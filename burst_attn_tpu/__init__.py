@@ -1,0 +1,41 @@
+"""burst-attn-tpu: TPU-native distributed (ring) exact attention.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of
+MayDomine/Burst-Attention (see SURVEY.md): sequence-parallel exact attention
+with FlashAttention-style online softmax, `lax.ppermute` rings under
+`shard_map`, hierarchical double ring over an ("inter", "intra") device mesh,
+causal load balancing via zigzag-half and striped token layouts, and a
+communication-optimized backward pass (rotating query-side tensors plus an
+accumulating-dq ring).
+
+Public API (reference parity: burst_attn/burst_attn_interface.py:109-158):
+    burst_attn            -- global-array entry point (applies shard_map)
+    burst_attn_shard      -- per-shard entry point (call inside shard_map)
+    burst_attn_func       -- reference-style alias (zigzag layout)
+    burst_attn_func_striped -- reference-style alias (striped layout)
+    BurstConfig           -- static configuration
+"""
+
+from .parallel.burst import (
+    BurstConfig,
+    burst_attn,
+    burst_attn_shard,
+    burst_attn_func,
+    burst_attn_func_striped,
+)
+from .parallel import layouts
+from .ops import masks, tile, reference
+
+__all__ = [
+    "BurstConfig",
+    "burst_attn",
+    "burst_attn_shard",
+    "burst_attn_func",
+    "burst_attn_func_striped",
+    "layouts",
+    "masks",
+    "tile",
+    "reference",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from . import masks, tile, reference
+
+__all__ = ["masks", "tile", "reference"]

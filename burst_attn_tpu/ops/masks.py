@@ -1,0 +1,99 @@
+"""Per-round attention mask specifications for ring attention.
+
+The reference implements causal load balancing with three *structural* code
+paths per ring round (full / first-half-KV / second-half-Q for the zigzag
+layout, and a shift-by-one tensor slicing for the striped layout — see
+burst_attn/burst_attn_interface.py:221-235, :303-367, :454-475 in the
+reference).  On TPU we instead parameterize ONE uniform attention tile by five
+runtime scalars, so every ring round is the same traced computation (scan
+body) and XLA/Pallas can skip the masked-out work via dynamic loop bounds:
+
+    q_lo, q_hi : active query-row range [q_lo, q_hi)    (local indices)
+    kv_hi      : active key/value-column range [0, kv_hi)
+    causal     : 1 if a causal constraint applies
+    offset     : col j visible from row i  iff  j <= i + offset
+
+This reproduces the reference's case analysis exactly:
+
+zigzag layout (rank p holds global chunks p and 2W-1-p, concatenated):
+  * kv_part == q_part : plain causal on the local layout (offset 0).  Valid
+    because both halves are internally contiguous and the first half precedes
+    the second globally.
+  * kv_part <  q_part : kv's first half is entirely in the local q's past and
+    its second half entirely in the future -> full q x first-half kv,
+    non-causal (reference's `split_kv` branch).
+  * kv_part >  q_part : local q's first half sees nothing; its second half
+    sees everything -> second-half q x full kv, non-causal.
+
+striped layout (rank p holds global tokens p, p+W, p+2W, ...):
+  local token i on rank a is global a + i*W; causality  b + jW <= a + iW
+  reduces to  j <= i  when  b <= a  (offset 0) and  j <= i-1  otherwise
+  (offset -1) — the reference's shift-by-one slicing
+  (burst_attn_interface.py:463-475) expressed as a mask.
+
+contig layout (plain contiguous chunks, the naive causal ring): kv_part <
+q_part -> full, == -> causal, > -> fully masked.  Not load balanced; kept as
+the ring-attention baseline (reference benchmarks/ring_attn.py).
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+LAYOUTS = ("contig", "zigzag", "striped")
+
+
+class MaskSpec(NamedTuple):
+    """Runtime scalars (all int32) describing one ring round's mask."""
+
+    q_lo: jnp.ndarray
+    q_hi: jnp.ndarray
+    kv_hi: jnp.ndarray
+    causal: jnp.ndarray
+    offset: jnp.ndarray
+
+
+def _i32(x):
+    return jnp.asarray(x, dtype=jnp.int32)
+
+
+def full_spec(s_q: int, s_kv: int) -> MaskSpec:
+    return MaskSpec(_i32(0), _i32(s_q), _i32(s_kv), _i32(0), _i32(0))
+
+
+def round_spec(q_part, kv_part, s_q: int, s_kv: int, causal: bool, layout: str) -> MaskSpec:
+    """Mask spec for one ring round.
+
+    q_part / kv_part: global partition ids (traced int32 scalars) of the
+    sequence chunks held by the query side and key/value side of this round.
+    s_q / s_kv: static local sub-sequence lengths.  causal/layout: static.
+    """
+    if not causal:
+        return full_spec(s_q, s_kv)
+    if layout == "zigzag":
+        assert s_q % 2 == 0 and s_kv % 2 == 0, "zigzag needs even local seqlen"
+        eq = q_part == kv_part
+        q_lo = jnp.where(kv_part > q_part, s_q // 2, 0).astype(jnp.int32)
+        kv_hi = jnp.where(kv_part < q_part, s_kv // 2, s_kv).astype(jnp.int32)
+        return MaskSpec(q_lo, _i32(s_q), kv_hi, eq.astype(jnp.int32), _i32(0))
+    elif layout == "striped":
+        offset = jnp.where(kv_part <= q_part, 0, -1).astype(jnp.int32)
+        return MaskSpec(_i32(0), _i32(s_q), _i32(s_kv), _i32(1), offset)
+    elif layout == "contig":
+        q_hi = jnp.where(kv_part > q_part, 0, s_q).astype(jnp.int32)
+        return MaskSpec(_i32(0), q_hi, _i32(s_kv), (q_part == kv_part).astype(jnp.int32), _i32(0))
+    else:
+        raise ValueError(f"unknown layout {layout!r}; expected one of {LAYOUTS}")
+
+
+def dense_mask(spec: MaskSpec, s_q: int, s_kv: int) -> jnp.ndarray:
+    """Materialize the [s_q, s_kv] boolean mask (True = attend).
+
+    Used by the jnp tile (the numerics oracle) and by tests; the Pallas
+    kernels compute the same predicate block-wise with dynamic loop bounds.
+    """
+    rows = jnp.arange(s_q, dtype=jnp.int32)[:, None]
+    cols = jnp.arange(s_kv, dtype=jnp.int32)[None, :]
+    m = (rows >= spec.q_lo) & (rows < spec.q_hi) & (cols < spec.kv_hi)
+    causal_ok = jnp.where(spec.causal > 0, cols <= rows + spec.offset, True)
+    return m & causal_ok

@@ -1,0 +1,31 @@
+"""Dense reference attention — the test oracle.
+
+Equivalent role to the reference's single-device flash oracle in
+test/test_burst.py:175-184: full-sequence attention computed directly (no
+tiling, fp32 softmax), against which the distributed op is compared chunk-wise
+with the reference's tolerances (test/checker.py:10).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("causal",))
+def dense_attention(q, k, v, scale=None, causal=False):
+    """q, k, v: [B, N, S, D] (kv heads may be fewer — GQA). Returns [B, N, S, D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    from .tile import _expand_kv
+
+    k = _expand_kv(k, q.shape[1])
+    v = _expand_kv(v, q.shape[1])
+    s = jnp.einsum("bnid,bnjd->bnij", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_kv = q.shape[2], k.shape[2]
+        rows = jnp.arange(s_q)[:, None]
+        cols = jnp.arange(s_kv)[None, :]
+        s = jnp.where(cols <= rows, s, float("-inf"))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bnij,bnjd->bnid", p, v.astype(jnp.float32)).astype(q.dtype)

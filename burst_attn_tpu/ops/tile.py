@@ -1,0 +1,126 @@
+"""Pure-jnp online-softmax attention tile with carry-in state.
+
+One ring round of FlashAttention-style attention: given carry state
+(m = running row max, lse = running log-sum-exp, acc = unnormalized output
+accumulator) from previous rounds, fold in the contribution of one KV block.
+
+This is the numerics oracle for the framework — the TPU-native analogue of
+the reference's pure-torch tile (burst_attn/burst_utils.py:42-101) and of the
+carry-in Triton kernel (burst_attn/lao.py:67-213).  It runs on any backend
+(CPU included), is exactly what the Pallas kernels must reproduce, and is the
+default backend for simulated-mesh tests.
+
+Conventions (all differ deliberately from the reference's torch layout mix):
+  q, k, v : [B, N, S, D]  ("bnsd"; contiguous [S, D] per head — TPU friendly)
+  m, lse  : [B, N, S]     float32, initialized to -inf
+  acc     : [B, N, S, D]  float32, initialized to 0, unnormalized
+  final   : o = acc * exp(m - lse)   (guarded for fully-masked rows)
+
+GQA: N query heads, Nk kv heads with N % Nk == 0; kv head g serves query
+heads [g*G, (g+1)*G).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .masks import MaskSpec, dense_mask
+
+NEG_INF = float("-inf")
+
+
+def init_state(batch, heads, seq, dim):
+    m = jnp.full((batch, heads, seq), NEG_INF, dtype=jnp.float32)
+    lse = jnp.full((batch, heads, seq), NEG_INF, dtype=jnp.float32)
+    acc = jnp.zeros((batch, heads, seq, dim), dtype=jnp.float32)
+    return m, lse, acc
+
+
+def _expand_kv(x, n_q_heads):
+    """Repeat kv heads to match query heads (GQA)."""
+    n_kv = x.shape[1]
+    if n_kv == n_q_heads:
+        return x
+    assert n_q_heads % n_kv == 0, f"GQA needs Nq % Nk == 0, got {n_q_heads} % {n_kv}"
+    return jnp.repeat(x, n_q_heads // n_kv, axis=1)
+
+
+def tile_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec):
+    """One online-softmax round; returns updated (m, lse, acc)."""
+    s_q, s_kv = q.shape[2], k.shape[2]
+    k = _expand_kv(k, q.shape[1])
+    v = _expand_kv(v, q.shape[1])
+    mask = dense_mask(spec, s_q, s_kv)
+
+    s = jnp.einsum("bnid,bnjd->bnij", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # alpha rescales the old accumulator; rows where m stays -inf keep alpha=1
+    # (their acc is 0 anyway) to avoid -inf - -inf = nan.
+    alpha = jnp.where(m >= m_new, 1.0, jnp.exp(m - m_new))
+    p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+    l_step = jnp.sum(p, axis=-1)
+
+    acc = acc * alpha[..., None] + jnp.einsum(
+        "bnij,bnjd->bnid", p, v, preferred_element_type=jnp.float32
+    )
+    prior = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(lse - m_new))
+    total = prior + l_step
+    lse_new = jnp.where(total > 0, m_new + jnp.log(total), NEG_INF)
+    return m_new, lse_new, acc
+
+
+def finalize(m, lse, acc, dtype):
+    """Normalize the accumulator: o = acc * exp(m - lse)."""
+    o_scale = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(m - lse))
+    return (acc * o_scale[..., None]).astype(dtype)
+
+
+def tile_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec):
+    """One backward ring round; returns this round's (dq, dk, dv) in float32.
+
+    delta = sum(o * do, axis=-1) [B, N, S] float32 (precomputed once — the
+    reference's optimize_bwd_comm quantity, burst_attn_interface.py:269-278).
+    lse is the FINAL log-sum-exp of the query rows, so p = exp(s - lse) is the
+    true softmax probability; masked entries are forced to zero.
+    """
+    n_q = q.shape[1]
+    n_kv = k.shape[1]
+    s_q, s_kv = q.shape[2], k.shape[2]
+    kx = _expand_kv(k, n_q)
+    vx = _expand_kv(v, n_q)
+    mask = dense_mask(spec, s_q, s_kv)
+
+    s = jnp.einsum("bnid,bnjd->bnij", q, kx, preferred_element_type=jnp.float32)
+    s = s * scale
+    p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bnij,bnid->bnjd", p, do32, preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bnid,bnjd->bnij", do32, vx, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bnij,bnjd->bnid", ds, kx, preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bnij,bnid->bnjd", ds, q, preferred_element_type=jnp.float32)
+
+    if n_kv != n_q:
+        g = n_q // n_kv
+        dk = dk.reshape(dk.shape[0], n_kv, g, s_kv, -1).sum(axis=2)
+        dv = dv.reshape(dv.shape[0], n_kv, g, s_kv, -1).sum(axis=2)
+    return dq, dk, dv
+
+
+@partial(jax.jit, static_argnames=("causal",))
+def single_device_attention(q, k, v, scale=None, causal=False):
+    """Full attention on one device via the tile (a one-round "ring")."""
+    from .masks import round_spec
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, n, s, d = q.shape
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, k.shape[2], causal, "contig")
+    m, lse, acc = init_state(b, n, s, d)
+    m, lse, acc = tile_fwd(q, k, v, m, lse, acc, scale, spec)
+    return finalize(m, lse, acc, q.dtype)

@@ -1,0 +1,3 @@
+from . import layouts, ring
+
+__all__ = ["layouts", "ring"]

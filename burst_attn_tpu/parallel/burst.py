@@ -1,0 +1,392 @@
+"""Burst (ring) attention — the core distributed op.
+
+TPU-native rebuild of the reference's OpBurstAttn / OpBurstAttnStrip
+(burst_attn/burst_attn_interface.py:161-613):
+
+  torch.autograd.Function            -> jax.custom_vjp (burst_attn_shard)
+  Python ring loop + CUDA streams +
+    double buffers (comm.py:267-301) -> lax.scan whose body issues the
+                                        collective-permute BEFORE the tile
+                                        compute; XLA's async collective
+                                        permute gives the comm/compute
+                                        overlap, the scan carry is the
+                                        double buffer
+  NCCL P2P ring                      -> lax.ppermute on a named mesh axis
+  double ring (intra-node ring nested
+    in inter-node ring, inter hop
+    prefetched one intra-cycle early
+    on its own stream, comm.py:221-254) -> a static Python loop over inter
+                                        cycles: the inter-axis ppermute of
+                                        the cycle base is issued at cycle
+                                        start and consumed at cycle end, so
+                                        XLA has the whole intra cycle to
+                                        hide the DCN hop
+  dq add-and-forward ring
+    (comm.py:187-218)                -> dq_intra rotates with the q-side
+                                        payload; at each cycle boundary it is
+                                        folded into an inter-ring running sum
+                                        and restarted at zero; one final
+                                        inter+intra hop returns dq home
+  causal zigzag 3-way case split /
+    striped shift-by-one slicing     -> one uniform tile parameterized by
+                                        runtime MaskSpec scalars (ops/masks.py)
+
+Data conventions: per-shard q, k, v are [B, N, S_local, D] ("bnsd"), where the
+global sequence is permuted into layout order (parallel/layouts.py) and
+chunked device-major over (inter, intra): partition id = inter_rank *
+intra_size + intra_rank.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops import tile as jnp_tile
+from ..ops.masks import round_spec
+from .ring import ppermute_next, my_partition, partition_at_round
+
+
+@dataclass(frozen=True)
+class BurstConfig:
+    """Static configuration for burst attention.
+
+    Mirrors the kwargs of the reference's burst_attn_func
+    (burst_attn_interface.py:135-158); process_group/double_group become mesh
+    axis names, the flash/triton/math backend switch becomes jnp vs pallas,
+    and `deterministic` is always true on TPU (XLA reductions are
+    deterministic) — kept for API parity.
+    """
+
+    causal: bool = False
+    layout: str = "zigzag"  # "zigzag" | "striped" | "contig" (causal schedule)
+    scale: Optional[float] = None  # default 1/sqrt(head_dim)
+    intra_axis: str = "sp"
+    inter_axis: Optional[str] = None  # set for the hierarchical double ring
+    backend: str = "jnp"  # "jnp" | "pallas"
+    optimize_bwd_comm: bool = True  # rotate delta=sum(o*do) [B,N,S] f32, not o
+    block_q: int = 256
+    block_kv: int = 256
+    deterministic: bool = True
+
+
+# ---------------------------------------------------------------------------
+# tile dispatch
+
+
+def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec):
+    if cfg.backend == "pallas":
+        from ..ops import pallas_flash
+
+        return pallas_flash.flash_fwd(
+            q, k, v, m, lse, acc, scale, spec,
+            block_q=cfg.block_q, block_kv=cfg.block_kv,
+        )
+    return jnp_tile.tile_fwd(q, k, v, m, lse, acc, scale, spec)
+
+
+def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec):
+    if cfg.backend == "pallas":
+        from ..ops import pallas_flash
+
+        return pallas_flash.flash_bwd(
+            do, q, k, v, delta, lse, scale, spec,
+            block_q=cfg.block_q, block_kv=cfg.block_kv,
+        )
+    return jnp_tile.tile_bwd(do, q, k, v, delta, lse, scale, spec)
+
+
+def _sizes(cfg):
+    intra = lax.axis_size(cfg.intra_axis)
+    inter = lax.axis_size(cfg.inter_axis) if cfg.inter_axis is not None else 1
+    return inter, intra
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def _fwd_impl(q, k, v, cfg: BurstConfig):
+    """Ring forward. Per-shard shapes q [B,N,S,D], k/v [B,Nk,S,D].
+
+    Reference call stack SURVEY.md §3.1 / burst_attn_interface.py:170-253.
+    Returns (o, lse) with o [B,N,S,D] in q.dtype, lse [B,N,S] f32.
+    """
+    b, n, s, d = q.shape
+    scale = cfg.scale if cfg.scale is not None else d**-0.5
+    n_inter, n_intra = _sizes(cfg)
+    part_me = my_partition(cfg.intra_axis, cfg.inter_axis)
+
+    state = jnp_tile.init_state(b, n, s, d)
+
+    def compute(st, kv_c, r):
+        kv_part = partition_at_round(r, cfg.intra_axis, cfg.inter_axis)
+        spec = round_spec(part_me, kv_part, s, kv_c[0].shape[2], cfg.causal, cfg.layout)
+        return _tile_fwd(cfg, q, kv_c[0], kv_c[1], *st, scale, spec)
+
+    kv = (k, v)
+    kv_base = kv
+    for c in range(n_inter):
+        if c < n_inter - 1:
+            # prefetch next cycle's base one full intra-cycle early
+            # (reference: comm.py:229-237); consumed at the cycle boundary.
+            kv_base_next = ppermute_next(kv_base, cfg.inter_axis)
+        if n_intra > 1:
+
+            def body(carry, s_idx, c=c):
+                kv_c, st = carry
+                kv_next = ppermute_next(kv_c, cfg.intra_axis)  # overlaps compute
+                st = compute(st, kv_c, c * n_intra + s_idx)
+                return (kv_next, st), None
+
+            (kv, state), _ = lax.scan(body, (kv, state), jnp.arange(n_intra - 1))
+        # last round of the cycle: no intra send (reference comm.py:238-251)
+        state = compute(state, kv, jnp.int32(c * n_intra + n_intra - 1))
+        if c < n_inter - 1:
+            kv = kv_base = kv_base_next
+    m, lse, acc = state
+    o = jnp_tile.finalize(m, lse, acc, q.dtype)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+
+
+def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
+    """Communication-optimized ring backward (SURVEY.md §3.2).
+
+    K, V stay resident; the query-side payload (delta|o, do, q, lse) rotates
+    like KV did in forward; dq rides a concurrent accumulating ring and is
+    returned home by one extra hop (burst_attn_interface.py:255-398).
+    """
+    b, n, s, d = q.shape
+    scale = cfg.scale if cfg.scale is not None else d**-0.5
+    n_inter, n_intra = _sizes(cfg)
+    part_me = my_partition(cfg.intra_axis, cfg.inter_axis)
+
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    if cfg.optimize_bwd_comm:
+        # ring payload shrinks by a factor of head_dim
+        # (reference burst_attn_interface.py:269-278)
+        payload = (delta, do, q, lse)
+    else:
+        payload = (o, do, q, lse)
+
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    dq_intra = jnp.zeros(q.shape, jnp.float32)
+    dq_inter = jnp.zeros(q.shape, jnp.float32)
+
+    def compute(pay, r):
+        q_part = partition_at_round(r, cfg.intra_axis, cfg.inter_axis)
+        # roles flip vs forward: the rotating payload is the query side,
+        # local k/v are resident.
+        spec = round_spec(q_part, part_me, s, s, cfg.causal, cfg.layout)
+        first, do_r, q_r, lse_r = pay
+        if cfg.optimize_bwd_comm:
+            delta_r = first
+        else:
+            delta_r = jnp.sum(first.astype(jnp.float32) * do_r.astype(jnp.float32), axis=-1)
+        return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec)
+
+    pay_base = payload
+    for c in range(n_inter):
+        if c < n_inter - 1:
+            pay_base_next = ppermute_next(pay_base, cfg.inter_axis)
+        if c > 0:
+            # cycle boundary: fold the intra accumulator into the inter-ring
+            # running sum (add-and-forward, reference comm.py:187-218) and
+            # restart the intra accumulator at zero.
+            dq_inter = ppermute_next(dq_inter + dq_intra, cfg.inter_axis)
+            dq_intra = jnp.zeros_like(dq_intra)
+        # ---- first round of the cycle (r = c*I): no dq rotation ----
+        dqc, dkc, dvc = compute(payload, jnp.int32(c * n_intra))
+        dq_intra = dq_intra + dqc
+        dk = dk + dkc
+        dv = dv + dvc
+        if n_intra > 1:
+            payload = ppermute_next(payload, cfg.intra_axis)
+            if n_intra > 2:
+
+                def body(carry, s_idx, c=c):
+                    pay, dq_i, dk_c, dv_c = carry
+                    pay_next = ppermute_next(pay, cfg.intra_axis)
+                    # dq leaves with the payload it accumulated for; the
+                    # arriving dq belongs to the payload we hold this round.
+                    dq_rot = ppermute_next(dq_i, cfg.intra_axis)
+                    dqc, dkc, dvc = compute(pay, c * n_intra + s_idx)
+                    return (pay_next, dq_rot + dqc, dk_c + dkc, dv_c + dvc), None
+
+                (payload, dq_intra, dk, dv), _ = lax.scan(
+                    body, (payload, dq_intra, dk, dv), jnp.arange(1, n_intra - 1)
+                )
+            # ---- last round of the cycle: rotate dq but not the payload ----
+            dq_rot = ppermute_next(dq_intra, cfg.intra_axis)
+            dqc, dkc, dvc = compute(payload, jnp.int32(c * n_intra + n_intra - 1))
+            dq_intra = dq_rot + dqc
+            dk = dk + dkc
+            dv = dv + dvc
+        if c < n_inter - 1:
+            payload = pay_base = pay_base_next
+
+    # final return-home hops (reference burst_attn_interface.py:391-396,
+    # comm.py:206-216): fold, one inter hop, one intra hop.
+    dq = dq_inter + dq_intra
+    if cfg.inter_axis is not None:
+        dq = ppermute_next(dq, cfg.inter_axis)
+    dq = ppermute_next(dq, cfg.intra_axis)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def burst_attn_shard(q, k, v, cfg: BurstConfig):
+    """Burst attention on per-shard arrays — call inside shard_map.
+
+    q: [B, N, S_local, D]; k, v: [B, Nk, S_local, D] (GQA when Nk < N).
+    Returns o: [B, N, S_local, D] in q.dtype.
+    """
+    o, _ = _fwd_impl(q, k, v, cfg)
+    return o
+
+
+def _vjp_fwd(q, k, v, cfg):
+    o, lse = _fwd_impl(q, k, v, cfg)
+    return o, (q, k, v, o, lse)
+
+
+def _vjp_bwd(cfg, residuals, do):
+    q, k, v, o, lse = residuals
+    dq, dk, dv = _bwd_impl(cfg, q, k, v, o, lse, do)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+burst_attn_shard.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# global-array wrapper
+
+
+def _resolve_backend(backend: str) -> str:
+    if backend == "auto":
+        if jax.default_backend() == "tpu":
+            try:
+                from ..ops import pallas_flash  # noqa: F401
+
+                return "pallas"
+            except ImportError:
+                return "jnp"
+        return "jnp"
+    return backend
+
+
+def burst_attn(
+    q,
+    k,
+    v,
+    *,
+    mesh,
+    seq_axes=("sp",),
+    causal: bool = False,
+    layout: str = "zigzag",
+    scale: Optional[float] = None,
+    backend: str = "auto",
+    optimize_bwd_comm: bool = True,
+    block_q: int = 256,
+    block_kv: int = 256,
+) -> jax.Array:
+    """Burst attention on global arrays [B, N, S, D]; S must already be in
+    layout order (parallel/layouts.to_layout) for causal runs.
+
+    seq_axes: mesh axis name(s) the sequence is sharded over — ("sp",) for a
+    single ring or ("inter", "intra") for the hierarchical double ring.
+    """
+    if isinstance(seq_axes, str):
+        seq_axes = (seq_axes,)
+    if len(seq_axes) == 1:
+        inter_axis, intra_axis = None, seq_axes[0]
+    elif len(seq_axes) == 2:
+        inter_axis, intra_axis = seq_axes
+    else:
+        raise ValueError(f"seq_axes must have 1 or 2 names, got {seq_axes}")
+    cfg = BurstConfig(
+        causal=causal,
+        layout=layout,
+        scale=scale,
+        intra_axis=intra_axis,
+        inter_axis=inter_axis,
+        backend=_resolve_backend(backend),
+        optimize_bwd_comm=optimize_bwd_comm,
+        block_q=block_q,
+        block_kv=block_kv,
+    )
+    spec = P(None, None, seq_axes if len(seq_axes) > 1 else intra_axis, None)
+    fn = jax.shard_map(
+        partial(burst_attn_shard, cfg=cfg),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# reference-style aliases (burst_attn_interface.py:109-158 parity)
+
+
+def burst_attn_func(
+    q,
+    k,
+    v,
+    softmax_scale=None,
+    flash: str = "auto",
+    causal: bool = False,
+    optimize_bwd_comm: bool = True,
+    deterministic: bool = True,
+    *,
+    mesh,
+    seq_axes=("sp",),
+):
+    """Reference-parity entry point: zigzag-half causal layout.
+
+    `flash` selects the tile backend ("auto" | "pallas" | "jnp"), replacing
+    the reference's "cuda"/"triton"/math switch.  `deterministic` is accepted
+    for parity; the TPU path is always deterministic.
+    """
+    del deterministic
+    return burst_attn(
+        q, k, v, mesh=mesh, seq_axes=seq_axes, causal=causal, layout="zigzag",
+        scale=softmax_scale, backend=flash, optimize_bwd_comm=optimize_bwd_comm,
+    )
+
+
+def burst_attn_func_striped(
+    q,
+    k,
+    v,
+    softmax_scale=None,
+    flash: str = "auto",
+    causal: bool = False,
+    optimize_bwd_comm: bool = True,
+    deterministic: bool = True,
+    *,
+    mesh,
+    seq_axes=("sp",),
+):
+    """Reference-parity entry point: striped causal layout
+    (burst_attn_interface.py:109, OpBurstAttnStrip)."""
+    del deterministic
+    return burst_attn(
+        q, k, v, mesh=mesh, seq_axes=seq_axes, causal=causal, layout="striped",
+        scale=softmax_scale, backend=flash, optimize_bwd_comm=optimize_bwd_comm,
+    )

@@ -1,0 +1,77 @@
+"""Sequence-sharding layouts: contiguous, zigzag-half, and striped.
+
+A layout is a permutation of the global sequence: after permuting, contiguous
+equal chunks over the ring (device-major order: partition p = inter_rank *
+intra_size + intra_rank) give each device its layout chunk.  This replaces
+the reference's ad-hoc chunk gathering (test/test_burst.py:44-58):
+
+  * contig : identity — chunk p holds global tokens [p*C, (p+1)*C)
+  * zigzag : chunk p holds global chunks p and 2W-1-p of size S/(2W)
+             (test_burst.py:46-52, `half_reputation=True`)
+  * striped: chunk p holds global tokens p, p+W, p+2W, ...
+             (test_burst.py:55-58)
+
+All helpers are pure index math (numpy at trace time) so they can be used
+both host-side (to shard test inputs) and inside jitted code (jnp.take with a
+constant permutation folds into a gather XLA handles well).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops.masks import LAYOUTS
+
+
+def seq_permutation(layout: str, seq_len: int, world: int) -> np.ndarray:
+    """perm[i] = global token index that position i of the layout-ordered
+    sequence holds.  Concatenating the per-device chunks (device-major) of the
+    permuted sequence reproduces the layout."""
+    if seq_len % world != 0:
+        raise ValueError(f"seq_len {seq_len} not divisible by world {world}")
+    if layout == "contig":
+        return np.arange(seq_len)
+    elif layout == "zigzag":
+        if seq_len % (2 * world) != 0:
+            raise ValueError(f"zigzag needs seq_len % (2*world) == 0, got {seq_len}, {world}")
+        c = seq_len // (2 * world)
+        chunks = np.arange(seq_len).reshape(2 * world, c)
+        order = []
+        for p in range(world):
+            order.append(chunks[p])
+            order.append(chunks[2 * world - 1 - p])
+        return np.concatenate(order)
+    elif layout == "striped":
+        # position (p, i) -> global token p + i*world
+        return np.arange(seq_len).reshape(seq_len // world, world).T.reshape(-1)
+    raise ValueError(f"unknown layout {layout!r}; expected one of {LAYOUTS}")
+
+
+def inverse_permutation(perm: np.ndarray) -> np.ndarray:
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm))
+    return inv
+
+
+def to_layout(x, layout: str, world: int, axis: int):
+    """Permute the global sequence axis into layout order."""
+    perm = seq_permutation(layout, x.shape[axis], world)
+    return jnp.take(x, jnp.asarray(perm), axis=axis)
+
+
+def from_layout(x, layout: str, world: int, axis: int):
+    """Inverse of to_layout: back to natural token order."""
+    perm = inverse_permutation(seq_permutation(layout, x.shape[axis], world))
+    return jnp.take(x, jnp.asarray(perm), axis=axis)
+
+
+def shard_chunks(x, layout: str, world: int, axis: int):
+    """Split a global array into the per-partition chunks (list of length
+    world) each ring member holds under `layout`.  Host/test helper."""
+    xl = to_layout(x, layout, world, axis)
+    return [c for c in jnp.split(xl, world, axis=axis)]
+
+
+def position_ids(layout: str, seq_len: int, world: int) -> np.ndarray:
+    """[world, seq_len // world] global position of each local token — feed to
+    rotary/position embeddings so models see true positions under any layout."""
+    return seq_permutation(layout, seq_len, world).reshape(world, -1)

@@ -1,0 +1,57 @@
+"""Ring transport over mesh axes — the TPU-native equivalent of the
+reference's NCCL P2P layer (burst_attn/comm.py).
+
+Every reference primitive maps to an XLA collective on a named mesh axis:
+
+  Ring._make_ring_ops / batch_isend_irecv  -> lax.ppermute (async
+      collective-permute; XLA overlaps it with compute, replacing the
+      reference's CUDA stream/event choreography, comm.py:267-282)
+  double ring intra/inter streams          -> two mesh axes ("inter","intra")
+  even/odd deadlock ordering (comm.py:166) -> not needed (ppermute is one op)
+  all_reduce / broadcast (comm.py:16,67)   -> lax.psum / device_put+pjit
+
+The partition-id schedule (reference get_partition_id,
+burst_attn_interface.py:20-37) tracks which global sequence partition a
+device's rotating buffer holds at ring round r.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ppermute_next(x, axis_name: str):
+    """Rotate a pytree one hop forward (rank i -> i+1) along a mesh axis."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), x)
+
+
+def axis_ranks(intra_axis: str, inter_axis):
+    """(inter_rank, intra_rank, inter_size, intra_size) for this device."""
+    intra_rank = lax.axis_index(intra_axis)
+    intra_size = lax.axis_size(intra_axis)
+    if inter_axis is None:
+        return jnp.int32(0), intra_rank, 1, intra_size
+    return lax.axis_index(inter_axis), intra_rank, lax.axis_size(inter_axis), intra_size
+
+
+def my_partition(intra_axis: str, inter_axis) -> jnp.ndarray:
+    inter_rank, intra_rank, _, intra_size = axis_ranks(intra_axis, inter_axis)
+    return inter_rank * intra_size + intra_rank
+
+
+def partition_at_round(r, intra_axis: str, inter_axis):
+    """Global partition id of the KV (fwd) / query-side (bwd) payload held at
+    0-indexed ring round r under the (double-)ring schedule.
+
+    With the forward rotation i -> i+1, after c inter hops and s intra hops a
+    device holds the payload of (inter_rank - c, intra_rank - s); flattened
+    partition id = inter*I + intra.  Matches the reference's formula
+    (burst_attn_interface.py:27-36) and, for a single ring, is equivalent to
+    its `round_r = r` shortcut (the <=-rank comparisons agree).
+    """
+    inter_rank, intra_rank, inter_size, intra_size = axis_ranks(intra_axis, inter_axis)
+    c = r // intra_size
+    s = r % intra_size
+    return ((inter_rank - c) % inter_size) * intra_size + (intra_rank - s) % intra_size

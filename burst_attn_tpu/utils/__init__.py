@@ -1,0 +1,3 @@
+from . import testing
+
+__all__ = ["testing"]

@@ -1,0 +1,16 @@
+"""Force an 8-device CPU mesh so distributed tests run anywhere.
+
+SURVEY.md §4: the reference's only test needs 8 real GPUs under torchrun; the
+TPU build simulates the ring on host devices instead
+(XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")

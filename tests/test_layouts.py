@@ -1,0 +1,50 @@
+"""Layout permutations: invertibility and equivalence to the reference's
+chunking (test/test_burst.py:44-58)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from burst_attn_tpu.parallel import layouts
+
+
+@pytest.mark.parametrize("layout", ["contig", "zigzag", "striped"])
+@pytest.mark.parametrize("W", [2, 4, 8])
+def test_permutation_invertible(layout, W):
+    S = 16 * W
+    perm = layouts.seq_permutation(layout, S, W)
+    assert sorted(perm.tolist()) == list(range(S))
+    inv = layouts.inverse_permutation(perm)
+    np.testing.assert_array_equal(perm[inv], np.arange(S))
+
+    x = jnp.arange(S * 3.0).reshape(S, 3)
+    np.testing.assert_array_equal(
+        np.asarray(layouts.from_layout(layouts.to_layout(x, layout, W, 0), layout, W, 0)),
+        np.asarray(x),
+    )
+
+
+def test_zigzag_matches_reference_chunking():
+    # reference get_chunk(half_reputation=True): rank i holds chunks i and
+    # 2W-1-i of the 2W-way split (test_burst.py:46-52)
+    W, S = 4, 32
+    perm = layouts.seq_permutation("zigzag", S, W).reshape(W, -1)
+    c = S // (2 * W)
+    for p in range(W):
+        expect = np.concatenate(
+            [np.arange(p * c, (p + 1) * c), np.arange((2 * W - 1 - p) * c, (2 * W - p) * c)]
+        )
+        np.testing.assert_array_equal(perm[p], expect)
+
+
+def test_striped_matches_reference_chunking():
+    # reference striped: rank i holds tokens i, i+W, i+2W, ... (test_burst.py:55-58)
+    W, S = 4, 32
+    perm = layouts.seq_permutation("striped", S, W).reshape(W, -1)
+    for p in range(W):
+        np.testing.assert_array_equal(perm[p], np.arange(p, S, W))
+
+
+def test_position_ids():
+    pos = layouts.position_ids("striped", 16, 4)
+    np.testing.assert_array_equal(pos[1], np.arange(1, 16, 4))
