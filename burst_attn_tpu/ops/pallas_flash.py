@@ -1,0 +1,556 @@
+"""Pallas TPU flash-attention kernel family with ring carry-in state.
+
+The native-kernel layer of the framework: the TPU equivalent of the
+reference's two GPU kernel backends — the flash-attn v2 CUDA kernels
+(reference burst_attn/burst_utils.py:149-248) and the carry-in Triton kernel
+(reference burst_attn/lao.py:67-213, whose forward ACCEPTS previous
+(m, lse, acc_o) so the online softmax continues across ring rounds).
+
+Design (see SURVEY.md §2.3, §7):
+
+  * `flash_fwd` — one ring round.  Takes carry state (m, lse, acc) and folds
+    in one KV block's contribution, exactly like lao.py's `_fwd_kernel`
+    carry-in args (M_in, Lse_in, O_in; lao.py:107-114) but expressed as a
+    Pallas grid over (batch, head, q-block, kv-block) with the running
+    (m, l, acc) held in VMEM scratch across the innermost kv iterations.
+  * `flash_bwd` — one backward ring round, split into a dq kernel and a
+    dk/dv kernel (both deterministic — no atomics, unlike lao.py's
+    atomic-add dq path, lao.py:473-482).  Takes the precomputed
+    delta = sum(o*do) (the reference's optimize_bwd_comm quantity /
+    flash-attn softmax_d input, burst_utils.py:195-229) and the FINAL lse.
+  * Every ring round is the SAME compiled kernel, parameterized by the five
+    runtime MaskSpec scalars (ops/masks.py) delivered via scalar prefetch;
+    index maps clamp the kv-block index so fully-masked blocks are neither
+    fetched nor computed (the TPU analogue of the reference's 3-way causal
+    case split, burst_attn_interface.py:221-235).
+
+State layout.  The per-row softmax stats (m, lse, delta) are logically
+[B, N, S] float32.  Mosaic requires the last two block dims to be
+(8k, 128k)-aligned or equal to the array dims, and the lane-replicated
+[B, N, S, 128] layout used by stock kernels inflates HBM 128x — untenable at
+ring scale (B·N·S grows to millions of rows).  We instead reshape to
+[B, N, S/LP, LP] (LP = 128 when possible; a free, layout-preserving reshape)
+and give each (batch, head) program the whole head's stats as one block
+(block dims == array dims, always legal).  In-kernel, rows for one q-block
+are unpacked (LP lanes -> bq sublanes) with an exact repeat+select, and
+packed back with a native lane-reducing reshape.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .masks import MaskSpec
+
+NEG_INF = float("-inf")
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(seq: int, block: int) -> int:
+    """Largest block <= `block` that divides seq (seq lengths are powers of
+    two in practice, so this is normally min(block, seq))."""
+    block = min(block, seq)
+    while seq % block:
+        block -= 1
+    return block
+
+
+def _spec_array(spec: MaskSpec):
+    return jnp.stack(
+        [
+            jnp.asarray(spec.q_lo, jnp.int32),
+            jnp.asarray(spec.q_hi, jnp.int32),
+            jnp.asarray(spec.kv_hi, jnp.int32),
+            jnp.asarray(spec.causal, jnp.int32),
+            jnp.asarray(spec.offset, jnp.int32),
+        ]
+    )
+
+
+def _block_mask(spec_ref, r0, c0, bq, bkv):
+    """[bq, bkv] bool mask for the tile at rows r0.., cols c0.. (True=attend)."""
+    rows = r0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+    cols = c0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+    q_lo, q_hi, kv_hi = spec_ref[0], spec_ref[1], spec_ref[2]
+    causal, offset = spec_ref[3], spec_ref[4]
+    m = (rows >= q_lo) & (rows < q_hi) & (cols < kv_hi)
+    return m & ((causal == 0) | (cols <= rows + offset))
+
+
+def _block_has_work(spec_ref, r0, c0, bq, bkv):
+    q_lo, q_hi, kv_hi = spec_ref[0], spec_ref[1], spec_ref[2]
+    causal, offset = spec_ref[3], spec_ref[4]
+    ok = (r0 < q_hi) & (r0 + bq > q_lo) & (c0 < kv_hi)
+    return ok & ((causal == 0) | (c0 <= r0 + bq - 1 + offset))
+
+
+def _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks):
+    """Last useful kv-block index for q-block i (for DMA index clamping)."""
+    kv_hi, causal, offset = spec_ref[2], spec_ref[3], spec_ref[4]
+    hi = jnp.where(causal > 0, jnp.minimum(kv_hi, i * bq + bq + offset), kv_hi)
+    return jnp.clip((hi + bkv - 1) // bkv - 1, 0, n_kv_blocks - 1)
+
+
+def _q_imin(spec_ref, j, bq, bkv, n_q_blocks):
+    """First useful q-block index for kv-block j (bwd dk/dv clamping)."""
+    q_lo, causal, offset = spec_ref[0], spec_ref[3], spec_ref[4]
+    lo = jnp.where(causal > 0, jnp.maximum(q_lo, j * bkv - offset), q_lo)
+    return jnp.clip(lo // bq, 0, n_q_blocks - 1)
+
+
+# ---------------------------------------------------------------------------
+# packed-stats helpers (see "State layout" in the module docstring)
+
+
+def _read_rows(state_ref, i, bq, lp):
+    """Rows [i*bq, (i+1)*bq) of a packed [1, 1, S/lp, lp] stats ref -> (bq, 1)."""
+    rows = bq // lp
+    pack = state_ref[0, 0, pl.ds(i * rows, rows), :]
+    if lp == 1:
+        return pack
+    rep = jnp.repeat(pack, bq // rows, axis=0)  # (bq, lp); row t = pack[t//lp]
+    t_lane = jax.lax.broadcasted_iota(jnp.int32, (bq, lp), 0) % lp
+    c_idx = jax.lax.broadcasted_iota(jnp.int32, (bq, lp), 1)
+    return jnp.sum(jnp.where(t_lane == c_idx, rep, 0.0), axis=1, keepdims=True)
+
+
+def _write_rows(state_ref, i, col, bq, lp):
+    """Inverse of _read_rows: store (bq, 1) into rows of the packed ref."""
+    rows = bq // lp
+    state_ref[0, 0, pl.ds(i * rows, rows), :] = jnp.reshape(col, (rows, lp))
+
+
+def _pack(x, lp):
+    """[B, N, S] -> [B, N, S/lp, lp] (free, layout-preserving reshape)."""
+    b, n, s = x.shape
+    return x.reshape(b, n, s // lp, lp)
+
+
+def _unpack(x):
+    b, n, r, lp = x.shape
+    return x.reshape(b, n, r * lp)
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def _fwd_kernel(
+    spec_ref,
+    q_ref, k_ref, v_ref, m_in_ref, lse_in_ref, acc_in_ref,
+    m_out_ref, lse_out_ref, acc_out_ref,
+    m_scr, l_scr, acc_scr,
+    *, scale, bq, bkv, lp, n_kv_blocks, cast_p,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    r0 = i * bq
+    c0 = j * bkv
+
+    @pl.when(j == 0)
+    def _init():
+        m0 = _read_rows(m_in_ref, i, bq, lp)
+        lse0 = _read_rows(lse_in_ref, i, bq, lp)
+        m_scr[:] = m0
+        # linear-scale running sum relative to m: l = exp(lse - m); 0 if empty
+        l_scr[:] = jnp.where(m0 == NEG_INF, 0.0, jnp.exp(lse0 - m0))
+        acc_scr[:] = acc_in_ref[0, 0, :, :]
+
+    @pl.when(
+        _block_has_work(spec_ref, r0, c0, bq, bkv)
+        & (j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks))
+    )
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        mask = _block_mask(spec_ref, r0, c0, bq, bkv)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = jnp.where(mask, s * scale, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.where(m_prev >= m_new, 1.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype) if cast_p else p,
+            v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(j == n_kv_blocks - 1)
+    def _finish():
+        m = m_scr[:]
+        l = l_scr[:]
+        _write_rows(m_out_ref, i, m, bq, lp)
+        lse = jnp.where(l > 0, m + jnp.log(l), NEG_INF)
+        _write_rows(lse_out_ref, i, lse, bq, lp)
+        acc_out_ref[0, 0, :, :] = acc_scr[:]
+
+
+def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
+              block_q=1024, block_kv=1024, interpret=None, cast_p=True):
+    """One online-softmax ring round on TPU.  Same contract as
+    ops/tile.py:tile_fwd: returns updated (m, lse, acc).
+
+    q [B,N,S,D]; k, v [B,Nk,Skv,D] (GQA when Nk < N); m, lse [B,N,S] f32;
+    acc [B,N,S,D] f32.  `spec` scalars may be traced values.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, n, s_q, d = q.shape
+    n_kv, s_kv = k.shape[1], k.shape[2]
+    group = n // n_kv
+    bq = _pick_block(s_q, block_q)
+    bkv = _pick_block(s_kv, block_kv)
+    lp = _pick_block(bq, 128)
+    nqb = s_q // bq
+    nkb = s_kv // bkv
+
+    def q_map(b_, h, i, j, sp):
+        return (b_, h, i, 0)
+
+    def kv_map(b_, h, i, j, sp):
+        return (b_, h // group, jnp.minimum(j, _kv_jmax(sp, i, bq, bkv, nkb)), 0)
+
+    def state_map(b_, h, i, j, sp):
+        return (b_, h, 0, 0)
+
+    grid = (b, n, nqb, nkb)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp, n_kv_blocks=nkb,
+        cast_p=cast_p,
+    )
+    state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
+    out_shape = [
+        jax.ShapeDtypeStruct((b, n, s_q // lp, lp), jnp.float32),
+        jax.ShapeDtypeStruct((b, n, s_q // lp, lp), jnp.float32),
+        jax.ShapeDtypeStruct((b, n, s_q, d), jnp.float32),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), q_map),
+            pl.BlockSpec((1, 1, bkv, d), kv_map),
+            pl.BlockSpec((1, 1, bkv, d), kv_map),
+            state_block,
+            state_block,
+            pl.BlockSpec((1, 1, bq, d), q_map),
+        ],
+        out_specs=[
+            state_block,
+            state_block,
+            pl.BlockSpec((1, 1, bq, d), q_map),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    m_new, lse_new, acc_new = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        # q-block dim must be "arbitrary": the packed m/lse out blocks are
+        # shared by every q-block of a head, so a megacore split over dim 2
+        # would race the partial writes.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(_spec_array(spec), q, k, v, _pack(m, lp), _pack(lse, lp), acc)
+    return _unpack(m_new), _unpack(lse_new), acc_new
+
+
+# ---------------------------------------------------------------------------
+# backward: dq kernel
+
+
+def _dq_kernel(
+    spec_ref,
+    do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
+    dq_ref,
+    dq_scr, lse_scr, delta_scr,
+    *, scale, bq, bkv, lp, n_kv_blocks,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    r0 = i * bq
+    c0 = j * bkv
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+        lse_scr[:] = _read_rows(lse_ref, i, bq, lp)
+        delta_scr[:] = _read_rows(delta_ref, i, bq, lp)
+
+    @pl.when(
+        _block_has_work(spec_ref, r0, c0, bq, bkv)
+        & (j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks))
+    )
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse_row = lse_scr[:]
+        delta_row = delta_scr[:]
+        mask = _block_mask(spec_ref, r0, c0, bq, bkv)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.where(mask & (lse_row != NEG_INF), jnp.exp(s - lse_row), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_row) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_kv_blocks - 1)
+    def _finish():
+        dq_ref[0, 0, :, :] = dq_scr[:]
+
+
+# ---------------------------------------------------------------------------
+# backward: dk/dv kernel
+#
+# Grid innermost dimension iterates over (gqa-group, q-block) pairs so each
+# (batch, kv-head, kv-block) program accumulates contributions from every
+# query head it serves — the group reduction of ops/tile.py:tile_bwd done
+# in-kernel without atomics.
+
+
+def _dkdv_kernel(
+    spec_ref,
+    do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
+    dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, scale, bq, bkv, lp, n_q_blocks, group,
+):
+    j = pl.program_id(2)
+    t = pl.program_id(3)
+    iq = t % n_q_blocks
+    r0 = iq * bq
+    c0 = j * bkv
+
+    @pl.when(t == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(
+        _block_has_work(spec_ref, r0, c0, bq, bkv)
+        & (iq >= _q_imin(spec_ref, j, bq, bkv, n_q_blocks))
+    )
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse_row = _read_rows(lse_ref, iq, bq, lp)
+        delta_row = _read_rows(delta_ref, iq, bq, lp)
+        mask = _block_mask(spec_ref, r0, c0, bq, bkv)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.where(mask & (lse_row != NEG_INF), jnp.exp(s - lse_row), 0.0)
+        # dv += p^T @ do
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_row) * scale
+        # dk += ds^T @ q
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(t == n_q_blocks * group - 1)
+    def _finish():
+        dk_ref[0, 0, :, :] = dk_scr[:]
+        dv_ref[0, 0, :, :] = dv_scr[:]
+
+
+def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
+              block_q=1024, block_kv=1024, interpret=None):
+    """One backward ring round on TPU.  Same contract as ops/tile.py:tile_bwd:
+    returns (dq [B,N,S,D], dk [B,Nk,Skv,D], dv [B,Nk,Skv,D]) in float32.
+
+    delta = sum(o*do, -1) [B,N,S] f32 (precomputed; reference
+    burst_attn_interface.py:269-278); lse is the FINAL log-sum-exp.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, n, s_q, d = q.shape
+    n_kv, s_kv = k.shape[1], k.shape[2]
+    group = n // n_kv
+    bq = _pick_block(s_q, block_q)
+    bkv = _pick_block(s_kv, block_kv)
+    lp = _pick_block(bq, 128)
+    nqb = s_q // bq
+    nkb = s_kv // bkv
+
+    # ---- dq ----
+    def q_map(b_, h, i, j, sp):
+        return (b_, h, i, 0)
+
+    def kv_map(b_, h, i, j, sp):
+        return (b_, h // group, jnp.minimum(j, _kv_jmax(sp, i, bq, bkv, nkb)), 0)
+
+    def state_map(b_, h, i, j, sp):
+        return (b_, h, 0, 0)
+
+    state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp, n_kv_blocks=nkb
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, n, nqb, nkb),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), q_map),
+                pl.BlockSpec((1, 1, bq, d), q_map),
+                pl.BlockSpec((1, 1, bkv, d), kv_map),
+                pl.BlockSpec((1, 1, bkv, d), kv_map),
+                state_block,
+                state_block,
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n, s_q, d), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(_spec_array(spec), do, q, k, v, _pack(delta, lp), _pack(lse, lp))
+
+    # ---- dk/dv ----
+    def qh_of(h, t):
+        return h * group + t // nqb
+
+    def bq_map(b_, h, j, t, sp):
+        return (b_, qh_of(h, t), jnp.maximum(t % nqb, _q_imin(sp, j, bq, bkv, nqb)), 0)
+
+    def bstate_map(b_, h, j, t, sp):
+        return (b_, qh_of(h, t), 0, 0)
+
+    def bkv_map(b_, h, j, t, sp):
+        return (b_, h, j, 0)
+
+    bstate_block = pl.BlockSpec((1, 1, s_q // lp, lp), bstate_map)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkdv_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp,
+            n_q_blocks=nqb, group=group,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, n_kv, nkb, nqb * group),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), bq_map),
+                pl.BlockSpec((1, 1, bq, d), bq_map),
+                pl.BlockSpec((1, 1, bkv, d), bkv_map),
+                pl.BlockSpec((1, 1, bkv, d), bkv_map),
+                bstate_block,
+                bstate_block,
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bkv, d), bkv_map),
+                pl.BlockSpec((1, 1, bkv, d), bkv_map),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bkv, d), jnp.float32),
+                pltpu.VMEM((bkv, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n_kv, s_kv, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, n_kv, s_kv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(_spec_array(spec), do, q, k, v, _pack(delta, lp), _pack(lse, lp))
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# single-device flash attention (the "flash" benchmark baseline, and a
+# standalone fused attention op — reference role: flash_attn_func on one GPU,
+# test/test_burst.py:175-184)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, scale=None, causal=False, block_q=1024, block_kv=1024):
+    """Fused single-device flash attention.  q,k,v [B,N,S,D] -> o [B,N,S,D]."""
+    o, _ = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv)
+    return o
+
+
+def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv):
+    from .masks import round_spec
+    from .tile import finalize as _finalize, init_state
+
+    b, n, s, d = q.shape
+    if scale is None:
+        scale = d**-0.5
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, k.shape[2], causal, "contig")
+    m0, lse0, acc0 = init_state(b, n, s, d)
+    m, lse, acc = flash_fwd(
+        q, k, v, m0, lse0, acc0, scale, spec, block_q=block_q, block_kv=block_kv
+    )
+    o = _finalize(m, lse, acc, q.dtype)
+    return o, lse
+
+
+def _flash_attention_vjp_fwd(q, k, v, scale, causal, block_q, block_kv):
+    o, lse = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, res, do):
+    from .masks import round_spec
+
+    q, k, v, o, lse = res
+    d = q.shape[-1]
+    if scale is None:
+        scale = d**-0.5
+    spec = round_spec(jnp.int32(0), jnp.int32(0), q.shape[2], k.shape[2], causal, "contig")
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    dq, dk, dv = flash_bwd(
+        do, q, k, v, delta, lse, scale, spec, block_q=block_q, block_kv=block_kv
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_attention_vjp_fwd, _flash_attention_vjp_bwd)
