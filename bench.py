@@ -1,0 +1,103 @@
+"""Driver benchmark: one JSON line with the headline metric.
+
+Metric = per-chip fwd+bwd TFLOPs/s of causal flash attention at the largest
+reference config that fits one chip, using the reference's FLOPs convention
+(reference benchmarks/benchmark.py:17-24): fwd FLOPs = 4*b*s^2*n*d / 2
+(causal), fwd+bwd = 3.5x fwd, divided by elapsed seconds / 1e12, per chip.
+
+Baseline = the reference's 8xA100 per-chip fwd+bwd TFLOPs/s at the same
+sequence length (reference README.md:81-85; BASELINE.md).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# seq -> reference per-chip fwd+bwd TFLOPs/s (README.md:81-85)
+BASELINE_FWDBWD = {65536: 170.0, 131072: 184.0, 262144: 191.0, 524288: 195.0, 1048576: 196.0}
+
+
+def _time(fn, *args, warmup=2, iters=5):
+    """fn must return a SCALAR; timing forces a host fetch because
+    block_until_ready alone does not synchronize on every platform (the
+    axon-relay TPU tunnel dispatches asynchronously)."""
+    for _ in range(warmup):
+        float(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def flops_fwd(b, s, n, d, causal):
+    return 4 * b * s * s * n * d / (2 if causal else 1)
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    b, n, d = 1, 32, 128
+    causal = True
+
+    if on_tpu:
+        from burst_attn_tpu.ops.pallas_flash import flash_attention
+
+        seq = 65536
+        dtype = jnp.bfloat16
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv, kg = jax.random.split(key, 4)
+        q = jax.random.normal(kq, (b, n, seq, d), dtype)
+        k = jax.random.normal(kk, (b, n, seq, d), dtype)
+        v = jax.random.normal(kv, (b, n, seq, d), dtype)
+        do = jax.random.normal(kg, (b, n, seq, d), dtype)
+
+        @jax.jit
+        def fwdbwd(q, k, v, do):
+            def loss(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, None, causal, 1024, 1024).astype(jnp.float32)
+                    * do.astype(jnp.float32)
+                )
+
+            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            # reduce to a scalar so the timer's host fetch is cheap but still
+            # forces the whole computation
+            return (jnp.sum(dq.astype(jnp.float32)) + jnp.sum(dk.astype(jnp.float32))
+                    + jnp.sum(dv.astype(jnp.float32)))
+
+        t = _time(fwdbwd, q, k, v, do)
+        tflops = 3.5 * flops_fwd(b, seq, n, d, causal) / t / 1e12
+        baseline = BASELINE_FWDBWD[seq]
+        print(json.dumps({
+            "metric": f"flash-attn fwd+bwd TFLOPs/s/chip @ seq={seq} causal bf16",
+            "value": round(tflops, 2),
+            "unit": "TFLOPs/s",
+            "vs_baseline": round(tflops / baseline, 4),
+        }))
+    else:
+        # CPU fallback: correctness-scale run so the driver always gets a line
+        from burst_attn_tpu.ops.tile import single_device_attention
+
+        seq = 2048
+        dtype = jnp.float32
+        key = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(s, (b, 8, seq, 64), dtype)
+                   for s in jax.random.split(key, 3))
+        t = _time(
+            lambda q, k, v: jnp.sum(single_device_attention(q, k, v, causal=True)),
+            q, k, v,
+        )
+        tflops = flops_fwd(b, seq, 8, 64, True) / t / 1e12
+        print(json.dumps({
+            "metric": f"cpu-fallback fwd TFLOPs/s @ seq={seq}",
+            "value": round(tflops, 3),
+            "unit": "TFLOPs/s",
+            "vs_baseline": 0.0,
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,184 @@
+"""Benchmark harness — the reference's benchmarks/benchmark.py rebuilt.
+
+Same FLOPs convention (reference benchmark.py:17-24): fwd FLOPs =
+4*b*s^2*n*d / (2 if causal), bwd = 2.5x, fwd+bwd = 3.5x; TFLOPs/s divided by
+ring width for distributed methods -> per-chip numbers comparable with the
+reference README tables (SURVEY.md §6).  Results append to a jsonl file
+(reference utils.py:73-86).
+
+Methods (reference benchmark.py:146-153, get_burst_func :242):
+  flash         — single-chip Pallas flash attention over the full sequence
+  burst         — burst_attn, zigzag layout
+  burst_striped — burst_attn, striped layout
+  ring          — score-materializing ring baseline (benchmarks/ring_baseline)
+
+Usage:  python -m benchmarks.benchmark [--methods burst,flash] [--seqs 4096]
+        [--mesh 8 | --mesh 2x4] [--causal] [--double-ring] [--out results.jsonl]
+"""
+
+import argparse
+import json
+import time
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def flops(b, s, n, d, mode="fwd", causal=False):
+    f = 4 * b * s * s * n * d / (2 if causal else 1)
+    return {"fwd": f, "bwd": 2.5 * f, "fwd_bwd": 3.5 * f}[mode]
+
+
+def efficiency(flop, t):
+    return flop / t / 1e12
+
+
+def bench_fn(fn, *args, warmup=3, iters=10):
+    """fn must return a SCALAR; a host float() fetch is the only reliable
+    synchronization on every platform (block_until_ready does not block on
+    the axon-relay TPU tunnel)."""
+    for _ in range(warmup):
+        float(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.min(ts))
+
+
+def _scalar_grads(grads):
+    return sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
+
+
+def make_mesh(spec: str):
+    devs = jax.devices()
+    if "x" in spec:
+        inter, intra = (int(x) for x in spec.split("x"))
+        if inter * intra > len(devs):
+            raise SystemExit(f"mesh {spec} needs {inter*intra} devices, have {len(devs)}")
+        mesh = Mesh(np.array(devs[: inter * intra]).reshape(inter, intra), ("inter", "intra"))
+        return mesh, ("inter", "intra")
+    w = int(spec)
+    return Mesh(np.array(devs[:w]), ("sp",)), ("sp",)
+
+
+def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend):
+    from burst_attn_tpu import burst_attn
+    from burst_attn_tpu.parallel import layouts
+
+    w = int(np.prod([mesh.shape[a] for a in seq_axes]))
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+
+    if method == "flash":
+        # full sequence on ONE chip (reference benchmark.py:146-153)
+        from burst_attn_tpu.ops.pallas_flash import flash_attention
+
+        q = jax.random.normal(kq, (b, n, s, d), dtype)
+        k = jax.random.normal(kk, (b, n_kv, s, d), dtype)
+        v = jax.random.normal(kv, (b, n_kv, s, d), dtype)
+        do = jax.random.normal(kg, (b, n, s, d), dtype)
+        fwd = jax.jit(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, None, causal, 1024, 1024).astype(jnp.float32)))
+
+        @jax.jit
+        def fb(q, k, v):
+            def loss(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, None, causal, 1024, 1024).astype(jnp.float32)
+                    * do.astype(jnp.float32))
+            return _scalar_grads(jax.grad(loss, argnums=(0, 1, 2))(q, k, v))
+
+        return bench_fn(fwd, q, k, v), bench_fn(fb, q, k, v), 1
+
+    layout = {"burst": "zigzag", "burst_striped": "striped", "ring": "contig"}[method]
+    seq_spec = seq_axes if len(seq_axes) > 1 else seq_axes[0]
+    shard = NamedSharding(mesh, P(None, None, seq_spec, None))
+    q = jax.device_put(jax.random.normal(kq, (b, n, s, d), dtype), shard)
+    k = jax.device_put(jax.random.normal(kk, (b, n_kv, s, d), dtype), shard)
+    v = jax.device_put(jax.random.normal(kv, (b, n_kv, s, d), dtype), shard)
+    do = jax.device_put(jax.random.normal(kg, (b, n, s, d), dtype), shard)
+
+    if method == "ring":
+        from benchmarks.ring_baseline import ring_attention
+
+        if len(seq_axes) != 1:
+            raise SystemExit("ring baseline supports a single 'sp' axis only")
+        fwd = jax.jit(
+            lambda q, k, v: jnp.sum(
+                ring_attention(q, k, v, mesh=mesh, causal=causal).astype(jnp.float32)))
+
+        @jax.jit
+        def fb(q, k, v):
+            def loss(q, k, v):
+                o = ring_attention(q, k, v, mesh=mesh, causal=causal)
+                return jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32))
+            return _scalar_grads(jax.grad(loss, argnums=(0, 1, 2))(q, k, v))
+
+        return bench_fn(fwd, q, k, v), bench_fn(fb, q, k, v), w
+
+    attn = partial(
+        burst_attn, mesh=mesh, seq_axes=seq_axes, causal=causal, layout=layout,
+        backend=backend,
+    )
+    fwd = jax.jit(lambda q, k, v: jnp.sum(attn(q, k, v).astype(jnp.float32)))
+
+    @jax.jit
+    def fb(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32) * do.astype(jnp.float32))
+        return _scalar_grads(jax.grad(loss, argnums=(0, 1, 2))(q, k, v))
+
+    return bench_fn(fwd, q, k, v), bench_fn(fb, q, k, v), w
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--methods", default="burst,flash")
+    ap.add_argument("--seqs", default="4096")
+    ap.add_argument("--mesh", default=str(len(jax.devices())))
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--heads", type=int, default=32)
+    ap.add_argument("--kv-heads", type=int, default=None)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--causal", action="store_true")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--backend", default="auto")
+    ap.add_argument("--out", default="results.jsonl")
+    args = ap.parse_args()
+
+    mesh, seq_axes = make_mesh(args.mesh)
+    dtype = jnp.dtype(args.dtype)
+    n_kv = args.kv_heads or args.heads
+    results = []
+    for s in (int(x) for x in args.seqs.split(",")):
+        for method in args.methods.split(","):
+            t_f, t_fb, w = run_method(
+                method, mesh, seq_axes, args.batch, s, args.heads, args.dim,
+                n_kv, args.causal, dtype, args.backend,
+            )
+            rec = {
+                "method": method, "seq": s, "batch": args.batch,
+                "heads": args.heads, "kv_heads": n_kv, "dim": args.dim,
+                "causal": args.causal, "dtype": str(dtype), "world": w,
+                "fwd_ms": round(t_f * 1e3, 3),
+                "fwd_bwd_ms": round(t_fb * 1e3, 3),
+                "fwd_tflops_per_chip": round(
+                    efficiency(flops(args.batch, s, args.heads, args.dim, "fwd", args.causal), t_f) / w, 2),
+                "fwd_bwd_tflops_per_chip": round(
+                    efficiency(flops(args.batch, s, args.heads, args.dim, "fwd_bwd", args.causal), t_fb) / w, 2),
+            }
+            print(json.dumps(rec))
+            results.append(rec)
+    with open(args.out, "a") as f:
+        for rec in results:
+            f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+from .transformer import ModelConfig, init_params, forward, param_specs
+from .train import TrainConfig, make_mesh, init_train_state, train_step, loss_fn
+
+__all__ = [
+    "ModelConfig",
+    "init_params",
+    "forward",
+    "param_specs",
+    "TrainConfig",
+    "make_mesh",
+    "init_train_state",
+    "train_step",
+    "loss_fn",
+]

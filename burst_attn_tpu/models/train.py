@@ -1,0 +1,156 @@
+"""Training loop machinery for the flagship LM: mesh building, sharded state
+init, and a jitted train step over a (dp, sp[, inter], tp) mesh.
+
+This is the end-to-end integration layer the reference delegates to host
+frameworks (BMTrain; reference README.md:36-38) — here it is in-framework and
+TPU-native: one `jax.jit` whose input/output shardings come from the model's
+PartitionSpec tree; XLA inserts the DP grad psums and megatron TP collectives,
+while burst_attn's shard_map runs the sequence ring over `sp` (and the
+hierarchical double ring when an `inter` axis is present).
+
+Loss convention: next-token cross entropy.  `tokens` and `labels` arrive
+already layout-permuted (parallel/layouts.to_layout on axis=1) with `labels`
+shifted BEFORE the permutation — shifting after would cross shard boundaries.
+`positions` carries true global positions for rotary (layouts.position_ids).
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .transformer import ModelConfig, forward, init_params, param_specs
+from ..parallel import layouts
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    lr: float = 3e-4
+    weight_decay: float = 0.01
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+
+
+def make_mesh(axis_sizes: dict, devices=None) -> Mesh:
+    """Build a Mesh from {"dp": 2, "sp": 2, "tp": 2}-style sizes (order is
+    significant: last axis is innermost = most ICI-local)."""
+    names = tuple(axis_sizes)
+    sizes = tuple(axis_sizes[n] for n in names)
+    devices = devices if devices is not None else jax.devices()
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh {axis_sizes} needs {n} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]).reshape(sizes), names)
+
+
+def _optimizer(tcfg: TrainConfig):
+    return optax.chain(
+        optax.clip_by_global_norm(tcfg.grad_clip),
+        optax.adamw(tcfg.lr, b1=tcfg.b1, b2=tcfg.b2, weight_decay=tcfg.weight_decay),
+    )
+
+
+def _state_specs(cfg: ModelConfig, tcfg: TrainConfig, params):
+    """PartitionSpec pytree for (params, opt_state): optimizer moments shard
+    like their parameters."""
+    pspecs = param_specs(cfg)
+    opt = _optimizer(tcfg)
+    opt_shape = jax.eval_shape(opt.init, params)
+
+    # Map each optimizer-state leaf to its parameter's spec when shapes line
+    # up with a parameter (adam moments), else replicate (scalars/counts).
+    p_leaves = jax.tree.leaves(params)
+    s_leaves = jax.tree.leaves(pspecs, is_leaf=lambda x: isinstance(x, P))
+    shape_to_spec = {}
+    for pl, sl in zip(p_leaves, s_leaves):
+        shape_to_spec.setdefault(pl.shape, sl)
+
+    def spec_of(leaf):
+        return shape_to_spec.get(leaf.shape, P())
+
+    opt_specs = jax.tree.map(spec_of, opt_shape)
+    return pspecs, opt_specs
+
+
+def init_train_state(key, cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
+    """Initialize (params, opt_state) sharded over `mesh` per param_specs."""
+    opt = _optimizer(tcfg)
+    pspecs = param_specs(cfg)
+
+    def init_fn(key):
+        params = init_params(key, cfg)
+        return params, opt.init(params)
+
+    params_shape, opt_shape = jax.eval_shape(init_fn, key)
+    params_dummy = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), params_shape)
+    _, opt_specs = _state_specs(cfg, tcfg, params_dummy)
+    out_shardings = (
+        jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
+                     is_leaf=lambda x: isinstance(x, P)),
+        jax.tree.map(lambda s: NamedSharding(mesh, s), opt_specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    )
+    return jax.jit(init_fn, out_shardings=out_shardings)(key)
+
+
+def loss_fn(params, tokens, positions, labels, cfg: ModelConfig, mesh):
+    """Mean next-token cross entropy (fp32).  labels < 0 are masked out."""
+    logits = forward(params, tokens, positions, cfg, mesh)
+    valid = labels >= 0
+    labels_safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels_safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
+    """Returns jitted step((params, opt_state), batch) -> (state, metrics).
+
+    batch = dict(tokens, positions, labels), each [B, S] in layout order,
+    sharded (dp, sp).
+    """
+    opt = _optimizer(tcfg)
+
+    def step(state, batch):
+        params, opt_state = state
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, batch["tokens"], batch["positions"], batch["labels"], cfg, mesh
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        return (params, opt_state), {"loss": loss, "grad_norm": gnorm}
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def train_step(state, batch, cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
+    """Convenience one-shot (compiles per call; prefer make_train_step)."""
+    return make_train_step(cfg, tcfg, mesh)(state, batch)
+
+
+def make_batch(key, cfg: ModelConfig, mesh: Mesh, batch: int, seq: int):
+    """Synthetic LM batch in layout order, placed with (dp, sp) sharding."""
+    world = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))
+    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab, dtype=jnp.int32)
+    labels = jnp.concatenate(
+        [tokens[:, 1:], jnp.full((batch, 1), -1, jnp.int32)], axis=1
+    )
+    pos = jnp.asarray(layouts.seq_permutation(cfg.layout, seq, world), jnp.int32)
+    positions = jnp.broadcast_to(pos[None, :], (batch, seq))
+    tokens_l = layouts.to_layout(tokens, cfg.layout, world, axis=1)
+    labels_l = layouts.to_layout(labels, cfg.layout, world, axis=1)
+    seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
+    sharding = NamedSharding(mesh, P(cfg.batch_axis, seq_spec))
+    return {
+        "tokens": jax.device_put(tokens_l, sharding),
+        "positions": jax.device_put(positions, sharding),
+        "labels": jax.device_put(labels_l, sharding),
+    }

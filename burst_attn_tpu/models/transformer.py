@@ -1,0 +1,201 @@
+"""Flagship model: a decoder-only transformer LM on burst (ring) attention.
+
+The reference is an op library whose integration story is "plug
+burst_attn_func into your training framework" (reference README.md:36-38,
+CPM-Live/BMTrain integration).  Here the model layer is first-class and
+TPU-native: pure-functional pytree parameters with an explicit
+PartitionSpec tree, so one `jit` with sharding constraints expresses
+DP x TP x SP (sequence ring) over a named mesh — XLA inserts the
+collectives (megatron-style TP from the param specs; the sequence ring
+from burst_attn's shard_map).
+
+Layout contract: `tokens` / `positions` fed to `forward` are in LAYOUT
+order (parallel/layouts.to_layout) when causal load balancing is on;
+`positions` carries the true global position of each token so rotary
+embeddings are exact under any permutation (parallel/layouts.position_ids).
+
+Design choices (TPU-first):
+  * bf16 activations/params, fp32 rotary and norm accumulation, fp32 logits
+    for a stable softmax cross-entropy.
+  * RMSNorm + SwiGLU + rotary: the modern decoder block; all matmuls are
+    [.., D] x [D, ..] einsums that XLA tiles onto the MXU.
+  * GQA: n_kv_heads <= n_heads, both divisible by the tp axis size.
+"""
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.burst import burst_attn
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 32768
+    d_model: int = 1024
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_head: int = 128
+    d_ff: int = 2816  # ~8/3 * d_model rounded to 256
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    # attention / parallelism
+    causal: bool = True
+    layout: str = "zigzag"
+    attn_backend: str = "auto"
+    seq_axes: Tuple[str, ...] = ("sp",)
+    batch_axis: Optional[str] = "dp"
+    head_axis: Optional[str] = "tp"
+    block_q: int = 256
+    block_kv: int = 256
+    remat: bool = True  # jax.checkpoint each block: FLOPs for HBM
+
+
+Params = Dict[str, Any]
+
+
+def _split(key, n):
+    return list(jax.random.split(key, n))
+
+
+def init_params(key, cfg: ModelConfig) -> Params:
+    """Initialize the parameter pytree (all leaves cfg.dtype except norms)."""
+    d, nh, nkv, hd, f = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.d_head, cfg.d_ff
+    init = jax.nn.initializers.normal(stddev=0.02)
+
+    def dense(k, shape):
+        return init(k, shape, cfg.dtype)
+
+    keys = _split(key, cfg.n_layers + 2)
+    layers = []
+    for lk in keys[: cfg.n_layers]:
+        ks = _split(lk, 6)
+        layers.append(
+            {
+                "attn_norm": jnp.ones((d,), jnp.float32),
+                "wq": dense(ks[0], (d, nh, hd)),
+                "wk": dense(ks[1], (d, nkv, hd)),
+                "wv": dense(ks[2], (d, nkv, hd)),
+                "wo": dense(ks[3], (nh, hd, d)),
+                "mlp_norm": jnp.ones((d,), jnp.float32),
+                "w_gate": dense(ks[4], (d, f)),
+                "w_up": dense(ks[5], (d, f)),
+                "w_down": dense(_split(ks[5], 2)[1], (f, d)),
+            }
+        )
+    return {
+        "embed": init(keys[-2], (cfg.vocab, d), cfg.dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": init(keys[-1], (cfg.vocab, d), cfg.dtype),
+    }
+
+
+def param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpec tree matching init_params: megatron TP over `head_axis`.
+
+    qkv projections are column-parallel (heads sharded), the output
+    projection row-parallel, the MLP gate/up column- and down row-parallel;
+    embeddings/lm_head shard the vocab dim.  Norm scales are replicated.
+    """
+    tp = cfg.head_axis
+    layer = {
+        "attn_norm": P(None),
+        "wq": P(None, tp, None),
+        "wk": P(None, tp, None),
+        "wv": P(None, tp, None),
+        "wo": P(tp, None, None),
+        "mlp_norm": P(None),
+        "w_gate": P(None, tp),
+        "w_up": P(None, tp),
+        "w_down": P(tp, None),
+    }
+    return {
+        "embed": P(tp, None),
+        "layers": [layer] * cfg.n_layers,
+        "final_norm": P(None),
+        "lm_head": P(tp, None),
+    }
+
+
+def _rms_norm(x, scale, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding. x [B, N, S, H], positions [B, S] (global token ids)."""
+    h = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, h, 2, dtype=jnp.float32) / h))
+    angles = positions[:, None, :, None].astype(jnp.float32) * freqs  # [B,1,S,H/2]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(p, x, positions, cfg: ModelConfig, mesh):
+    h = _rms_norm(x, p["attn_norm"])
+    q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
+    k = jnp.einsum("bsd,dnh->bnsh", h, p["wk"])
+    v = jnp.einsum("bsd,dnh->bnsh", h, p["wv"])
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    o = burst_attn(
+        q,
+        k,
+        v,
+        mesh=mesh,
+        seq_axes=cfg.seq_axes,
+        causal=cfg.causal,
+        layout=cfg.layout,
+        backend=cfg.attn_backend,
+        block_q=cfg.block_q,
+        block_kv=cfg.block_kv,
+        batch_axes=cfg.batch_axis,
+        head_axes=cfg.head_axis,
+    )
+    return jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+
+
+def _mlp(p, x):
+    h = _rms_norm(x, p["mlp_norm"])
+    gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", h, p["w_up"])
+    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
+
+
+def forward(params: Params, tokens, positions, cfg: ModelConfig, mesh) -> jax.Array:
+    """tokens, positions: [B, S] int32 (layout order). Returns fp32 logits
+    [B, S, vocab]."""
+    from jax.sharding import NamedSharding
+
+    seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
+    act_spec = NamedSharding(mesh, P(cfg.batch_axis, seq_spec, None))
+    logit_spec = NamedSharding(mesh, P(cfg.batch_axis, seq_spec, cfg.head_axis))
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = jax.lax.with_sharding_constraint(x, act_spec)
+
+    def block(x, p):
+        x = x + _attention(p, x, positions, cfg, mesh)
+        x = x + _mlp(p, x)
+        return jax.lax.with_sharding_constraint(x, act_spec)
+
+    for p in params["layers"]:
+        if cfg.remat:
+            x = jax.checkpoint(block)(x, p)
+        else:
+            x = block(x, p)
+
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x, params["lm_head"], preferred_element_type=jnp.float32
+    )
+    return jax.lax.with_sharding_constraint(logits, logit_spec)

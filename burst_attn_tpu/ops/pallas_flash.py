@@ -132,6 +132,28 @@ def _pack(x, lp):
     return x.reshape(b, n, s // lp, lp)
 
 
+def _gqa_group(n: int, n_kv: int) -> int:
+    assert n % n_kv == 0, f"GQA needs Nq % Nk == 0, got {n} % {n_kv}"
+    return n // n_kv
+
+
+def _make_index_maps(bq, bkv, nqb, nkb, group):
+    """Shared fwd/bwd(dq) index maps over the (batch, head, q-block, kv-block)
+    grid; kv fetches are clamped to the last useful block so fully-masked
+    blocks are never DMA'd."""
+
+    def q_map(b_, h, i, j, sp):
+        return (b_, h, i, 0)
+
+    def kv_map(b_, h, i, j, sp):
+        return (b_, h // group, jnp.minimum(j, _kv_jmax(sp, i, bq, bkv, nkb)), 0)
+
+    def state_map(b_, h, i, j, sp):
+        return (b_, h, 0, 0)
+
+    return q_map, kv_map, state_map
+
+
 def _unpack(x):
     b, n, r, lp = x.shape
     return x.reshape(b, n, r * lp)
@@ -212,21 +234,13 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
         interpret = _interpret_default()
     b, n, s_q, d = q.shape
     n_kv, s_kv = k.shape[1], k.shape[2]
-    group = n // n_kv
+    group = _gqa_group(n, n_kv)
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
     lp = _pick_block(bq, 128)
     nqb = s_q // bq
     nkb = s_kv // bkv
-
-    def q_map(b_, h, i, j, sp):
-        return (b_, h, i, 0)
-
-    def kv_map(b_, h, i, j, sp):
-        return (b_, h // group, jnp.minimum(j, _kv_jmax(sp, i, bq, bkv, nkb)), 0)
-
-    def state_map(b_, h, i, j, sp):
-        return (b_, h, 0, 0)
+    q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group)
 
     grid = (b, n, nqb, nkb)
     kernel = functools.partial(
@@ -406,7 +420,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
         interpret = _interpret_default()
     b, n, s_q, d = q.shape
     n_kv, s_kv = k.shape[1], k.shape[2]
-    group = n // n_kv
+    group = _gqa_group(n, n_kv)
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
     lp = _pick_block(bq, 128)
@@ -414,15 +428,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     nkb = s_kv // bkv
 
     # ---- dq ----
-    def q_map(b_, h, i, j, sp):
-        return (b_, h, i, 0)
-
-    def kv_map(b_, h, i, j, sp):
-        return (b_, h // group, jnp.minimum(j, _kv_jmax(sp, i, bq, bkv, nkb)), 0)
-
-    def state_map(b_, h, i, j, sp):
-        return (b_, h, 0, 0)
-
+    q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group)
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     dq = pl.pallas_call(
         functools.partial(

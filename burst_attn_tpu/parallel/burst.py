@@ -303,12 +303,17 @@ def burst_attn(
     optimize_bwd_comm: bool = True,
     block_q: int = 256,
     block_kv: int = 256,
+    batch_axes=None,
+    head_axes=None,
 ) -> jax.Array:
     """Burst attention on global arrays [B, N, S, D]; S must already be in
     layout order (parallel/layouts.to_layout) for causal runs.
 
     seq_axes: mesh axis name(s) the sequence is sharded over — ("sp",) for a
     single ring or ("inter", "intra") for the hierarchical double ring.
+    batch_axes / head_axes: mesh axis name(s) batch / heads are sharded over
+    (data / tensor parallelism riding alongside the sequence ring — the
+    reference's process_group mechanism, burst_attn_interface.py:144-145).
     """
     if isinstance(seq_axes, str):
         seq_axes = (seq_axes,)
@@ -329,7 +334,8 @@ def burst_attn(
         block_q=block_q,
         block_kv=block_kv,
     )
-    spec = P(None, None, seq_axes if len(seq_axes) > 1 else intra_axis, None)
+    seq_spec = seq_axes if len(seq_axes) > 1 else intra_axis
+    spec = P(batch_axes, head_axes, seq_spec, None)
     fn = jax.shard_map(
         partial(burst_attn_shard, cfg=cfg),
         mesh=mesh,

@@ -41,6 +41,30 @@ def my_partition(intra_axis: str, inter_axis) -> jnp.ndarray:
     return inter_rank * intra_size + intra_rank
 
 
+def ring_schedule(intra_size: int, inter_size: int = 1):
+    """Host-side expected schedule: [world, rounds] array where entry
+    (device, r) is the partition id device holds at ring round r.
+
+    The debug/verification analogue of the reference's per-rank `record` list
+    logged each run (burst_attn_interface.py:213-217,249,290-293,392): the
+    distributed schedule (partition_at_round inside shard_map) must replay
+    these rows exactly — asserted in tests/test_schedule.py.
+    """
+    import numpy as np
+
+    world = inter_size * intra_size
+    rounds = world
+    out = np.empty((world, rounds), dtype=np.int64)
+    for dev in range(world):
+        inter_rank, intra_rank = divmod(dev, intra_size)
+        for r in range(rounds):
+            c, s = divmod(r, intra_size)
+            out[dev, r] = ((inter_rank - c) % inter_size) * intra_size + (
+                (intra_rank - s) % intra_size
+            )
+    return out
+
+
 def partition_at_round(r, intra_axis: str, inter_axis):
     """Global partition id of the KV (fwd) / query-side (bwd) payload held at
     0-indexed ring round r under the (double-)ring schedule.

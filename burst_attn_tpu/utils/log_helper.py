@@ -1,0 +1,43 @@
+"""Logging utilities — parity with the reference's log_helper
+(burst_attn/log_helper.py:2-16) plus rank-aware helpers replacing its
+print_rank / log_rank0 (reference comm.py:324-333, :31)."""
+
+import logging
+import sys
+from typing import Optional
+
+import jax
+
+_FMT = "%(asctime)s %(name)s %(levelname)s: %(message)s"
+
+
+def get_logger(name: str, level=logging.INFO, file: Optional[str] = None):
+    """Per-name logger with stream (and optional file) handlers, configured
+    once."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        logger.setLevel(level)
+        sh = logging.StreamHandler(sys.stderr)
+        sh.setFormatter(logging.Formatter(_FMT))
+        logger.addHandler(sh)
+        if file:
+            fh = logging.FileHandler(file)
+            fh.setFormatter(logging.Formatter(_FMT))
+            logger.addHandler(fh)
+        logger.propagate = False
+    return logger
+
+
+def is_primary() -> bool:
+    """True on the host that should emit logs (process 0)."""
+    return jax.process_index() == 0
+
+
+def print_rank0(*args, **kwargs):
+    if is_primary():
+        print(*args, **kwargs)
+
+
+def log_rank0(logger, msg, level=logging.INFO):
+    if is_primary():
+        logger.log(level, msg)

@@ -1,0 +1,21 @@
+"""Score-materializing ring baseline vs the dense oracle (the fixed port of
+the reference's broken RingQK/RingAV, SURVEY.md §2.2)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+import pytest
+
+from benchmarks.ring_baseline import ring_attention
+from burst_attn_tpu.ops.reference import dense_attention
+from burst_attn_tpu.utils.testing import check_close, random_qkv
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_baseline(causal):
+    mesh = Mesh(np.array(jax.devices()[:8]), ("sp",))
+    q, k, v, _ = random_qkv(jax.random.PRNGKey(3), 1, 4, 128, 16, dtype=jnp.float32)
+    o = ring_attention(q, k, v, mesh=mesh, causal=causal)
+    o_ref = dense_attention(q, k, v, causal=causal)
+    check_close(o, o_ref, rtol=2e-4, atol=2e-4, msg=f"ring baseline causal={causal}")
