@@ -19,17 +19,22 @@ import jax.numpy as jnp
 BASELINE_FWDBWD = {65536: 170.0, 131072: 184.0, 262144: 191.0, 524288: 195.0, 1048576: 196.0}
 
 
-def _time(fn, *args, warmup=2, iters=5):
-    """fn must return a SCALAR; timing forces a host fetch because
-    block_until_ready alone does not synchronize on every platform (the
-    axon-relay TPU tunnel dispatches asynchronously)."""
+def _time(fn, *args, warmup=2, iters=8, reps=3):
+    """fn must return a SCALAR.  All `iters` dispatches are queued
+    asynchronously and synchronized by ONE host fetch of their sum: a per-iter
+    fetch would add the host<->device round trip (tens of ms through the
+    axon-relay TPU tunnel) to every iteration."""
     for _ in range(warmup):
         float(fn(*args))
     times = []
-    for _ in range(iters):
+    for _ in range(reps):
         t0 = time.perf_counter()
-        float(fn(*args))
-        times.append(time.perf_counter() - t0)
+        acc = None
+        for _ in range(iters):
+            r = fn(*args)
+            acc = r if acc is None else acc + r
+        float(acc)
+        times.append((time.perf_counter() - t0) / iters)
     return min(times)
 
 
@@ -58,7 +63,7 @@ def main():
         def fwdbwd(q, k, v, do):
             def loss(q, k, v):
                 return jnp.sum(
-                    flash_attention(q, k, v, None, causal, 1024, 1024).astype(jnp.float32)
+                    flash_attention(q, k, v, None, causal).astype(jnp.float32)
                     * do.astype(jnp.float32)
                 )
 

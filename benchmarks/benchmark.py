@@ -36,17 +36,22 @@ def efficiency(flop, t):
     return flop / t / 1e12
 
 
-def bench_fn(fn, *args, warmup=3, iters=10):
-    """fn must return a SCALAR; a host float() fetch is the only reliable
-    synchronization on every platform (block_until_ready does not block on
-    the axon-relay TPU tunnel)."""
+def bench_fn(fn, *args, warmup=3, iters=10, reps=3):
+    """fn must return a SCALAR.  All `iters` dispatches are queued
+    asynchronously and synchronized by ONE host fetch of their sum — a
+    per-iteration fetch would add the host<->device round trip (tens of ms
+    through the axon-relay TPU tunnel) to every measurement."""
     for _ in range(warmup):
         float(fn(*args))
     ts = []
-    for _ in range(iters):
+    for _ in range(reps):
         t0 = time.perf_counter()
-        float(fn(*args))
-        ts.append(time.perf_counter() - t0)
+        acc = None
+        for _ in range(iters):
+            r = fn(*args)
+            acc = r if acc is None else acc + r
+        float(acc)
+        ts.append((time.perf_counter() - t0) / iters)
     return float(np.min(ts))
 
 
@@ -84,13 +89,13 @@ def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend)
         do = jax.random.normal(kg, (b, n, s, d), dtype)
         fwd = jax.jit(
             lambda q, k, v: jnp.sum(
-                flash_attention(q, k, v, None, causal, 1024, 1024).astype(jnp.float32)))
+                flash_attention(q, k, v, None, causal).astype(jnp.float32)))
 
         @jax.jit
         def fb(q, k, v):
             def loss(q, k, v):
                 return jnp.sum(
-                    flash_attention(q, k, v, None, causal, 1024, 1024).astype(jnp.float32)
+                    flash_attention(q, k, v, None, causal).astype(jnp.float32)
                     * do.astype(jnp.float32))
             return _scalar_grads(jax.grad(loss, argnums=(0, 1, 2))(q, k, v))
 

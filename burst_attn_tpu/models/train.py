@@ -56,25 +56,36 @@ def _optimizer(tcfg: TrainConfig):
     )
 
 
-def _state_specs(cfg: ModelConfig, tcfg: TrainConfig, params):
+def _state_specs(cfg: ModelConfig, tcfg: TrainConfig, params_shape):
     """PartitionSpec pytree for (params, opt_state): optimizer moments shard
-    like their parameters."""
+    like their parameters.
+
+    Matching is by TREE PATH, not array shape: optax state leaves embed the
+    parameter tree, so an optimizer leaf whose path ends with a parameter's
+    path (e.g. `.0.mu.layers[0].wq` vs `.layers[0].wq`) is that parameter's
+    moment.  Shape-keyed matching would silently transpose specs whenever two
+    differently-sharded parameters share a shape (w_gate/w_down at
+    d_ff == d_model).  `params_shape` may be abstract (ShapeDtypeStructs).
+    """
     pspecs = param_specs(cfg)
     opt = _optimizer(tcfg)
-    opt_shape = jax.eval_shape(opt.init, params)
+    opt_shape = jax.eval_shape(opt.init, params_shape)
 
-    # Map each optimizer-state leaf to its parameter's spec when shapes line
-    # up with a parameter (adam moments), else replicate (scalars/counts).
-    p_leaves = jax.tree.leaves(params)
-    s_leaves = jax.tree.leaves(pspecs, is_leaf=lambda x: isinstance(x, P))
-    shape_to_spec = {}
-    for pl, sl in zip(p_leaves, s_leaves):
-        shape_to_spec.setdefault(pl.shape, sl)
+    path_to_spec = {
+        jax.tree_util.keystr(kp): spec
+        for kp, spec in jax.tree_util.tree_flatten_with_path(
+            pspecs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+    }
 
-    def spec_of(leaf):
-        return shape_to_spec.get(leaf.shape, P())
+    def spec_of(kp, leaf):
+        s = jax.tree_util.keystr(kp)
+        for p, spec in path_to_spec.items():
+            if s.endswith(p):
+                return spec
+        return P()  # scalars / step counts
 
-    opt_specs = jax.tree.map(spec_of, opt_shape)
+    opt_specs = jax.tree_util.tree_map_with_path(spec_of, opt_shape)
     return pspecs, opt_specs
 
 
@@ -88,8 +99,7 @@ def init_train_state(key, cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
         return params, opt.init(params)
 
     params_shape, opt_shape = jax.eval_shape(init_fn, key)
-    params_dummy = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), params_shape)
-    _, opt_specs = _state_specs(cfg, tcfg, params_dummy)
+    _, opt_specs = _state_specs(cfg, tcfg, params_shape)
     out_shardings = (
         jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
                      is_leaf=lambda x: isinstance(x, P)),

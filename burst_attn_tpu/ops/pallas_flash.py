@@ -46,6 +46,19 @@ from jax.experimental.pallas import tpu as pltpu
 from .masks import MaskSpec
 
 NEG_INF = float("-inf")
+# stand-in for -inf lse rows in the backward kernels: exp(s - BIG_LSE)
+# underflows to exactly 0 for any finite score s
+BIG_LSE = 1e30
+# The kernels run the online softmax in base 2: log2(e) is folded into the
+# q-block scaling so every transcendental is a bare exp2 (exp(x) lowers to
+# exp2(x*log2e) + a mul on the VPU; the kernels are VPU-bound so the dropped
+# [bq, bkv] multiplies are measurable).  All kernel INTERFACES stay in the
+# natural-log domain (m, lse), converted on the [bq, 1] columns at init/finish.
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+# Mosaic's default scoped-VMEM budget is 16 MiB; v5e has far more physical
+# VMEM and the larger budget admits 2048-wide kv blocks
+VMEM_LIMIT = 100 * 1024 * 1024
 
 
 def _interpret_default():
@@ -88,6 +101,17 @@ def _block_has_work(spec_ref, r0, c0, bq, bkv):
     causal, offset = spec_ref[3], spec_ref[4]
     ok = (r0 < q_hi) & (r0 + bq > q_lo) & (c0 < kv_hi)
     return ok & ((causal == 0) | (c0 <= r0 + bq - 1 + offset))
+
+
+def _block_full(spec_ref, r0, c0, bq, bkv):
+    """True iff every (row, col) of the tile is visible — the fast path can
+    skip mask construction and the elementwise selects entirely.  On a causal
+    64-block grid ~97% of live blocks are interior, and the kernels are
+    VPU-bound, so this matters more than any matmul tuning."""
+    q_lo, q_hi, kv_hi = spec_ref[0], spec_ref[1], spec_ref[2]
+    causal, offset = spec_ref[3], spec_ref[4]
+    ok = (r0 >= q_lo) & (r0 + bq <= q_hi) & (c0 + bkv <= kv_hi)
+    return ok & ((causal == 0) | (c0 + bkv - 1 <= r0 + offset))
 
 
 def _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks):
@@ -179,42 +203,56 @@ def _fwd_kernel(
     def _init():
         m0 = _read_rows(m_in_ref, i, bq, lp)
         lse0 = _read_rows(lse_in_ref, i, bq, lp)
-        m_scr[:] = m0
+        # scratch m is kept in the base-2 scaled domain (see LOG2E note)
+        m_scr[:] = m0 * LOG2E
         # linear-scale running sum relative to m: l = exp(lse - m); 0 if empty
         l_scr[:] = jnp.where(m0 == NEG_INF, 0.0, jnp.exp(lse0 - m0))
         acc_scr[:] = acc_in_ref[0, 0, :, :]
 
-    @pl.when(
-        _block_has_work(spec_ref, r0, c0, bq, bkv)
-        & (j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks))
+    live = _block_has_work(spec_ref, r0, c0, bq, bkv) & (
+        j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks)
     )
-    def _compute():
-        q = q_ref[0, 0, :, :]
-        k = k_ref[0, 0, :, :]
-        v = v_ref[0, 0, :, :]
-        mask = _block_mask(spec_ref, r0, c0, bq, bkv)
+    full = _block_full(spec_ref, r0, c0, bq, bkv)
 
-        s = jax.lax.dot_general(
+    def _scores():
+        # scale (and the base-2 conversion) folded into the [bq, d] q block
+        # (one small mul) instead of the [bq, bkv] score matrix — the kernel
+        # is VPU-bound, not MXU-bound
+        q = q_ref[0, 0, :, :] * (scale * LOG2E)
+        k = k_ref[0, 0, :, :]
+        return jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        s = jnp.where(mask, s * scale, NEG_INF)
 
+    def _update(s, mask):
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.where(m_prev >= m_new, 1.0, jnp.exp(m_prev - m_new))
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-
+        alpha = jnp.where(m_prev >= m_new, 1.0, jnp.exp2(m_prev - m_new))
+        p = jnp.exp2(s - m_new)
+        if mask is not None:
+            # guards the all-masked-row nan (s = m_new = -inf)
+            p = jnp.where(mask, p, 0.0)
         m_scr[:] = m_new
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0, :, :]
         pv = jax.lax.dot_general(
             p.astype(v.dtype) if cast_p else p,
             v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
         acc_scr[:] = acc_scr[:] * alpha + pv
 
+    @pl.when(live & full)
+    def _compute_fast():
+        _update(_scores(), None)
+
+    @pl.when(live & ~full)
+    def _compute_masked():
+        mask = _block_mask(spec_ref, r0, c0, bq, bkv)
+        _update(jnp.where(mask, _scores(), NEG_INF), mask)
+
     @pl.when(j == n_kv_blocks - 1)
     def _finish():
-        m = m_scr[:]
+        m = m_scr[:] * LN2  # back to the natural-log domain
         l = l_scr[:]
         _write_rows(m_out_ref, i, m, bq, lp)
         lse = jnp.where(l > 0, m + jnp.log(l), NEG_INF)
@@ -283,6 +321,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
         # shared by every q-block of a head, so a megacore split over dim 2
         # would race the partial writes.
         compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT,
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -309,38 +348,50 @@ def _dq_kernel(
     @pl.when(j == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
-        lse_scr[:] = _read_rows(lse_ref, i, bq, lp)
+        lse = _read_rows(lse_ref, i, bq, lp)
+        # fully-masked rows have lse = -inf; substituting a large positive
+        # value makes p = exp2(s - BIG) underflow to 0 without an elementwise
+        # select over the [bq, bkv] tile.  lse converted to base 2 (LOG2E).
+        lse_scr[:] = jnp.where(lse == NEG_INF, BIG_LSE, lse * LOG2E)
         delta_scr[:] = _read_rows(delta_ref, i, bq, lp)
 
-    @pl.when(
-        _block_has_work(spec_ref, r0, c0, bq, bkv)
-        & (j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks))
+    live = _block_has_work(spec_ref, r0, c0, bq, bkv) & (
+        j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks)
     )
-    def _compute():
-        q = q_ref[0, 0, :, :]
+    full = _block_full(spec_ref, r0, c0, bq, bkv)
+
+    def _accum(mask):
+        q = q_ref[0, 0, :, :] * (scale * LOG2E)
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
-        lse_row = lse_scr[:]
-        delta_row = delta_scr[:]
-        mask = _block_mask(spec_ref, r0, c0, bq, bkv)
-
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        p = jnp.where(mask & (lse_row != NEG_INF), jnp.exp(s - lse_row), 0.0)
+        )
+        p = jnp.exp2(s - lse_scr[:])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_row) * scale
+        # the trailing *scale of ds is deferred to _finish (constant across j)
+        ds = p * (dp - delta_scr[:])
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
+    @pl.when(live & full)
+    def _compute_fast():
+        _accum(None)
+
+    @pl.when(live & ~full)
+    def _compute_masked():
+        _accum(_block_mask(spec_ref, r0, c0, bq, bkv))
+
     @pl.when(j == n_kv_blocks - 1)
     def _finish():
-        dq_ref[0, 0, :, :] = dq_scr[:]
+        dq_ref[0, 0, :, :] = dq_scr[:] * scale
 
 
 # ---------------------------------------------------------------------------
@@ -370,23 +421,27 @@ def _dkdv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(
-        _block_has_work(spec_ref, r0, c0, bq, bkv)
-        & (iq >= _q_imin(spec_ref, j, bq, bkv, n_q_blocks))
+    live = _block_has_work(spec_ref, r0, c0, bq, bkv) & (
+        iq >= _q_imin(spec_ref, j, bq, bkv, n_q_blocks)
     )
-    def _compute():
+    full = _block_full(spec_ref, r0, c0, bq, bkv)
+
+    def _accum(mask):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
         lse_row = _read_rows(lse_ref, iq, bq, lp)
+        lse_row = jnp.where(lse_row == NEG_INF, BIG_LSE, lse_row * LOG2E)
         delta_row = _read_rows(delta_ref, iq, bq, lp)
-        mask = _block_mask(spec_ref, r0, c0, bq, bkv)
 
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        p = jnp.where(mask & (lse_row != NEG_INF), jnp.exp(s - lse_row), 0.0)
+            q * (scale * LOG2E), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        p = jnp.exp2(s - lse_row)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         # dv += p^T @ do
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -395,26 +450,214 @@ def _dkdv_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_row) * scale
+        # trailing *scale of ds deferred to _finish; dk uses the RAW q block
+        ds = p * (dp - delta_row)
         # dk += ds^T @ q
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
+    @pl.when(live & full)
+    def _compute_fast():
+        _accum(None)
+
+    @pl.when(live & ~full)
+    def _compute_masked():
+        _accum(_block_mask(spec_ref, r0, c0, bq, bkv))
+
     @pl.when(t == n_q_blocks * group - 1)
     def _finish():
-        dk_ref[0, 0, :, :] = dk_scr[:]
+        dk_ref[0, 0, :, :] = dk_scr[:] * scale
         dv_ref[0, 0, :, :] = dv_scr[:]
 
 
+# ---------------------------------------------------------------------------
+# backward: fused kernel (dq + dk + dv in one pass)
+#
+# The split dq/dkdv kernels each recompute s and dp — 7 matmuls and 2
+# softmax-exp passes per block pair where 5 and 1 suffice.  The fused kernel
+# keeps dk/dv in VMEM scratch (kv-block-major grid) and accumulates dq
+# IN PLACE in HBM via input_output_aliasing (the megablox gmm pattern):
+# each visit reads the aliased dq block, adds this block's contribution, and
+# writes it back.  Two structural rules make this race-free:
+#   * q-blocks iterate DESCENDING within each kv sweep, so a dq block
+#     written in sweep j is re-read in sweep j+1 exactly one full sweep
+#     (nqb*group grid steps) later — far outside the pipeline's prefetch
+#     lookahead.  Ascending order would re-read the last diagonal block only
+#     one step after its write.
+#   * index-map clamping maps skipped (masked) steps onto the first live
+#     block, so consecutive duplicate indices collapse into one
+#     fetch/flush; duplicate visits rewrite identical content.
+# Gated on n_q_blocks * group >= 4 (below that the split kernels are used;
+# the separation argument needs a reasonably long sweep).
+
+
+def _bwd_fused_kernel(
+    spec_ref,
+    do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref, dq_in_ref,
+    dq_out_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, scale, bq, bkv, lp, n_q_blocks, group,
+):
+    j = pl.program_id(2)
+    t = pl.program_id(3)
+    iq = n_q_blocks - 1 - (t % n_q_blocks)  # descending (see header comment)
+    r0 = iq * bq
+    c0 = j * bkv
+
+    @pl.when(t == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    imin = _q_imin(spec_ref, j, bq, bkv, n_q_blocks)
+    # clamped steps (iq < imin) revisit block imin, whose live visit came just
+    # before them in the descending sweep; they must not touch dq_out or
+    # they'd overwrite that visit's accumulation with the stale dq_in buffer
+    clamped = iq < imin
+    live = _block_has_work(spec_ref, r0, c0, bq, bkv) & ~clamped
+    full = _block_full(spec_ref, r0, c0, bq, bkv)
+
+    def _accum(mask):
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse_row = _read_rows(lse_ref, iq, bq, lp)
+        lse_row = jnp.where(lse_row == NEG_INF, BIG_LSE, lse_row * LOG2E)
+        delta_row = _read_rows(delta_ref, iq, bq, lp)
+
+        s = jax.lax.dot_general(
+            q * (scale * LOG2E), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        p = jnp.exp2(s - lse_row)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_row)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # in-place dq accumulation (ds*scale deferred to the caller's epilog
+        # would lose the per-visit accumulation — apply it here instead)
+        dq_out_ref[0, 0, :, :] = dq_in_ref[0, 0, :, :] + scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(live & full)
+    def _compute_fast():
+        _accum(None)
+
+    @pl.when(live & ~full)
+    def _compute_masked():
+        _accum(_block_mask(spec_ref, r0, c0, bq, bkv))
+
+    @pl.when(~live & ~clamped)
+    def _passthrough():
+        # an unclamped dead block (fully-masked column / row range) gets its
+        # own buffer flush at the next index change; keep its content valid
+        dq_out_ref[0, 0, :, :] = dq_in_ref[0, 0, :, :]
+
+    @pl.when(t == n_q_blocks * group - 1)
+    def _finish():
+        dk_ref[0, 0, :, :] = dk_scr[:] * scale
+        dv_ref[0, 0, :, :] = dv_scr[:]
+
+
+def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
+                     block_q, block_kv, interpret):
+    b, n, s_q, d = q.shape
+    n_kv, s_kv = k.shape[1], k.shape[2]
+    group = _gqa_group(n, n_kv)
+    bq = _pick_block(s_q, block_q)
+    bkv = _pick_block(s_kv, block_kv)
+    lp = _pick_block(bq, 128)
+    nqb = s_q // bq
+    nkb = s_kv // bkv
+
+    def qh_of(h, t):
+        return h * group + t // nqb
+
+    def iq_of(t, j, sp):
+        # descending within the sweep, clamped onto the first live block
+        return jnp.maximum(nqb - 1 - (t % nqb), _q_imin(sp, j, bq, bkv, nqb))
+
+    def bq_map(b_, h, j, t, sp):
+        return (b_, qh_of(h, t), iq_of(t, j, sp), 0)
+
+    def bstate_map(b_, h, j, t, sp):
+        return (b_, qh_of(h, t), 0, 0)
+
+    def bkv_map(b_, h, j, t, sp):
+        return (b_, h, j, 0)
+
+    bstate_block = pl.BlockSpec((1, 1, s_q // lp, lp), bstate_map)
+    dq0 = jnp.zeros((b, n, s_q, d), jnp.float32)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp,
+            n_q_blocks=nqb, group=group,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, n_kv, nkb, nqb * group),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), bq_map),
+                pl.BlockSpec((1, 1, bq, d), bq_map),
+                pl.BlockSpec((1, 1, bkv, d), bkv_map),
+                pl.BlockSpec((1, 1, bkv, d), bkv_map),
+                bstate_block,
+                bstate_block,
+                pl.BlockSpec((1, 1, bq, d), bq_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bq, d), bq_map),
+                pl.BlockSpec((1, 1, bkv, d), bkv_map),
+                pl.BlockSpec((1, 1, bkv, d), bkv_map),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bkv, d), jnp.float32),
+                pltpu.VMEM((bkv, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, s_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, n_kv, s_kv, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, n_kv, s_kv, d), jnp.float32),
+        ],
+        # flattened input index 7 = dq0 (after the scalar-prefetch spec array)
+        input_output_aliases={7: 0},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT,
+            dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(_spec_array(spec), do, q, k, v, _pack(delta, lp), _pack(lse, lp), dq0)
+    return dq, dk, dv
+
+
 def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
-              block_q=1024, block_kv=1024, interpret=None):
+              block_q=1024, block_kv=1024, interpret=None, fused=None):
     """One backward ring round on TPU.  Same contract as ops/tile.py:tile_bwd:
     returns (dq [B,N,S,D], dk [B,Nk,Skv,D], dv [B,Nk,Skv,D]) in float32.
 
     delta = sum(o*do, -1) [B,N,S] f32 (precomputed; reference
     burst_attn_interface.py:269-278); lse is the FINAL log-sum-exp.
+
+    `fused` selects the single-pass dq+dk+dv kernel (default on real TPU when
+    the sweep is long enough for its aliasing-separation argument; see
+    _bwd_fused_kernel).  The split kernels remain for interpret mode and
+    short sweeps.
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -426,6 +669,13 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     lp = _pick_block(bq, 128)
     nqb = s_q // bq
     nkb = s_kv // bkv
+    if fused is None:
+        fused = not interpret and (s_q // bq) * group >= 4
+    if fused:
+        return _flash_bwd_fused(
+            do, q, k, v, delta, lse, scale, spec,
+            block_q=block_q, block_kv=block_kv, interpret=interpret,
+        )
 
     # ---- dq ----
     q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group)
@@ -454,6 +704,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
         ),
         out_shape=jax.ShapeDtypeStruct((b, n, s_q, d), jnp.float32),
         compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -503,6 +754,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
             jax.ShapeDtypeStruct((b, n_kv, s_kv, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -516,9 +768,13 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
 # test/test_burst.py:175-184)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, scale=None, causal=False, block_q=1024, block_kv=1024):
-    """Fused single-device flash attention.  q,k,v [B,N,S,D] -> o [B,N,S,D]."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_attention(q, k, v, scale=None, causal=False, block_q=2048, block_kv=2048,
+                    block_q_bwd=1024, block_kv_bwd=2048):
+    """Fused single-device flash attention.  q,k,v [B,N,S,D] -> o [B,N,S,D].
+
+    Default block sizes are the measured v5e optimum at long seq (fwd likes
+    2048x2048; the fused backward 1024x2048)."""
     o, _ = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv)
     return o
 
@@ -539,12 +795,14 @@ def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv):
     return o, lse
 
 
-def _flash_attention_vjp_fwd(q, k, v, scale, causal, block_q, block_kv):
+def _flash_attention_vjp_fwd(q, k, v, scale, causal, block_q, block_kv,
+                             block_q_bwd, block_kv_bwd):
     o, lse = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv)
     return o, (q, k, v, o, lse)
 
 
-def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, res, do):
+def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
+                             block_kv_bwd, res, do):
     from .masks import round_spec
 
     q, k, v, o, lse = res
@@ -554,7 +812,8 @@ def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, res, do):
     spec = round_spec(jnp.int32(0), jnp.int32(0), q.shape[2], k.shape[2], causal, "contig")
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
     dq, dk, dv = flash_bwd(
-        do, q, k, v, delta, lse, scale, spec, block_q=block_q, block_kv=block_kv
+        do, q, k, v, delta, lse, scale, spec,
+        block_q=block_q_bwd, block_kv=block_kv_bwd,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 

@@ -12,5 +12,11 @@ os.environ["XLA_FLAGS"] = (
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_default_matmul_precision", "highest")
+# BURST_TESTS_TPU=1 runs on real hardware instead (for the TPU-only kernel
+# tests, e.g. tests/test_fused_bwd.py); default stays CPU so the whole suite
+# runs anywhere.
+if not os.environ.get("BURST_TESTS_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+    # deterministic f32 CPU matmuls for the numerics oracle; NOT set on TPU
+    # (it would force multi-pass f32 MXU matmuls and breaks Mosaic bf16 dots)
+    jax.config.update("jax_default_matmul_precision", "highest")
