@@ -10,32 +10,14 @@ sequence length (reference README.md:81-85; BASELINE.md).
 """
 
 import json
-import time
 
 import jax
 import jax.numpy as jnp
 
+from benchmarks.benchmark import bench_fn as _time  # single timing impl
+
 # seq -> reference per-chip fwd+bwd TFLOPs/s (README.md:81-85)
 BASELINE_FWDBWD = {65536: 170.0, 131072: 184.0, 262144: 191.0, 524288: 195.0, 1048576: 196.0}
-
-
-def _time(fn, *args, warmup=2, iters=8, reps=3):
-    """fn must return a SCALAR.  All `iters` dispatches are queued
-    asynchronously and synchronized by ONE host fetch of their sum: a per-iter
-    fetch would add the host<->device round trip (tens of ms through the
-    axon-relay TPU tunnel) to every iteration."""
-    for _ in range(warmup):
-        float(fn(*args))
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        acc = None
-        for _ in range(iters):
-            r = fn(*args)
-            acc = r if acc is None else acc + r
-        float(acc)
-        times.append((time.perf_counter() - t0) / iters)
-    return min(times)
 
 
 def flops_fwd(b, s, n, d, causal):

@@ -23,6 +23,7 @@ from .parallel.burst import (
     burst_attn_func,
     burst_attn_func_striped,
 )
+from .parallel.ulysses import ulysses_attn
 from .parallel import layouts
 from .ops import masks, tile, reference
 
@@ -32,6 +33,7 @@ __all__ = [
     "burst_attn_shard",
     "burst_attn_func",
     "burst_attn_func_striped",
+    "ulysses_attn",
     "layouts",
     "masks",
     "tile",

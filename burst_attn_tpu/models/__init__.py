@@ -1,5 +1,6 @@
 from .transformer import ModelConfig, init_params, forward, param_specs
 from .train import TrainConfig, make_mesh, init_train_state, train_step, loss_fn
+from .decode import Cache, forward_cached, generate, init_cache, prefill
 
 __all__ = [
     "ModelConfig",
@@ -11,4 +12,9 @@ __all__ = [
     "init_train_state",
     "train_step",
     "loss_fn",
+    "Cache",
+    "forward_cached",
+    "generate",
+    "init_cache",
+    "prefill",
 ]

@@ -1,0 +1,141 @@
+"""Autoregressive inference for the flagship LM: KV-cache prefill + decode.
+
+The reference is a training-time op library with no inference story; a
+complete framework needs one.  TPU-first design choices:
+
+  * The KV cache is a pair of preallocated [B, Nkv, max_seq, D] buffers per
+    layer (static shapes — no reallocation, no dynamic shapes under jit);
+    `lax.dynamic_update_slice` writes the new tokens' K/V at the current
+    length.
+  * One function serves prefill (T = prompt length) and decode (T = 1): the
+    causal predicate against the cache is `col <= cache_len + row`, so a
+    whole prompt is absorbed in one fused pass rather than token by token.
+  * `generate` runs the decode loop inside ONE jit via `lax.scan` — no
+    per-token dispatch overhead (which dominates single-token steps on TPU).
+  * Tokens stay in natural order — ring layouts (parallel/layouts.py) are a
+    training-time concern; decode shards over batch (dp) and heads (tp).
+"""
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .transformer import ModelConfig, _mlp, _rms_norm, _rope
+
+
+class LayerCache(NamedTuple):
+    k: jax.Array  # [B, Nkv, max_seq, D]
+    v: jax.Array  # [B, Nkv, max_seq, D]
+
+
+class Cache(NamedTuple):
+    layers: Tuple[LayerCache, ...]
+    length: jax.Array  # scalar int32: number of valid cache positions
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_seq: int) -> Cache:
+    shape = (batch, cfg.n_kv_heads, max_seq, cfg.d_head)
+    layers = tuple(
+        LayerCache(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+        for _ in range(cfg.n_layers)
+    )
+    return Cache(layers, jnp.int32(0))
+
+
+def _cached_attention(p, x, positions, lc: LayerCache, cache_len, cfg: ModelConfig):
+    """Attend the T new tokens against [cache .. cache+T); returns (out, new
+    LayerCache).  positions: [B, T] global positions of the new tokens."""
+    b, t, _ = x.shape
+    h = _rms_norm(x, p["attn_norm"])
+    q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
+    k = jnp.einsum("bsd,dnh->bnsh", h, p["wk"])
+    v = jnp.einsum("bsd,dnh->bnsh", h, p["wv"])
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+
+    ck = lax.dynamic_update_slice(lc.k, k.astype(lc.k.dtype), (0, 0, cache_len, 0))
+    cv = lax.dynamic_update_slice(lc.v, v.astype(lc.v.dtype), (0, 0, cache_len, 0))
+
+    group = cfg.n_heads // cfg.n_kv_heads
+    kx = jnp.repeat(ck, group, axis=1) if group > 1 else ck
+    vx = jnp.repeat(cv, group, axis=1) if group > 1 else cv
+
+    s = jnp.einsum(
+        "bnih,bnjh->bnij", q, kx, preferred_element_type=jnp.float32
+    ) * (cfg.d_head**-0.5)
+    rows = jnp.arange(t, dtype=jnp.int32)[:, None]
+    cols = jnp.arange(kx.shape[2], dtype=jnp.int32)[None, :]
+    s = jnp.where(cols <= cache_len + rows, s, float("-inf"))
+    prob = jax.nn.softmax(s, axis=-1).astype(vx.dtype)
+    o = jnp.einsum("bnij,bnjh->bnih", prob, vx)
+    out = jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+    return out, LayerCache(ck, cv)
+
+
+def forward_cached(params, tokens, positions, cache: Cache, cfg: ModelConfig):
+    """One cached forward pass over T new tokens.
+
+    tokens, positions: [B, T] int32 (natural order).  Returns (fp32 logits
+    [B, T, vocab], updated Cache with length += T).
+    """
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    new_layers = []
+    for p, lc in zip(params["layers"], cache.layers):
+        attn_out, lc = _cached_attention(p, x, positions, lc, cache.length, cfg)
+        x = x + attn_out
+        x = x + _mlp(p, x)
+        new_layers.append(lc)
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x, params["lm_head"], preferred_element_type=jnp.float32
+    )
+    return logits, Cache(tuple(new_layers), cache.length + tokens.shape[1])
+
+
+def prefill(params, tokens, cfg: ModelConfig, max_seq: int):
+    """Absorb a [B, T] prompt in one pass.  Returns (logits, cache)."""
+    b, t = tokens.shape
+    if t > max_seq:
+        raise ValueError(f"prompt length {t} exceeds max_seq {max_seq}")
+    cache = init_cache(cfg, b, max_seq)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+    return forward_cached(params, tokens, positions, cache, cfg)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "max_seq", "temperature"))
+def generate(params, prompt, cfg: ModelConfig, *, steps: int, max_seq: int,
+             temperature: float = 0.0, rng=None):
+    """Greedy (temperature=0) or sampled generation.
+
+    prompt: [B, T] int32.  Returns [B, steps] generated tokens.  The decode
+    loop is a lax.scan — one compiled program, no per-token dispatch.
+    """
+    if prompt.shape[1] + steps > max_seq:
+        raise ValueError("prompt + steps exceeds max_seq")
+    logits, cache = prefill(params, prompt, cfg, max_seq)
+    b = prompt.shape[0]
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    rng, first_key = jax.random.split(rng)
+
+    def pick(logits_last, key):
+        if temperature > 0.0:
+            return jax.random.categorical(key, logits_last / temperature, axis=-1)
+        return jnp.argmax(logits_last, axis=-1)
+
+    first = pick(logits[:, -1], first_key)
+
+    def body(carry, key):
+        token, cache = carry
+        positions = jnp.broadcast_to(cache.length[None, None], (b, 1)).astype(jnp.int32)
+        logits, cache = forward_cached(
+            params, token[:, None], positions, cache, cfg
+        )
+        nxt = pick(logits[:, -1], key)
+        return (nxt, cache), token
+
+    keys = jax.random.split(rng, steps)
+    (_, _), toks = lax.scan(body, (first, cache), keys[:steps])
+    return jnp.moveaxis(toks, 0, 1)  # [B, steps]

@@ -46,7 +46,8 @@ class ModelConfig:
     dtype: Any = jnp.bfloat16
     # attention / parallelism
     causal: bool = True
-    layout: str = "zigzag"
+    attn_strategy: str = "burst"  # "burst" (ring) | "ulysses" (all-to-all)
+    layout: str = "zigzag"  # ring layouts; ulysses uses natural order
     attn_backend: str = "auto"
     seq_axes: Tuple[str, ...] = ("sp",)
     batch_axis: Optional[str] = "dp"
@@ -147,20 +148,37 @@ def _attention(p, x, positions, cfg: ModelConfig, mesh):
     v = jnp.einsum("bsd,dnh->bnsh", h, p["wv"])
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    o = burst_attn(
-        q,
-        k,
-        v,
-        mesh=mesh,
-        seq_axes=cfg.seq_axes,
-        causal=cfg.causal,
-        layout=cfg.layout,
-        backend=cfg.attn_backend,
-        block_q=cfg.block_q,
-        block_kv=cfg.block_kv,
-        batch_axes=cfg.batch_axis,
-        head_axes=cfg.head_axis,
-    )
+    if cfg.attn_strategy == "ulysses":
+        if len(cfg.seq_axes) != 1:
+            raise ValueError("ulysses supports a single sequence axis")
+        from ..parallel.ulysses import ulysses_attn
+
+        o = ulysses_attn(
+            q, k, v, mesh=mesh, seq_axis=cfg.seq_axes[0], causal=cfg.causal,
+            backend=cfg.attn_backend, block_q=cfg.block_q,
+            block_kv=cfg.block_kv, batch_axes=cfg.batch_axis,
+            head_axes=cfg.head_axis,
+        )
+    elif cfg.attn_strategy == "burst":
+        o = burst_attn(
+            q,
+            k,
+            v,
+            mesh=mesh,
+            seq_axes=cfg.seq_axes,
+            causal=cfg.causal,
+            layout=cfg.layout,
+            backend=cfg.attn_backend,
+            block_q=cfg.block_q,
+            block_kv=cfg.block_kv,
+            batch_axes=cfg.batch_axis,
+            head_axes=cfg.head_axis,
+        )
+    else:
+        raise ValueError(
+            f"unknown attn_strategy {cfg.attn_strategy!r}; "
+            "expected 'burst' or 'ulysses'"
+        )
     return jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
 
 

@@ -192,7 +192,7 @@ def _fwd_kernel(
     q_ref, k_ref, v_ref, m_in_ref, lse_in_ref, acc_in_ref,
     m_out_ref, lse_out_ref, acc_out_ref,
     m_scr, l_scr, acc_scr,
-    *, scale, bq, bkv, lp, n_kv_blocks, cast_p,
+    *, scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p,
 ):
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -214,17 +214,22 @@ def _fwd_kernel(
     )
     full = _block_full(spec_ref, r0, c0, bq, bkv)
 
-    def _scores():
+    def _update(u, mask):
+        """Fold kv sub-block u (bkv_compute wide) into the running state.
+        The memory block (bkv) is split into compute sub-blocks (splash-style
+        bkv vs bkv_compute) so sub-block u+1's score matmul is independent of
+        sub-block u's VPU softmax chain — ILP the scheduler can overlap."""
+        cs = pl.ds(u * bkv_compute, bkv_compute)
         # scale (and the base-2 conversion) folded into the [bq, d] q block
         # (one small mul) instead of the [bq, bkv] score matrix — the kernel
         # is VPU-bound, not MXU-bound
         q = q_ref[0, 0, :, :] * (scale * LOG2E)
-        k = k_ref[0, 0, :, :]
-        return jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        s = jax.lax.dot_general(
+            q, k_ref[0, 0, cs, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-
-    def _update(s, mask):
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.where(m_prev >= m_new, 1.0, jnp.exp2(m_prev - m_new))
@@ -234,7 +239,7 @@ def _fwd_kernel(
             p = jnp.where(mask, p, 0.0)
         m_scr[:] = m_new
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0, 0, :, :]
+        v = v_ref[0, 0, cs, :]
         pv = jax.lax.dot_general(
             p.astype(v.dtype) if cast_p else p,
             v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -243,12 +248,13 @@ def _fwd_kernel(
 
     @pl.when(live & full)
     def _compute_fast():
-        _update(_scores(), None)
+        for u in range(bkv // bkv_compute):
+            _update(u, None)
 
     @pl.when(live & ~full)
     def _compute_masked():
-        mask = _block_mask(spec_ref, r0, c0, bq, bkv)
-        _update(jnp.where(mask, _scores(), NEG_INF), mask)
+        for u in range(bkv // bkv_compute):
+            _update(u, _block_mask(spec_ref, r0, c0 + u * bkv_compute, bq, bkv_compute))
 
     @pl.when(j == n_kv_blocks - 1)
     def _finish():
@@ -261,12 +267,15 @@ def _fwd_kernel(
 
 
 def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
-              block_q=1024, block_kv=1024, interpret=None, cast_p=True):
+              block_q=1024, block_kv=1024, block_kv_compute=None,
+              interpret=None, cast_p=True):
     """One online-softmax ring round on TPU.  Same contract as
     ops/tile.py:tile_fwd: returns updated (m, lse, acc).
 
     q [B,N,S,D]; k, v [B,Nk,Skv,D] (GQA when Nk < N); m, lse [B,N,S] f32;
     acc [B,N,S,D] f32.  `spec` scalars may be traced values.
+    `block_kv_compute` (<= block_kv, default equal) sets the in-kernel
+    compute sub-block width (see _fwd_kernel._update).
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -275,6 +284,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     group = _gqa_group(n, n_kv)
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
+    bkc = bkv if block_kv_compute is None else _pick_block(bkv, block_kv_compute)
     lp = _pick_block(bq, 128)
     nqb = s_q // bq
     nkb = s_kv // bkv
@@ -282,8 +292,8 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
 
     grid = (b, n, nqb, nkb)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp, n_kv_blocks=nkb,
-        cast_p=cast_p,
+        _fwd_kernel, scale=scale, bq=bq, bkv=bkv, bkv_compute=bkc, lp=lp,
+        n_kv_blocks=nkb, cast_p=cast_p,
     )
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     out_shape = [

@@ -1,0 +1,105 @@
+"""Ulysses (DeepSpeed-style) all-to-all sequence parallelism.
+
+Absent from the reference (SURVEY.md §2.4: "no all-to-all anywhere") but a
+natural complement to the burst ring: instead of rotating KV around a ring,
+each device exchanges its sequence shard for a head shard (one all-to-all),
+runs FULL-sequence attention on its subset of heads, and exchanges back.
+
+Trade-offs vs the ring (why both belong in the framework):
+  * comm volume: 2 all-to-alls of the activations vs W-1 KV rotations —
+    Ulysses moves less data when W is large and heads are plentiful;
+  * no causal load-balance problem: every device sees the full sequence, so
+    plain causal masking is already balanced (no zigzag/striped layouts);
+  * hard cap: parallelism cannot exceed the KV head count (GQA limits it),
+    where the ring scales with sequence length alone.
+
+TPU mapping: `lax.all_to_all` along the mesh axis inside shard_map (XLA
+lowers it onto ICI), local attention = the Pallas flash kernel (or the jnp
+tile off-TPU); differentiable end to end, so no hand-written VJP is needed —
+the transpose of all-to-all is all-to-all and XLA inserts it.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _local_attention(q, k, v, scale, causal, backend, block_q, block_kv):
+    if backend == "pallas":
+        from ..ops.pallas_flash import flash_attention
+
+        return flash_attention(q, k, v, scale, causal, block_q, block_kv)
+    from ..ops.tile import single_device_attention
+
+    return single_device_attention(q, k, v, scale, causal)
+
+
+def _ulysses_shard(q, k, v, *, axis, scale, causal, backend, block_q, block_kv):
+    """Per-shard [B, N, S/W, D] -> [B, N, S/W, D] with full-seq attention on
+    N/W heads in between."""
+    # scatter heads (axis 1), gather sequence (axis 2)
+    qh = lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
+    kh = lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
+    vh = lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
+    o = _local_attention(qh, kh, vh, scale, causal, backend, block_q, block_kv)
+    # scatter sequence back, gather heads
+    return lax.all_to_all(o, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_attn(
+    q,
+    k,
+    v,
+    *,
+    mesh,
+    seq_axis: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+    backend: str = "auto",
+    block_q: int = 2048,
+    block_kv: int = 2048,
+    batch_axes=None,
+    head_axes=None,
+) -> jax.Array:
+    """All-to-all sequence-parallel attention on global [B, N, S, D] arrays.
+
+    S is sharded over `seq_axis` in NATURAL token order (no ring layouts);
+    `head_axes` optionally shards heads over a tensor-parallel axis riding
+    alongside (the all-to-all then exchanges the LOCAL heads of each tp
+    group).  Requires per-tp-group head counts divisible by the seq axis
+    size W for both q and kv heads.
+    """
+    from .burst import _resolve_backend
+
+    w = mesh.shape[seq_axis]
+    tp = 1
+    if head_axes is not None:
+        for a in ((head_axes,) if isinstance(head_axes, str) else head_axes):
+            tp *= mesh.shape[a]
+    if (q.shape[1] // tp) % w or (k.shape[1] // tp) % w:
+        raise ValueError(
+            f"ulysses needs per-group q heads {q.shape[1]}/{tp} and kv heads "
+            f"{k.shape[1]}/{tp} divisible by the '{seq_axis}' axis size {w}"
+        )
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    fn = jax.shard_map(
+        partial(
+            _ulysses_shard,
+            axis=seq_axis,
+            scale=scale,
+            causal=causal,
+            backend=_resolve_backend(backend),
+            block_q=block_q,
+            block_kv=block_kv,
+        ),
+        mesh=mesh,
+        in_specs=(P(batch_axes, head_axes, seq_axis, None),) * 3,
+        out_specs=P(batch_axes, head_axes, seq_axis, None),
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,3 +1,5 @@
 from . import testing
 
+# checkpoint is imported lazily by callers (pulls in orbax); see
+# utils/checkpoint.Checkpointer
 __all__ = ["testing"]

@@ -1,0 +1,97 @@
+"""Ulysses all-to-all SP vs dense reference on the simulated 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from burst_attn_tpu.parallel.ulysses import ulysses_attn
+
+
+def ref_attn(q, k, v, causal):
+    g = q.shape[1] // k.shape[1]
+    kx = jnp.repeat(k, g, axis=1).astype(jnp.float32)
+    vx = jnp.repeat(v, g, axis=1).astype(jnp.float32)
+    s = jnp.einsum("bnid,bnjd->bnij", q.astype(jnp.float32), kx)
+    s = s * (q.shape[-1] ** -0.5)
+    if causal:
+        i = jnp.arange(q.shape[2])[:, None]
+        j = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(j <= i, s, float("-inf"))
+    return jnp.einsum("bnij,bnjd->bnid", jax.nn.softmax(s, axis=-1), vx)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("sp",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("nkv", [8, 16])
+def test_ulysses_fwd_grad(mesh, causal, nkv):
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (1, 16, 256, 32), jnp.float32)
+    k = jax.random.normal(ks[1], (1, nkv, 256, 32), jnp.float32)
+    v = jax.random.normal(ks[2], (1, nkv, 256, 32), jnp.float32)
+    do = jax.random.normal(ks[3], q.shape, jnp.float32)
+
+    o = ulysses_attn(q, k, v, mesh=mesh, causal=causal, backend="jnp")
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref_attn(q, k, v, causal)),
+                               rtol=1e-4, atol=1e-4)
+
+    def loss(q, k, v):
+        return jnp.sum(ulysses_attn(q, k, v, mesh=mesh, causal=causal,
+                                    backend="jnp") * do)
+
+    def rloss(q, k, v):
+        return jnp.sum(ref_attn(q, k, v, causal) * do)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    rgrads = jax.grad(rloss, argnums=(0, 1, 2))(q, k, v)
+    for g, r in zip(grads, rgrads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_rejects_indivisible_heads(mesh):
+    q = jnp.zeros((1, 4, 64, 16))
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attn(q, q, q, mesh=mesh)
+
+
+def test_model_train_step_with_ulysses(mesh):
+    """The flagship LM trains with attn_strategy='ulysses' over the sp axis."""
+    from burst_attn_tpu.models import ModelConfig, TrainConfig
+    from burst_attn_tpu.models.train import (
+        init_train_state, make_batch, make_mesh, make_train_step,
+    )
+
+    cfg = ModelConfig(
+        vocab=64, d_model=32, n_layers=1, n_heads=8, n_kv_heads=8, d_head=8,
+        d_ff=64, attn_strategy="ulysses", layout="contig", attn_backend="jnp",
+        remat=False, seq_axes=("sp",), batch_axis=None, head_axis=None,
+    )
+    tcfg = TrainConfig()
+    m = make_mesh({"sp": 8})
+    state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, m)
+    batch = make_batch(jax.random.PRNGKey(1), cfg, m, batch=2, seq=64)
+    state, metrics = make_train_step(cfg, tcfg, m)(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_ulysses_with_tp_head_sharding():
+    """Heads sharded over tp alongside the sp all-to-all (no redundant
+    compute: each tp group exchanges only its local heads)."""
+    m = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("sp", "tp"))
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (1, 16, 256, 32), jnp.float32)
+    o = ulysses_attn(q, q, q, mesh=m, seq_axis="sp", causal=True,
+                     backend="jnp", head_axes="tp")
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref_attn(q, q, q, True)),
+                               rtol=1e-4, atol=1e-4)
+    # per-group heads 16/2=8 not divisible by sp=4 is fine; 4 heads is not
+    bad = jax.random.normal(key, (1, 4, 256, 32), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attn(bad, bad, bad, mesh=m, seq_axis="sp", head_axes="tp")
