@@ -91,15 +91,18 @@ def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend)
             lambda q, k, v: jnp.sum(
                 flash_attention(q, k, v, None, causal).astype(jnp.float32)))
 
+        # NB: big arrays (do) must be jit ARGUMENTS, not closures — a closed-
+        # over array is embedded in the compile payload (multi-hundred-MB
+        # requests overflow the remote-compile tunnel)
         @jax.jit
-        def fb(q, k, v):
+        def fb(q, k, v, do):
             def loss(q, k, v):
                 return jnp.sum(
                     flash_attention(q, k, v, None, causal).astype(jnp.float32)
                     * do.astype(jnp.float32))
             return _scalar_grads(jax.grad(loss, argnums=(0, 1, 2))(q, k, v))
 
-        return bench_fn(fwd, q, k, v), bench_fn(fb, q, k, v), 1
+        return bench_fn(fwd, q, k, v), bench_fn(fb, q, k, v, do), 1
 
     layout = {"burst": "zigzag", "burst_striped": "striped", "ring": "contig"}[method]
     seq_spec = seq_axes if len(seq_axes) > 1 else seq_axes[0]
@@ -119,13 +122,13 @@ def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend)
                 ring_attention(q, k, v, mesh=mesh, causal=causal).astype(jnp.float32)))
 
         @jax.jit
-        def fb(q, k, v):
+        def fb(q, k, v, do):
             def loss(q, k, v):
                 o = ring_attention(q, k, v, mesh=mesh, causal=causal)
                 return jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32))
             return _scalar_grads(jax.grad(loss, argnums=(0, 1, 2))(q, k, v))
 
-        return bench_fn(fwd, q, k, v), bench_fn(fb, q, k, v), w
+        return bench_fn(fwd, q, k, v), bench_fn(fb, q, k, v, do), w
 
     attn = partial(
         burst_attn, mesh=mesh, seq_axes=seq_axes, causal=causal, layout=layout,
@@ -134,12 +137,12 @@ def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend)
     fwd = jax.jit(lambda q, k, v: jnp.sum(attn(q, k, v).astype(jnp.float32)))
 
     @jax.jit
-    def fb(q, k, v):
+    def fb(q, k, v, do):
         def loss(q, k, v):
             return jnp.sum(attn(q, k, v).astype(jnp.float32) * do.astype(jnp.float32))
         return _scalar_grads(jax.grad(loss, argnums=(0, 1, 2))(q, k, v))
 
-    return bench_fn(fwd, q, k, v), bench_fn(fb, q, k, v), w
+    return bench_fn(fwd, q, k, v), bench_fn(fb, q, k, v, do), w
 
 
 def main():

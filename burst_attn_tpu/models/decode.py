@@ -59,18 +59,19 @@ def _cached_attention(p, x, positions, lc: LayerCache, cache_len, cfg: ModelConf
     ck = lax.dynamic_update_slice(lc.k, k.astype(lc.k.dtype), (0, 0, cache_len, 0))
     cv = lax.dynamic_update_slice(lc.v, v.astype(lc.v.dtype), (0, 0, cache_len, 0))
 
+    # GQA via a grouped query axis — never materialize a repeated cache (at
+    # decode the [B, Nkv, max_seq, D] buffers dominate memory traffic)
     group = cfg.n_heads // cfg.n_kv_heads
-    kx = jnp.repeat(ck, group, axis=1) if group > 1 else ck
-    vx = jnp.repeat(cv, group, axis=1) if group > 1 else cv
-
+    qg = q.reshape(q.shape[0], cfg.n_kv_heads, group, t, cfg.d_head)
     s = jnp.einsum(
-        "bnih,bnjh->bnij", q, kx, preferred_element_type=jnp.float32
+        "bngih,bnjh->bngij", qg, ck, preferred_element_type=jnp.float32
     ) * (cfg.d_head**-0.5)
     rows = jnp.arange(t, dtype=jnp.int32)[:, None]
-    cols = jnp.arange(kx.shape[2], dtype=jnp.int32)[None, :]
+    cols = jnp.arange(ck.shape[2], dtype=jnp.int32)[None, :]
     s = jnp.where(cols <= cache_len + rows, s, float("-inf"))
-    prob = jax.nn.softmax(s, axis=-1).astype(vx.dtype)
-    o = jnp.einsum("bnij,bnjh->bnih", prob, vx)
+    prob = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+    o = jnp.einsum("bngij,bnjh->bngih", prob, cv)
+    o = o.reshape(q.shape[0], cfg.n_heads, t, cfg.d_head)
     out = jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
     return out, LayerCache(ck, cv)
 

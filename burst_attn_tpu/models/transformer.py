@@ -52,8 +52,8 @@ class ModelConfig:
     seq_axes: Tuple[str, ...] = ("sp",)
     batch_axis: Optional[str] = "dp"
     head_axis: Optional[str] = "tp"
-    block_q: int = 256
-    block_kv: int = 256
+    block_q: int = 2048  # kernel blocks, clamped down for short shards
+    block_kv: int = 2048
     remat: bool = True  # jax.checkpoint each block: FLOPs for HBM
 
 
@@ -151,6 +151,13 @@ def _attention(p, x, positions, cfg: ModelConfig, mesh):
     if cfg.attn_strategy == "ulysses":
         if len(cfg.seq_axes) != 1:
             raise ValueError("ulysses supports a single sequence axis")
+        if cfg.layout != "contig":
+            # ulysses attends in array order with a plain causal mask; a ring
+            # layout permutation would silently scramble causality
+            raise ValueError(
+                "attn_strategy='ulysses' requires layout='contig' (natural "
+                f"token order); got layout={cfg.layout!r}"
+            )
         from ..parallel.ulysses import ulysses_attn
 
         o = ulysses_attn(

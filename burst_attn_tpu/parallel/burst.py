@@ -69,8 +69,12 @@ class BurstConfig:
     inter_axis: Optional[str] = None  # set for the hierarchical double ring
     backend: str = "jnp"  # "jnp" | "pallas"
     optimize_bwd_comm: bool = True  # rotate delta=sum(o*do) [B,N,S] f32, not o
-    block_q: int = 256
-    block_kv: int = 256
+    # v5e-tuned kernel blocks (fwd likes square 2048; the fused bwd 1024x2048);
+    # _pick_block clamps them down for small ring shards
+    block_q: int = 2048
+    block_kv: int = 2048
+    block_q_bwd: int = 1024
+    block_kv_bwd: int = 2048
     deterministic: bool = True
 
 
@@ -95,7 +99,7 @@ def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec):
 
         return pallas_flash.flash_bwd(
             do, q, k, v, delta, lse, scale, spec,
-            block_q=cfg.block_q, block_kv=cfg.block_kv,
+            block_q=cfg.block_q_bwd, block_kv=cfg.block_kv_bwd,
         )
     return jnp_tile.tile_bwd(do, q, k, v, delta, lse, scale, spec)
 
@@ -301,8 +305,10 @@ def burst_attn(
     scale: Optional[float] = None,
     backend: str = "auto",
     optimize_bwd_comm: bool = True,
-    block_q: int = 256,
-    block_kv: int = 256,
+    block_q: int = 2048,
+    block_kv: int = 2048,
+    block_q_bwd: int = 1024,
+    block_kv_bwd: int = 2048,
     batch_axes=None,
     head_axes=None,
 ) -> jax.Array:
@@ -333,6 +339,8 @@ def burst_attn(
         optimize_bwd_comm=optimize_bwd_comm,
         block_q=block_q,
         block_kv=block_kv,
+        block_q_bwd=block_q_bwd,
+        block_kv_bwd=block_kv_bwd,
     )
     seq_spec = seq_axes if len(seq_axes) > 1 else intra_axis
     spec = P(batch_axes, head_axes, seq_spec, None)

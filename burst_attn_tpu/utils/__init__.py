@@ -1,5 +1,5 @@
-from . import testing
+from . import testing, profiling
 
-# checkpoint is imported lazily by callers (pulls in orbax); see
-# utils/checkpoint.Checkpointer
-__all__ = ["testing"]
+# checkpoint / multihost are imported lazily by callers (orbax / distributed
+# runtime deps); see utils/checkpoint.Checkpointer, utils/multihost
+__all__ = ["testing", "profiling"]

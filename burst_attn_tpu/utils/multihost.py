@@ -1,0 +1,76 @@
+"""Multi-host (multi-process) runtime setup.
+
+The reference reaches multi-node through torchrun + NCCL rendezvous
+(reference test/test.sh:6, comm.py:74-101 env-var rank plumbing).  The JAX
+equivalent is the multi-controller runtime: every host runs the same
+program, `jax.distributed.initialize` performs the rendezvous, and
+`jax.devices()` then spans all hosts, so a `Mesh` built from it carries DCN
+(inter-host) axes transparently — the double ring's "inter" axis simply maps
+onto the DCN dimension of the mesh.
+
+Typical launch (per host):
+
+    from burst_attn_tpu.utils import multihost
+    multihost.initialize()                       # env-driven (TPU pods: automatic)
+    mesh = multihost.make_hybrid_mesh(ici={"intra": 4}, dcn={"inter": 2})
+    # burst_attn(..., seq_axes=("inter", "intra"), mesh=mesh)
+"""
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Start the multi-controller runtime.  On TPU pods all arguments come
+    from the environment; on CPU/GPU clusters pass them explicitly
+    (reference analogue: torchrun's c10d rendezvous, test.sh:6).
+
+    Must run before any JAX computation (backend init).  Intentionally does
+    NOT probe jax.process_count() first — that would itself initialize the
+    local backend and break the rendezvous.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs.update(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        # tolerate double-initialize; surface every other failure (a wrong
+        # coordinator address silently falling back to single-host would be
+        # far worse than an exception)
+        if "already" not in str(e).lower():
+            raise
+    except ValueError:
+        if kwargs:
+            raise  # explicit arguments were wrong — do not swallow
+        # auto-detection found no cluster environment: single-process run
+
+
+def make_hybrid_mesh(ici: Dict[str, int], dcn: Dict[str, int]):
+    """Mesh whose `dcn` axes span hosts (outermost) and `ici` axes stay
+    chip-local — the layout the double ring assumes (inter hop = DCN, intra
+    ring = ICI; SURVEY.md §2.3 NCCL row).
+
+    Devices are ordered process-major, so reshaping to
+    (*dcn_sizes, *ici_sizes) puts whole processes (hosts/slices) along the
+    leading DCN axes; XLA then routes collectives on those axes over DCN and
+    the trailing axes over ICI.
+    """
+    from jax.sharding import Mesh
+
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    names = tuple(dcn) + tuple(ici)
+    shape = tuple(dcn.values()) + tuple(ici.values())
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh {dict(**dcn, **ici)} needs {n} devices, "
+                         f"have {len(devs)}")
+    return Mesh(np.array(devs[:n]).reshape(shape), names)

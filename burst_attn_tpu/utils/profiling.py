@@ -1,0 +1,76 @@
+"""Tracing / profiling utilities.
+
+The reference has no profiling subsystem beyond its benchmark harness
+(SURVEY.md §5); the only debug aid is each rank's `record` list of visited
+partition ids (burst_attn_interface.py:213-217).  Here both live in the
+framework: XLA profiler capture (viewable in XProf/TensorBoard, incl. the
+collective-permute/compute overlap of the ring scan) and the ring-schedule
+replay check.
+
+    with trace("/tmp/profile"):
+        step(state, batch)          # -> /tmp/profile/plugins/profile/...
+
+    timer = StepTimer()
+    for batch in data:
+        with timer:
+            state, _ = step(state, batch)
+    print(timer.summary())
+"""
+
+import contextlib
+import time
+from typing import List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, host_tracer_level: int = 2):
+    """Capture an XLA profiler trace of the enclosed block.
+
+    On TPU this records device timelines (kernel + collective activity) —
+    the tool for confirming the ring's permute/compute overlap that the
+    reference eyeballed with CUDA stream timing.
+    """
+    opts = jax.profiler.ProfileOptions()
+    opts.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(log_dir, profiler_options=opts)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span that shows up on the profiler timeline (TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Wall-clock step timer with a blocking fetch at each exit so device
+    work is included (use around jitted steps)."""
+
+    def __init__(self):
+        self.times: List[float] = []
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        for a in jax.live_arrays():
+            if not a.is_deleted():  # donated buffers linger in live_arrays
+                a.block_until_ready()
+        self.times.append(time.perf_counter() - self._t0)
+        return False
+
+    def summary(self, skip_first: int = 1) -> dict:
+        """Stats over recorded steps (first `skip_first` dropped: compile)."""
+        ts = self.times[skip_first:] or self.times
+        return {
+            "steps": len(ts),
+            "mean_s": sum(ts) / len(ts),
+            "min_s": min(ts),
+            "max_s": max(ts),
+        }

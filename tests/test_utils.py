@@ -1,0 +1,44 @@
+"""Profiling + multihost utilities on the simulated device set."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from burst_attn_tpu.utils import multihost, profiling
+
+
+def test_step_timer():
+    t = profiling.StepTimer()
+    x = jnp.ones((256, 256))
+    f = jax.jit(lambda x: x @ x)
+    for _ in range(3):
+        with t:
+            f(x)
+    s = t.summary()
+    assert s["steps"] == 2  # first dropped as compile
+    assert s["min_s"] <= s["mean_s"] <= s["max_s"]
+
+
+def test_trace_writes_profile(tmp_path):
+    d = str(tmp_path / "prof")
+    with profiling.trace(d):
+        with profiling.annotate("matmul"):
+            jnp.ones((64, 64)) @ jnp.ones((64, 64))
+    found = [f for _, _, fs in os.walk(d) for f in fs]
+    assert found, "no profile artifacts written"
+
+
+def test_make_hybrid_mesh_single_host():
+    mesh = multihost.make_hybrid_mesh(ici={"intra": 4}, dcn={"inter": 2})
+    assert mesh.axis_names == ("inter", "intra")
+    assert mesh.shape == {"inter": 2, "intra": 4}
+    with pytest.raises(ValueError, match="devices"):
+        multihost.make_hybrid_mesh(ici={"intra": 16}, dcn={"inter": 2})
+
+
+def test_initialize_single_process_noop():
+    multihost.initialize()  # must not raise in a single-process run
+    assert jax.process_count() == 1
