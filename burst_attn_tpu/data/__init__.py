@@ -1,0 +1,5 @@
+"""Data subsystem: native (C++) token loader + dataset file utilities."""
+
+from .loader import DataLoader, write_token_file, read_token_file
+
+__all__ = ["DataLoader", "write_token_file", "read_token_file"]

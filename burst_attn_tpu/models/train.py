@@ -146,6 +146,29 @@ def train_step(state, batch, cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     return make_train_step(cfg, tcfg, mesh)(state, batch)
 
 
+def batch_from_host(tokens, labels, cfg: ModelConfig, mesh: Mesh):
+    """Turn a host batch (e.g. from data.DataLoader: inputs/targets
+    [B, S] int32 numpy, natural order) into the sharded, layout-permuted
+    batch dict `make_train_step` consumes.
+
+    Labels are shifted by the LOADER (targets = window[1:]), so here they
+    only get the same layout permutation as tokens.
+    """
+    tokens = np.asarray(tokens)
+    labels = np.asarray(labels)
+    b, s = tokens.shape
+    world = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))
+    perm = layouts.seq_permutation(cfg.layout, s, world)
+    pos = np.broadcast_to(np.asarray(perm, np.int32)[None, :], (b, s))
+    seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
+    sharding = NamedSharding(mesh, P(cfg.batch_axis, seq_spec))
+    return {
+        "tokens": jax.device_put(np.asarray(tokens[:, perm]), sharding),
+        "positions": jax.device_put(pos, sharding),
+        "labels": jax.device_put(np.asarray(labels[:, perm]), sharding),
+    }
+
+
 def make_batch(key, cfg: ModelConfig, mesh: Mesh, batch: int, seq: int):
     """Synthetic LM batch in layout order, placed with (dp, sp) sharding."""
     world = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))

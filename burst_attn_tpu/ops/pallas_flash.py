@@ -780,11 +780,13 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, scale=None, causal=False, block_q=2048, block_kv=2048,
-                    block_q_bwd=1024, block_kv_bwd=2048):
+                    block_q_bwd=None, block_kv_bwd=None):
     """Fused single-device flash attention.  q,k,v [B,N,S,D] -> o [B,N,S,D].
 
     Default block sizes are the measured v5e optimum at long seq (fwd likes
-    2048x2048; the fused backward 1024x2048)."""
+    2048x2048; the fused backward 1024x2048).  The bwd blocks default to
+    None = derived from the fwd blocks (min(1024, block_q), block_kv) so a
+    caller who shrinks the fwd blocks for VMEM keeps that budget in bwd."""
     o, _ = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv)
     return o
 
@@ -819,6 +821,10 @@ def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
     d = q.shape[-1]
     if scale is None:
         scale = d**-0.5
+    if block_q_bwd is None:
+        block_q_bwd = min(1024, block_q)
+    if block_kv_bwd is None:
+        block_kv_bwd = block_kv
     spec = round_spec(jnp.int32(0), jnp.int32(0), q.shape[2], k.shape[2], causal, "contig")
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
     dq, dk, dv = flash_bwd(

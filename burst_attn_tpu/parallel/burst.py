@@ -70,12 +70,20 @@ class BurstConfig:
     backend: str = "jnp"  # "jnp" | "pallas"
     optimize_bwd_comm: bool = True  # rotate delta=sum(o*do) [B,N,S] f32, not o
     # v5e-tuned kernel blocks (fwd likes square 2048; the fused bwd 1024x2048);
-    # _pick_block clamps them down for small ring shards
+    # _pick_block clamps them down for small ring shards.  The bwd blocks
+    # default to None = derived from the fwd blocks (never larger), so a
+    # caller who tunes block_q/block_kv down for VMEM keeps that budget in
+    # the backward pass too.
     block_q: int = 2048
     block_kv: int = 2048
-    block_q_bwd: int = 1024
-    block_kv_bwd: int = 2048
+    block_q_bwd: Optional[int] = None
+    block_kv_bwd: Optional[int] = None
     deterministic: bool = True
+
+    def bwd_blocks(self) -> Tuple[int, int]:
+        bq = self.block_q_bwd if self.block_q_bwd is not None else min(1024, self.block_q)
+        bkv = self.block_kv_bwd if self.block_kv_bwd is not None else self.block_kv
+        return bq, bkv
 
 
 # ---------------------------------------------------------------------------
@@ -97,9 +105,9 @@ def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec):
     if cfg.backend == "pallas":
         from ..ops import pallas_flash
 
+        bq, bkv = cfg.bwd_blocks()
         return pallas_flash.flash_bwd(
-            do, q, k, v, delta, lse, scale, spec,
-            block_q=cfg.block_q_bwd, block_kv=cfg.block_kv_bwd,
+            do, q, k, v, delta, lse, scale, spec, block_q=bq, block_kv=bkv,
         )
     return jnp_tile.tile_bwd(do, q, k, v, delta, lse, scale, spec)
 
@@ -307,8 +315,8 @@ def burst_attn(
     optimize_bwd_comm: bool = True,
     block_q: int = 2048,
     block_kv: int = 2048,
-    block_q_bwd: int = 1024,
-    block_kv_bwd: int = 2048,
+    block_q_bwd: Optional[int] = None,
+    block_kv_bwd: Optional[int] = None,
     batch_axes=None,
     head_axes=None,
 ) -> jax.Array:

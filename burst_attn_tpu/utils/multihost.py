@@ -16,10 +16,31 @@ Typical launch (per host):
     # burst_attn(..., seq_axes=("inter", "intra"), mesh=mesh)
 """
 
+import os
 from typing import Dict, Optional
 
 import numpy as np
 import jax
+
+
+def _cluster_env() -> bool:
+    """True iff the environment advertises a MULTI-host run (the signals
+    jax.distributed's auto-detectors key on).  Single-valued forms —
+    TPU_WORKER_HOSTNAMES=localhost (which single-chip TPU plugins set),
+    one-task SLURM/MPI jobs — do not count."""
+    for v in ("MEGASCALE_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+              "JAX_COORDINATOR_ADDRESS", "JOBSET_NAME"):
+        if os.environ.get(v):
+            return True
+    if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
+        return True
+    for v in ("OMPI_COMM_WORLD_SIZE", "SLURM_NTASKS", "SLURM_NPROCS"):
+        try:
+            if int(os.environ.get(v, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -43,15 +64,24 @@ def initialize(coordinator_address: Optional[str] = None,
     try:
         jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
-        # tolerate double-initialize; surface every other failure (a wrong
-        # coordinator address silently falling back to single-host would be
-        # far worse than an exception)
-        if "already" not in str(e).lower():
+        # tolerate double-initialize, and — for the env-driven form only, on
+        # a machine with no cluster environment — a backend that is already
+        # up (single-process run that did JAX work before calling us).  In a
+        # real cluster env the same error means the rendezvous was missed and
+        # N duplicate single-host jobs would run: surface it.
+        msg = str(e).lower()
+        benign = "already" in msg or (
+            not kwargs and "must be called before" in msg and not _cluster_env()
+        )
+        if not benign:
             raise
     except ValueError:
-        if kwargs:
-            raise  # explicit arguments were wrong — do not swallow
-        # auto-detection found no cluster environment: single-process run
+        # explicit arguments were wrong, or auto-detection failed on a host
+        # that IS in a cluster (e.g. COORDINATOR_ADDRESS set but process ids
+        # underivable) — both must surface, not degrade to N duplicate
+        # single-host jobs.  Only a genuine no-cluster environment is benign.
+        if kwargs or _cluster_env():
+            raise
 
 
 def make_hybrid_mesh(ici: Dict[str, int], dcn: Dict[str, int]):
@@ -59,18 +89,32 @@ def make_hybrid_mesh(ici: Dict[str, int], dcn: Dict[str, int]):
     chip-local — the layout the double ring assumes (inter hop = DCN, intra
     ring = ICI; SURVEY.md §2.3 NCCL row).
 
-    Devices are ordered process-major, so reshaping to
-    (*dcn_sizes, *ici_sizes) puts whole processes (hosts/slices) along the
-    leading DCN axes; XLA then routes collectives on those axes over DCN and
-    the trailing axes over ICI.
+    On real multi-host topologies this delegates to
+    `mesh_utils.create_hybrid_device_mesh`, which orders ICI devices by
+    physical torus coordinates (a naive id sort can make ICI-non-adjacent
+    chips ring neighbors on 2D/3D slices, crippling collective-permute
+    bandwidth).  Single-process simulated device sets (CPU
+    host-platform-device-count) have no granules for it to split, so there
+    we fall back to a process-major reshape — topology is moot.
     """
     from jax.sharding import Mesh
 
-    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
     names = tuple(dcn) + tuple(ici)
     shape = tuple(dcn.values()) + tuple(ici.values())
     n = int(np.prod(shape))
+    devs = jax.devices()
     if n > len(devs):
         raise ValueError(f"mesh {dict(**dcn, **ici)} needs {n} devices, "
                          f"have {len(devs)}")
+    if jax.process_count() > 1:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=tuple(ici.values()),
+            dcn_mesh_shape=tuple(dcn.values()),
+            devices=devs[:n],
+        )
+        # create_hybrid_device_mesh returns [*dcn, *ici]-shaped devices
+        return Mesh(arr, names)
+    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
     return Mesh(np.array(devs[:n]).reshape(shape), names)

@@ -47,27 +47,45 @@ def annotate(name: str):
 
 
 class StepTimer:
-    """Wall-clock step timer with a blocking fetch at each exit so device
-    work is included (use around jitted steps)."""
+    """Wall-clock step timer that blocks on the step's OUTPUTS at exit so
+    device work is included without serializing unrelated async work (a
+    global live-array sweep would block on e.g. the next batch's
+    host-to-device prefetch and destroy the IO/compute overlap):
+
+        with timer as t:
+            state, metrics = step(state, batch)
+            t.watch(state)
+    """
 
     def __init__(self):
         self.times: List[float] = []
         self._t0: Optional[float] = None
+        self._watched = None
+
+    def watch(self, *outputs):
+        """Register the step's outputs; exit blocks until they are ready."""
+        self._watched = outputs
+        return outputs[0] if len(outputs) == 1 else outputs
 
     def __enter__(self):
+        self._watched = None
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        for a in jax.live_arrays():
-            if not a.is_deleted():  # donated buffers linger in live_arrays
-                a.block_until_ready()
-        self.times.append(time.perf_counter() - self._t0)
+        if exc[0] is None:
+            if self._watched is None:
+                raise RuntimeError("StepTimer: call t.watch(outputs) inside the block")
+            jax.block_until_ready(self._watched)
+            self.times.append(time.perf_counter() - self._t0)
+        self._watched = None
         return False
 
     def summary(self, skip_first: int = 1) -> dict:
         """Stats over recorded steps (first `skip_first` dropped: compile)."""
         ts = self.times[skip_first:] or self.times
+        if not ts:
+            return {"steps": 0, "mean_s": 0.0, "min_s": 0.0, "max_s": 0.0}
         return {
             "steps": len(ts),
             "mean_s": sum(ts) / len(ts),

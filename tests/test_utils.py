@@ -16,10 +16,17 @@ def test_step_timer():
     f = jax.jit(lambda x: x @ x)
     for _ in range(3):
         with t:
-            f(x)
+            t.watch(f(x))
     s = t.summary()
     assert s["steps"] == 2  # first dropped as compile
     assert s["min_s"] <= s["mean_s"] <= s["max_s"]
+
+
+def test_step_timer_requires_watch():
+    t = profiling.StepTimer()
+    with pytest.raises(RuntimeError, match="watch"):
+        with t:
+            pass
 
 
 def test_trace_writes_profile(tmp_path):
