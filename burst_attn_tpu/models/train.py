@@ -153,19 +153,30 @@ def batch_from_host(tokens, labels, cfg: ModelConfig, mesh: Mesh):
 
     Labels are shifted by the LOADER (targets = window[1:]), so here they
     only get the same layout permutation as tokens.
+
+    Multi-process: `tokens`/`labels` are each process's LOCAL batch (e.g.
+    its shard of the DataLoader stream); the global batch is assembled
+    across processes, so the global batch size is local_B x the number of
+    batch-sharding processes.  A plain device_put of local data against a
+    cross-host sharding would silently drop most loaded rows.
     """
     tokens = np.asarray(tokens)
     labels = np.asarray(labels)
     b, s = tokens.shape
     world = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))
     perm = layouts.seq_permutation(cfg.layout, s, world)
-    pos = np.broadcast_to(np.asarray(perm, np.int32)[None, :], (b, s))
+    pos = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(perm, np.int32)[None, :], (b, s)))
     seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
     sharding = NamedSharding(mesh, P(cfg.batch_axis, seq_spec))
+    if jax.process_count() > 1:
+        put = partial(jax.make_array_from_process_local_data, sharding)
+    else:
+        put = partial(jax.device_put, device=sharding)
     return {
-        "tokens": jax.device_put(np.asarray(tokens[:, perm]), sharding),
-        "positions": jax.device_put(pos, sharding),
-        "labels": jax.device_put(np.asarray(labels[:, perm]), sharding),
+        "tokens": put(np.ascontiguousarray(tokens[:, perm])),
+        "positions": put(pos),
+        "labels": put(np.ascontiguousarray(labels[:, perm])),
     }
 
 

@@ -1,0 +1,71 @@
+"""End-to-end runner: CLI arg plumbing, fit(), checkpoint resume."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from burst_attn_tpu.data import write_token_file
+from burst_attn_tpu.models import ModelConfig
+from burst_attn_tpu.models.runner import RunConfig, TrainConfig, _parse_mesh, fit
+from burst_attn_tpu.models.train import make_mesh
+
+
+@pytest.fixture(scope="module")
+def data_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("run") / "toks.batd"
+    rng = np.random.default_rng(1)
+    write_token_file(p, rng.integers(0, 512, size=60_000))
+    return str(p)
+
+
+def _cfg(**kw):
+    return ModelConfig(
+        vocab=512, d_model=64, n_layers=1, n_heads=4, n_kv_heads=2, d_head=16,
+        d_ff=128, block_q=32, block_kv=32, remat=False, **kw,
+    )
+
+
+def test_parse_mesh():
+    assert _parse_mesh("dp=2,sp=4") == {"dp": 2, "sp": 4}
+    with pytest.raises(ValueError):
+        _parse_mesh("dp2")
+
+
+def test_fit_runs_and_logs(data_path):
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    run = RunConfig(data_path=data_path, steps=3, batch=2, seq_len=128,
+                    log_every=1)
+    state, history = fit(_cfg(), TrainConfig(lr=1e-3), run, mesh)
+    assert len(history) == 3
+    assert all(np.isfinite(h["loss"]) for h in history)
+    # random 512-vocab data: initial loss near ln(512) ~ 6.24
+    assert 4.5 < history[0]["loss"] < 8.5
+
+
+def test_fit_resume_continues_stream(data_path, tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    mesh = make_mesh({"sp": 2})
+    cfg, tcfg = _cfg(batch_axis=None, head_axis=None), TrainConfig(lr=1e-3)
+    ckpt = str(tmp_path / "ckpt")
+
+    # uninterrupted 4-step run
+    run_all = RunConfig(data_path=data_path, steps=4, batch=2, seq_len=128,
+                        log_every=1)
+    _, hist_all = fit(cfg, tcfg, run_all, mesh)
+
+    # 2 steps + checkpoint, then resume for 2 more
+    run_a = RunConfig(data_path=data_path, steps=2, batch=2, seq_len=128,
+                      ckpt_dir=ckpt, ckpt_every=100, log_every=1)
+    fit(cfg, tcfg, run_a, mesh)
+    run_b = RunConfig(data_path=data_path, steps=4, batch=2, seq_len=128,
+                      ckpt_dir=ckpt, ckpt_every=100, log_every=1)
+    _, hist_b = fit(cfg, tcfg, run_b, mesh)
+
+    assert hist_b[0]["step"] == 3  # resumed at step 2
+    # same data stream + same state => same losses as the uninterrupted run
+    np.testing.assert_allclose(
+        [h["loss"] for h in hist_b],
+        [h["loss"] for h in hist_all[2:]],
+        rtol=2e-4,
+    )
