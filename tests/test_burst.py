@@ -95,6 +95,46 @@ def test_small_world_2():
     run_case((2,), "zigzag", causal=True)
 
 
+def test_pallas_backend_in_ring_interpret():
+    """The pallas tile inside the distributed ring (interpret mode off-TPU):
+    closes the gap between 'kernels correct standalone' (test_pallas.py) and
+    'kernels correct as the ring's tile' — catches contract drift in the
+    carry-in state or MaskSpec plumbing between burst.py and the kernels."""
+    W, b, n, d = 4, 1, 2, 16
+    S = 16 * W
+    mesh, names = make_mesh((4,))
+    q, k, v, do = random_qkv(KEY, b, n, S, d, kv_heads=n, dtype=jnp.float32)
+    o_ref = dense_attention(q, k, v, causal=True)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True).astype(jnp.float32) * do)
+
+    dq_ref, dk_ref, dv_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+
+    ql, kl, vl, dol = (layouts.to_layout(t, "zigzag", W, 2) for t in (q, k, v, do))
+
+    def burst_loss(ql, kl, vl):
+        o = burst_attn(
+            ql, kl, vl, mesh=mesh, seq_axes=names, causal=True, layout="zigzag",
+            backend="pallas", block_q=16, block_kv=16,
+        )
+        return jnp.sum(o.astype(jnp.float32) * dol)
+
+    o_l = burst_attn(
+        ql, kl, vl, mesh=mesh, seq_axes=names, causal=True, layout="zigzag",
+        backend="pallas", block_q=16, block_kv=16,
+    )
+    dq_l, dk_l, dv_l = jax.grad(burst_loss, argnums=(0, 1, 2))(ql, kl, vl)
+    o = layouts.from_layout(o_l, "zigzag", W, 2)
+    dq = layouts.from_layout(dq_l, "zigzag", W, 2)
+    dk = layouts.from_layout(dk_l, "zigzag", W, 2)
+    dv = layouts.from_layout(dv_l, "zigzag", W, 2)
+    check_close(o, o_ref, rtol=2e-4, atol=2e-4, msg="pallas-ring o")
+    check_close(dq, dq_ref, rtol=2e-4, atol=2e-4, msg="pallas-ring dq")
+    check_close(dk, dk_ref, rtol=2e-4, atol=2e-4, msg="pallas-ring dk")
+    check_close(dv, dv_ref, rtol=2e-4, atol=2e-4, msg="pallas-ring dv")
+
+
 def test_bf16_reference_tolerance():
     """bf16 end-to-end within the reference's own tolerance convention
     (rtol 1e-3 / atol 1e-2 in half precision, test/checker.py:10)."""
