@@ -214,16 +214,17 @@ def _fwd_kernel(
     )
     full = _block_full(spec_ref, r0, c0, bq, bkv)
 
+    # scale (and the base-2 conversion) folded into the [bq, d] q block
+    # (one small mul, hoisted out of the sub-block loop) instead of the
+    # [bq, bkv] score matrix — the kernel is VPU-bound, not MXU-bound
+    q = q_ref[0, 0, :, :] * (scale * LOG2E)
+
     def _update(u, mask):
         """Fold kv sub-block u (bkv_compute wide) into the running state.
         The memory block (bkv) is split into compute sub-blocks (splash-style
         bkv vs bkv_compute) so sub-block u+1's score matmul is independent of
         sub-block u's VPU softmax chain — ILP the scheduler can overlap."""
         cs = pl.ds(u * bkv_compute, bkv_compute)
-        # scale (and the base-2 conversion) folded into the [bq, d] q block
-        # (one small mul) instead of the [bq, bkv] score matrix — the kernel
-        # is VPU-bound, not MXU-bound
-        q = q_ref[0, 0, :, :] * (scale * LOG2E)
         s = jax.lax.dot_general(
             q, k_ref[0, 0, cs, :], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Benchmark launcher (reference benchmarks/bench.sh:6 analogue).
+# Usage: scripts/bench.sh [extra args for benchmarks.benchmark]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python bench.py
+python -m benchmarks.benchmark --methods burst,flash --causal "$@"

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Test launcher (reference test/test.sh:6 analogue).  No torchrun, no GPU
+# fleet: the distributed tests run on a simulated 8-device CPU mesh anywhere;
+# pass --tpu to also run the real-hardware kernel tests on this machine.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q "${@/--tpu/}"
+if [[ " $* " == *" --tpu "* ]]; then
+  BURST_TESTS_TPU=1 python -m pytest tests/test_fused_bwd.py tests/test_pallas.py -q
+fi
