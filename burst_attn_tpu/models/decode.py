@@ -45,9 +45,46 @@ def init_cache(cfg: ModelConfig, batch: int, max_seq: int) -> Cache:
     return Cache(layers, jnp.int32(0))
 
 
-def _cached_attention(p, x, positions, lc: LayerCache, cache_len, cfg: ModelConfig):
+def _flash_prompt_attention(q, k, v, use_flash=None):
+    """Causal self-attention over a fresh prompt — O(T) memory via the flash
+    tile instead of the [T, max_seq] score matrix (which makes long-context
+    prefill impossible: 32 heads x 32K x 32K f32 scores is ~137 GB).
+
+    use_flash: None = auto (flash kernel on TPU, jnp tile elsewhere);
+    override for tests (the flash branch runs in interpret mode off-TPU).
+    """
+    t = q.shape[2]
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        from ..ops.pallas_flash import flash_attention
+
+        # pad to the kernel's tile granularity; CAUSAL masking keeps the
+        # zero-padded tail out of every real row's receptive field (col <=
+        # row: a padded column j >= t is visible only to padded rows i >= j)
+        pad = (-t) % 128
+        if pad:
+            cfgp = [(0, 0), (0, 0), (0, pad), (0, 0)]
+            q, k, v = (jnp.pad(a, cfgp) for a in (q, k, v))
+        o = flash_attention(q, k, v, None, True)
+        return o[:, :, :t] if pad else o
+    from ..ops.tile import single_device_attention
+
+    # GQA: the jnp tile wants equal heads; repeat K/V (CPU path, small)
+    group = q.shape[1] // k.shape[1]
+    if group > 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    return single_device_attention(q, k, v, causal=True)
+
+
+def _cached_attention(p, x, positions, lc: LayerCache, cache_len, cfg: ModelConfig,
+                      fresh: bool = False):
     """Attend the T new tokens against [cache .. cache+T); returns (out, new
-    LayerCache).  positions: [B, T] global positions of the new tokens."""
+    LayerCache).  positions: [B, T] global positions of the new tokens.
+    `fresh` (static) marks an empty cache — the prompt attends only to
+    itself, so the flash path applies and the cache buffers are write-only.
+    """
     b, t, _ = x.shape
     h = _rms_norm(x, p["attn_norm"])
     q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
@@ -59,19 +96,22 @@ def _cached_attention(p, x, positions, lc: LayerCache, cache_len, cfg: ModelConf
     ck = lax.dynamic_update_slice(lc.k, k.astype(lc.k.dtype), (0, 0, cache_len, 0))
     cv = lax.dynamic_update_slice(lc.v, v.astype(lc.v.dtype), (0, 0, cache_len, 0))
 
-    # GQA via a grouped query axis — never materialize a repeated cache (at
-    # decode the [B, Nkv, max_seq, D] buffers dominate memory traffic)
-    group = cfg.n_heads // cfg.n_kv_heads
-    qg = q.reshape(q.shape[0], cfg.n_kv_heads, group, t, cfg.d_head)
-    s = jnp.einsum(
-        "bngih,bnjh->bngij", qg, ck, preferred_element_type=jnp.float32
-    ) * (cfg.d_head**-0.5)
-    rows = jnp.arange(t, dtype=jnp.int32)[:, None]
-    cols = jnp.arange(ck.shape[2], dtype=jnp.int32)[None, :]
-    s = jnp.where(cols <= cache_len + rows, s, float("-inf"))
-    prob = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
-    o = jnp.einsum("bngij,bnjh->bngih", prob, cv)
-    o = o.reshape(q.shape[0], cfg.n_heads, t, cfg.d_head)
+    if fresh:
+        o = _flash_prompt_attention(q, k.astype(lc.k.dtype), v.astype(lc.v.dtype))
+    else:
+        # GQA via a grouped query axis — never materialize a repeated cache
+        # (at decode the [B, Nkv, max_seq, D] buffers dominate memory traffic)
+        group = cfg.n_heads // cfg.n_kv_heads
+        qg = q.reshape(q.shape[0], cfg.n_kv_heads, group, t, cfg.d_head)
+        s = jnp.einsum(
+            "bngih,bnjh->bngij", qg, ck, preferred_element_type=jnp.float32
+        ) * (cfg.d_head**-0.5)
+        rows = jnp.arange(t, dtype=jnp.int32)[:, None]
+        cols = jnp.arange(ck.shape[2], dtype=jnp.int32)[None, :]
+        s = jnp.where(cols <= cache_len + rows, s, float("-inf"))
+        prob = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+        o = jnp.einsum("bngij,bnjh->bngih", prob, cv)
+        o = o.reshape(q.shape[0], cfg.n_heads, t, cfg.d_head)
     out = jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
     return out, LayerCache(ck, cv)
 
@@ -82,10 +122,20 @@ def forward_cached(params, tokens, positions, cache: Cache, cfg: ModelConfig):
     tokens, positions: [B, T] int32 (natural order).  Returns (fp32 logits
     [B, T, vocab], updated Cache with length += T).
     """
+    return _forward_cached_impl(params, tokens, positions, cache, cfg, fresh=False)
+
+
+def _forward_cached_impl(params, tokens, positions, cache: Cache,
+                         cfg: ModelConfig, *, fresh: bool):
+    """`fresh` (static) asserts the cache is EMPTY, enabling the O(T)-memory
+    flash prefill path that ignores cache contents — which is why it is not
+    on the public signature: with a non-empty cache it would silently drop
+    all cached context.  `prefill` is the only fresh caller."""
     x = params["embed"].astype(cfg.dtype)[tokens]
     new_layers = []
     for p, lc in zip(params["layers"], cache.layers):
-        attn_out, lc = _cached_attention(p, x, positions, lc, cache.length, cfg)
+        attn_out, lc = _cached_attention(p, x, positions, lc, cache.length, cfg,
+                                         fresh=fresh)
         x = x + attn_out
         x = x + _mlp(p, x)
         new_layers.append(lc)
@@ -103,7 +153,7 @@ def prefill(params, tokens, cfg: ModelConfig, max_seq: int):
         raise ValueError(f"prompt length {t} exceeds max_seq {max_seq}")
     cache = init_cache(cfg, b, max_seq)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
-    return forward_cached(params, tokens, positions, cache, cfg)
+    return _forward_cached_impl(params, tokens, positions, cache, cfg, fresh=True)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "max_seq", "temperature"))

@@ -75,3 +75,21 @@ def test_generate_bounds(setup):
     prompt = jnp.zeros((1, 30), jnp.int32)
     with pytest.raises(ValueError, match="max_seq"):
         generate(params, prompt, cfg, steps=8, max_seq=32)
+
+
+def test_flash_prompt_attention_padded_matches_tile():
+    """The flash prefill branch (interpret mode off-TPU) with a prompt length
+    that is NOT a tile multiple must match the jnp tile path — covers the
+    causal-safe zero padding."""
+    from burst_attn_tpu.models.decode import _flash_prompt_attention
+
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    t = 19  # deliberately not a multiple of the 128 tile
+    q = jax.random.normal(kq, (1, 4, t, 16), jnp.float32)
+    k = jax.random.normal(kk, (1, 2, t, 16), jnp.float32)  # GQA group 2
+    v = jax.random.normal(kv, (1, 2, t, 16), jnp.float32)
+    o_flash = _flash_prompt_attention(q, k, v, use_flash=True)
+    o_tile = _flash_prompt_attention(q, k, v, use_flash=False)
+    np.testing.assert_allclose(np.asarray(o_flash), np.asarray(o_tile),
+                               rtol=2e-5, atol=2e-5)
