@@ -10,8 +10,52 @@ sequence length (reference README.md:81-85; BASELINE.md).
 """
 
 import json
+import subprocess
+import sys
+import time
+
+
+def _wait_for_tpu(attempts=6, probe_timeout=120, sleep_s=45) -> bool:
+    """The TPU is reached through a relay tunnel that can be down for tens of
+    minutes; a CPU-fallback bench line recorded in that window would misstate
+    the framework's performance.  Probe the backend in a SUBPROCESS (a hung
+    tunnel hangs `import jax` in-process, unrecoverable).
+
+    Only a probe TIMEOUT (tunnel hang) gets the long retry schedule — worst
+    case ~16 min, inside the ~20 min benchmark budget.  A fast nonzero exit
+    means this host simply has no TPU: give up after two tries with no
+    sleep, so CPU-only machines start the fallback immediately."""
+    fast_fails = 0
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert jax.default_backend() == 'tpu'"],
+                timeout=probe_timeout, capture_output=True,
+            )
+            if r.returncode == 0:
+                return True
+            fast_fails += 1
+            if fast_fails >= 2:
+                return False
+        except subprocess.TimeoutExpired:
+            pass
+        if i < attempts - 1:
+            print(f"bench: TPU probe {i + 1}/{attempts} failed; retrying",
+                  file=sys.stderr, flush=True)
+            time.sleep(sleep_s)
+    return False
+
+
+_TPU_UP = _wait_for_tpu()
 
 import jax
+
+if not _TPU_UP:
+    # pin to CPU BEFORE any backend init: with the tunnel down, letting jax
+    # try the TPU plugin hangs the process instead of falling back
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 from benchmarks.benchmark import bench_fn as _time  # single timing impl
