@@ -1,0 +1,95 @@
+"""Kernel block-size sweep on real TPU — finds the fwd/bwd block optimum
+that bench.py's defaults should use.
+
+Each fresh kernel shape is a 5-10 MINUTE remote compile through the axon
+tunnel; results append to a jsonl file immediately so an interrupted sweep
+keeps what it measured.  Run in the background:
+
+    python -m benchmarks.sweep_blocks --out /tmp/sweep.jsonl
+"""
+
+import argparse
+import json
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=65536)
+    p.add_argument("--heads", type=int, default=32)
+    p.add_argument("--kv-heads", type=int, default=None)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--out", default="sweep_blocks.jsonl")
+    p.add_argument("--fwd", default="2048x2048,2048x4096,1024x4096",
+                   help="comma list of BQxBKV (fwd), empty to skip")
+    p.add_argument("--bwd", default="1024x2048,1024x4096,2048x2048,512x4096",
+                   help="comma list of BQxBKV (bwd), empty to skip")
+    p.add_argument("--fwd-compute", default="",
+                   help="comma list of BQxBKVxBKC (fwd with compute sub-block)")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.benchmark import bench_fn, flops
+    from burst_attn_tpu.ops.pallas_flash import flash_attention
+
+    if jax.default_backend() != "tpu":
+        print("sweep_blocks: not on TPU; refusing to record numbers", file=sys.stderr)
+        sys.exit(1)
+
+    b, n, d, seq = 1, args.heads, args.dim, args.seq
+    nkv = args.kv_heads or n
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, n, seq, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, nkv, seq, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, nkv, seq, d), jnp.bfloat16)
+    do = jax.random.normal(kg, (b, n, seq, d), jnp.bfloat16)
+
+    def record(row):
+        row.update(seq=seq, heads=n, kv_heads=nkv, dim=d)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        print(json.dumps(row), flush=True)
+
+    def parse(spec):
+        return [tuple(int(x) for x in c.split("x")) for c in spec.split(",") if c]
+
+    for cfg in parse(args.fwd) + parse(args.fwd_compute):
+        bq, bkv = cfg[0], cfg[1]
+        bkc = cfg[2] if len(cfg) > 2 else None
+        try:
+            f = jax.jit(lambda q, k, v, bq=bq, bkv=bkv, bkc=bkc: jnp.sum(
+                flash_attention(q, k, v, None, True, bq, bkv,
+                                block_kv_compute=bkc).astype(jnp.float32)))
+            t = bench_fn(f, q, k, v)
+            record({"pass": "fwd", "bq": bq, "bkv": bkv, "bkc": bkc,
+                    "ms": round(t * 1e3, 2),
+                    "tflops": round(flops(b, seq, n, d, "fwd", True) / t / 1e12, 1)})
+        except Exception as e:  # noqa: BLE001 - record and continue the sweep
+            record({"pass": "fwd", "bq": bq, "bkv": bkv, "bkc": bkc,
+                    "error": f"{type(e).__name__}: {e}"[:200]})
+
+    for bqb, bkvb in parse(args.bwd):
+        try:
+            @jax.jit
+            def fb(q, k, v, do, bqb=bqb, bkvb=bkvb):
+                def loss(q, k, v):
+                    o = flash_attention(q, k, v, None, True, 2048, 2048, bqb, bkvb)
+                    return jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32))
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+                return (jnp.sum(dq.astype(jnp.float32))
+                        + jnp.sum(dk.astype(jnp.float32))
+                        + jnp.sum(dv.astype(jnp.float32)))
+            t = bench_fn(fb, q, k, v, do)
+            record({"pass": "fwd+bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
+                    "ms": round(t * 1e3, 2),
+                    "tflops": round(flops(b, seq, n, d, "fwd_bwd", True) / t / 1e12, 1)})
+        except Exception as e:  # noqa: BLE001
+            record({"pass": "fwd+bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
+                    "error": f"{type(e).__name__}: {e}"[:200]})
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,7 @@ from .parallel.burst import (
     burst_attn_func_striped,
 )
 from .parallel.ulysses import ulysses_attn
+from .parallel.pipeline import pipeline, stack_stages
 from .parallel import layouts
 from .ops import masks, tile, reference
 
@@ -34,6 +35,8 @@ __all__ = [
     "burst_attn_func",
     "burst_attn_func_striped",
     "ulysses_attn",
+    "pipeline",
+    "stack_stages",
     "layouts",
     "masks",
     "tile",

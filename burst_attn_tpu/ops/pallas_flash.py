@@ -779,20 +779,24 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
 # test/test_burst.py:175-184)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(q, k, v, scale=None, causal=False, block_q=2048, block_kv=2048,
-                    block_q_bwd=None, block_kv_bwd=None):
+                    block_q_bwd=None, block_kv_bwd=None, block_kv_compute=None):
     """Fused single-device flash attention.  q,k,v [B,N,S,D] -> o [B,N,S,D].
 
     Default block sizes are the measured v5e optimum at long seq (fwd likes
     2048x2048; the fused backward 1024x2048).  The bwd blocks default to
     None = derived from the fwd blocks (min(1024, block_q), block_kv) so a
-    caller who shrinks the fwd blocks for VMEM keeps that budget in bwd."""
-    o, _ = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv)
+    caller who shrinks the fwd blocks for VMEM keeps that budget in bwd.
+    block_kv_compute splits the fwd kv memory block into compute sub-blocks
+    (see flash_fwd)."""
+    o, _ = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
+                                     block_kv_compute)
     return o
 
 
-def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv):
+def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
+                              block_kv_compute=None):
     from .masks import round_spec
     from .tile import finalize as _finalize, init_state
 
@@ -802,20 +806,22 @@ def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv):
     spec = round_spec(jnp.int32(0), jnp.int32(0), s, k.shape[2], causal, "contig")
     m0, lse0, acc0 = init_state(b, n, s, d)
     m, lse, acc = flash_fwd(
-        q, k, v, m0, lse0, acc0, scale, spec, block_q=block_q, block_kv=block_kv
+        q, k, v, m0, lse0, acc0, scale, spec, block_q=block_q, block_kv=block_kv,
+        block_kv_compute=block_kv_compute,
     )
     o = _finalize(m, lse, acc, q.dtype)
     return o, lse
 
 
 def _flash_attention_vjp_fwd(q, k, v, scale, causal, block_q, block_kv,
-                             block_q_bwd, block_kv_bwd):
-    o, lse = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv)
+                             block_q_bwd, block_kv_bwd, block_kv_compute):
+    o, lse = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
+                                       block_kv_compute)
     return o, (q, k, v, o, lse)
 
 
 def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
-                             block_kv_bwd, res, do):
+                             block_kv_bwd, block_kv_compute, res, do):
     from .masks import round_spec
 
     q, k, v, o, lse = res
