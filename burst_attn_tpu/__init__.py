@@ -25,6 +25,7 @@ from .parallel.burst import (
 )
 from .parallel.ulysses import ulysses_attn
 from .parallel.pipeline import pipeline, stack_stages
+from .parallel.moe import MoEParams, init_moe_params, moe_apply
 from .parallel import layouts
 from .ops import masks, tile, reference
 
@@ -37,6 +38,9 @@ __all__ = [
     "ulysses_attn",
     "pipeline",
     "stack_stages",
+    "MoEParams",
+    "init_moe_params",
+    "moe_apply",
     "layouts",
     "masks",
     "tile",
