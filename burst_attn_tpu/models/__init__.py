@@ -1,4 +1,4 @@
-from .transformer import ModelConfig, init_params, forward, param_specs
+from .transformer import ModelConfig, init_params, forward, forward_with_aux, param_specs
 from .train import TrainConfig, make_mesh, init_train_state, train_step, loss_fn
 from .decode import Cache, forward_cached, generate, init_cache, prefill
 
@@ -6,6 +6,7 @@ __all__ = [
     "ModelConfig",
     "init_params",
     "forward",
+    "forward_with_aux",
     "param_specs",
     "TrainConfig",
     "make_mesh",

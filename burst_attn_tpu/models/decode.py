@@ -137,7 +137,8 @@ def _forward_cached_impl(params, tokens, positions, cache: Cache,
         attn_out, lc = _cached_attention(p, x, positions, lc, cache.length, cfg,
                                          fresh=fresh)
         x = x + attn_out
-        x = x + _mlp(p, x)
+        m, _ = _mlp(p, x, cfg, inference=True)  # drop-free capacity; aux unused
+        x = x + m
         new_layers.append(lc)
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum(

@@ -139,6 +139,8 @@ def main(argv=None):
     p.add_argument("--n-kv-heads", type=int, default=None)
     p.add_argument("--d-ff", type=int, default=None)
     p.add_argument("--layout", default="zigzag")
+    p.add_argument("--n-experts", type=int, default=0,
+                   help="MoE experts per layer (0 = dense MLP)")
     p.add_argument("--no-remat", action="store_true")
     p.add_argument("--multihost", action="store_true",
                    help="call multihost.initialize() before touching jax")
@@ -160,10 +162,18 @@ def main(argv=None):
         mesh_axes.setdefault("sp", 1)
     mesh = make_mesh(mesh_axes)
     n_heads = args.n_heads
+    # experts shard over a dedicated "ep" axis when the mesh has one, else
+    # ride the dp axis (the classic GShard data+expert layout)
+    expert_axis = None
+    if args.n_experts:
+        expert_axis = "ep" if "ep" in mesh_axes else (
+            "dp" if "dp" in mesh_axes else None)
     cfg = ModelConfig(
         seq_axes=seq_axes,
         batch_axis="dp" if "dp" in mesh_axes else None,
         head_axis="tp" if "tp" in mesh_axes else None,
+        n_experts=args.n_experts,
+        expert_axis=expert_axis,
         vocab=args.vocab,
         d_model=args.d_model,
         n_layers=args.n_layers,

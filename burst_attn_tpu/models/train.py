@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .transformer import ModelConfig, forward, init_params, param_specs
+from .transformer import ModelConfig, forward, forward_with_aux, init_params, param_specs
 from ..parallel import layouts
 
 
@@ -35,6 +35,7 @@ class TrainConfig:
     b1: float = 0.9
     b2: float = 0.95
     grad_clip: float = 1.0
+    moe_aux_weight: float = 0.01  # weight of the MoE load-balancing loss
 
 
 def make_mesh(axis_sizes: dict, devices=None) -> Mesh:
@@ -109,15 +110,18 @@ def init_train_state(key, cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     return jax.jit(init_fn, out_shardings=out_shardings)(key)
 
 
-def loss_fn(params, tokens, positions, labels, cfg: ModelConfig, mesh):
-    """Mean next-token cross entropy (fp32).  labels < 0 are masked out."""
-    logits = forward(params, tokens, positions, cfg, mesh)
+def loss_fn(params, tokens, positions, labels, cfg: ModelConfig, mesh,
+            moe_aux_weight: float = 0.0):
+    """Mean next-token cross entropy (fp32) + weighted MoE aux loss.
+    labels < 0 are masked out."""
+    logits, aux = forward_with_aux(params, tokens, positions, cfg, mesh)
     valid = labels >= 0
     labels_safe = jnp.where(valid, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels_safe[..., None], axis=-1)[..., 0]
     nll = jnp.where(valid, nll, 0.0)
-    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+    ce = jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+    return ce + moe_aux_weight * aux
 
 
 def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
@@ -131,7 +135,8 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     def step(state, batch):
         params, opt_state = state
         loss, grads = jax.value_and_grad(loss_fn)(
-            params, batch["tokens"], batch["positions"], batch["labels"], cfg, mesh
+            params, batch["tokens"], batch["positions"], batch["labels"], cfg, mesh,
+            moe_aux_weight=tcfg.moe_aux_weight if cfg.n_experts else 0.0,
         )
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

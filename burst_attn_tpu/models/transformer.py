@@ -55,6 +55,13 @@ class ModelConfig:
     block_q: int = 2048  # kernel blocks, clamped down for short shards
     block_kv: int = 2048
     remat: bool = True  # jax.checkpoint each block: FLOPs for HBM
+    # MoE (parallel/moe.py): n_experts=0 -> dense SwiGLU MLP.  With experts,
+    # every layer's MLP becomes a top-k routed MoE; expert_axis names the
+    # mesh axis experts shard over (GSPMD inserts the dispatch collectives)
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    expert_axis: Optional[str] = None
 
 
 Params = Dict[str, Any]
@@ -76,19 +83,28 @@ def init_params(key, cfg: ModelConfig) -> Params:
     layers = []
     for lk in keys[: cfg.n_layers]:
         ks = _split(lk, 6)
-        layers.append(
-            {
-                "attn_norm": jnp.ones((d,), jnp.float32),
-                "wq": dense(ks[0], (d, nh, hd)),
-                "wk": dense(ks[1], (d, nkv, hd)),
-                "wv": dense(ks[2], (d, nkv, hd)),
-                "wo": dense(ks[3], (nh, hd, d)),
-                "mlp_norm": jnp.ones((d,), jnp.float32),
-                "w_gate": dense(ks[4], (d, f)),
-                "w_up": dense(ks[5], (d, f)),
-                "w_down": dense(_split(ks[5], 2)[1], (f, d)),
-            }
-        )
+        layer = {
+            "attn_norm": jnp.ones((d,), jnp.float32),
+            "wq": dense(ks[0], (d, nh, hd)),
+            "wk": dense(ks[1], (d, nkv, hd)),
+            "wv": dense(ks[2], (d, nkv, hd)),
+            "wo": dense(ks[3], (nh, hd, d)),
+            "mlp_norm": jnp.ones((d,), jnp.float32),
+        }
+        if cfg.n_experts:
+            from ..parallel.moe import init_moe_params
+
+            layer.update(
+                **init_moe_params(ks[4], d, f, cfg.n_experts,
+                                  dtype=cfg.dtype)._asdict()
+            )
+        else:
+            layer.update(
+                w_gate=dense(ks[4], (d, f)),
+                w_up=dense(ks[5], (d, f)),
+                w_down=dense(_split(ks[5], 2)[1], (f, d)),
+            )
+        layers.append(layer)
     return {
         "embed": init(keys[-2], (cfg.vocab, d), cfg.dtype),
         "layers": layers,
@@ -112,10 +128,25 @@ def param_specs(cfg: ModelConfig) -> Params:
         "wv": P(None, tp, None),
         "wo": P(tp, None, None),
         "mlp_norm": P(None),
-        "w_gate": P(None, tp),
-        "w_up": P(None, tp),
-        "w_down": P(tp, None),
     }
+    if cfg.n_experts:
+        # experts shard over expert_axis ONLY (the _mlp shard_map slices the
+        # same way); sharding their ffn dim over tp as well would need a
+        # row-parallel psum inside the expert MLP — replication across tp is
+        # the simpler trade at these expert sizes
+        ep = cfg.expert_axis
+        layer.update(
+            router=P(None, None),
+            w_gate=P(ep, None, None),
+            w_up=P(ep, None, None),
+            w_down=P(ep, None, None),
+        )
+    else:
+        layer.update(
+            w_gate=P(None, tp),
+            w_up=P(None, tp),
+            w_down=P(tp, None),
+        )
     return {
         "embed": P(tp, None),
         "layers": [layer] * cfg.n_layers,
@@ -189,16 +220,105 @@ def _attention(p, x, positions, cfg: ModelConfig, mesh):
     return jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
 
 
-def _mlp(p, x):
+def _mlp(p, x, cfg: Optional[ModelConfig] = None, mesh=None, inference=False):
+    """Dense SwiGLU, or (cfg.n_experts > 0) a routed MoE.  Returns
+    (out, aux_loss) — aux is 0 for the dense path so callers are uniform.
+
+    MoE routing is PER SHARD (GShard): tokens route within their
+    (batch, seq)-shard's group, so the [T, E, C] dispatch tensors stay
+    O(local_tokens^2) instead of O(global_tokens^2) — routing the global
+    token set as one group is quadratically infeasible at long sequence.
+    `inference=True` sizes capacity drop-free (tokens x top_k): silently
+    zeroing a token's MLP output is a training-time trade, not an
+    inference-time one.
+    """
     h = _rms_norm(x, p["mlp_norm"])
+    if cfg is not None and cfg.n_experts:
+        from ..parallel.moe import MoEParams, moe_shard
+
+        mp = MoEParams(p["router"], p["w_gate"], p["w_up"], p["w_down"])
+        token_axes = tuple(
+            a for a in (cfg.batch_axis, *cfg.seq_axes) if a is not None
+        )
+        # single-program callers (decode) have no mesh: no expert axis, no
+        # cross-shard aux reduction
+        ep_axis = cfg.expert_axis if mesh is not None else None
+        # Drop-free inference routes in CHUNKS: capacity == chunk size is
+        # drop-free (a token contributes at most one slot per expert), and
+        # chunking keeps the [chunk, E, chunk] dispatch tensors O(chunk^2)
+        # instead of O(T^2) on long prefills.  Chunking is exact when
+        # nothing drops — routing is per-token.
+        chunk = 512
+
+        def route(mp, h2, cap):
+            y, aux, _ = moe_shard(
+                mp, h2, top_k=cfg.moe_top_k, capacity=cap, axis=ep_axis
+            )
+            return y, aux
+
+        def group(mp, h):
+            bb, ss, dd = h.shape
+            tokens = bb * ss
+            h2 = h.reshape(tokens, dd)
+            if inference:
+                c = min(chunk, tokens)
+                if tokens % c or ep_axis is not None:
+                    # ragged, or collectives in route (vmap of all_to_all is
+                    # not supported): one drop-free group
+                    y, aux = route(mp, h2, tokens)
+                else:
+                    yc, aux = jax.vmap(lambda hc: route(mp, hc, c))(
+                        h2.reshape(tokens // c, c, dd)
+                    )
+                    y, aux = yc.reshape(tokens, dd), jnp.mean(aux)
+            else:
+                cap = max(1, int(cfg.moe_capacity_factor * cfg.moe_top_k
+                                 * tokens / cfg.n_experts))
+                y, aux = route(mp, h2, cap)
+            # moe_shard pmeans over the expert axis; average the remaining
+            # token-sharding axes so aux is replicated
+            rest = tuple(a for a in token_axes if a != ep_axis)
+            if mesh is not None and rest:
+                aux = jax.lax.pmean(aux, rest)
+            return y.reshape(bb, ss, dd), aux
+
+        if mesh is None:  # single-program path (e.g. decode off-mesh)
+            y, aux = group(mp, h)
+            return y, aux
+
+        seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
+        ep = cfg.expert_axis
+        if ep is not None:
+            ep_size = mesh.shape[ep]
+            if cfg.n_experts % ep_size:
+                raise ValueError(
+                    f"n_experts {cfg.n_experts} not divisible by "
+                    f"expert_axis {ep!r} size {ep_size}")
+        pspec = MoEParams(P(None, None), P(ep, None, None),
+                          P(ep, None, None), P(ep, None, None))
+        y, aux = jax.shard_map(
+            group, mesh=mesh,
+            in_specs=(pspec, P(cfg.batch_axis, seq_spec, None)),
+            out_specs=(P(cfg.batch_axis, seq_spec, None), P()),
+            check_vma=False,
+        )(mp, h)
+        return y, aux
     gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"])
     up = jnp.einsum("bsd,df->bsf", h, p["w_up"])
-    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
+    out = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
+    return out, jnp.float32(0.0)
 
 
 def forward(params: Params, tokens, positions, cfg: ModelConfig, mesh) -> jax.Array:
     """tokens, positions: [B, S] int32 (layout order). Returns fp32 logits
     [B, S, vocab]."""
+    logits, _ = forward_with_aux(params, tokens, positions, cfg, mesh)
+    return logits
+
+
+def forward_with_aux(params: Params, tokens, positions, cfg: ModelConfig, mesh):
+    """forward + the summed MoE auxiliary load-balancing loss (0 for dense
+    models); the trainer adds `moe_aux_weight * aux` to the objective."""
     from jax.sharding import NamedSharding
 
     seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
@@ -208,19 +328,23 @@ def forward(params: Params, tokens, positions, cfg: ModelConfig, mesh) -> jax.Ar
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = jax.lax.with_sharding_constraint(x, act_spec)
 
-    def block(x, p):
+    def block(carry, p):
+        x, aux = carry
         x = x + _attention(p, x, positions, cfg, mesh)
-        x = x + _mlp(p, x)
-        return jax.lax.with_sharding_constraint(x, act_spec)
+        m, aux_l = _mlp(p, x, cfg, mesh)
+        x = jax.lax.with_sharding_constraint(x + m, act_spec)
+        return x, aux + aux_l
 
+    carry = (x, jnp.float32(0.0))
     for p in params["layers"]:
         if cfg.remat:
-            x = jax.checkpoint(block)(x, p)
+            carry = jax.checkpoint(block)(carry, p)
         else:
-            x = block(x, p)
+            carry = block(carry, p)
+    x, aux = carry
 
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum(
         "bsd,vd->bsv", x, params["lm_head"], preferred_element_type=jnp.float32
     )
-    return jax.lax.with_sharding_constraint(logits, logit_spec)
+    return jax.lax.with_sharding_constraint(logits, logit_spec), aux

@@ -71,3 +71,72 @@ def test_double_ring_model():
     batch = make_batch(jax.random.PRNGKey(1), cfg, mesh, batch=2, seq=64)
     state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_moe_model_trains():
+    """MoE layers in the flagship LM: sharded train step runs, loss finite
+    and decreasing-ish, router receives gradient through the gates."""
+    cfg = ModelConfig(**{**CFG, "n_experts": 4, "expert_axis": "dp",
+                         "moe_capacity_factor": 4.0, "remat": False})
+    tcfg = TrainConfig(lr=1e-3)
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh)
+    router_before = np.asarray(state[0]["layers"][0]["router"])
+    step = make_train_step(cfg, tcfg, mesh)
+    batch = make_batch(jax.random.PRNGKey(1), cfg, mesh, batch=2, seq=64)
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # same batch thrice must reduce loss
+    # the router must actually learn: gradient flows through the gates
+    router_after = np.asarray(state[0]["layers"][0]["router"])
+    assert np.max(np.abs(router_after - router_before)) > 0
+
+
+def test_moe_forward_matches_dense_expert_compute():
+    """With identical experts and ample capacity, the MoE model's forward
+    equals the dense model whose MLP weights are that shared expert (gates
+    sum to 1), pinning routing+combine correctness at the model level."""
+    from burst_attn_tpu.models import forward_with_aux
+
+    cfg_moe = ModelConfig(**{**CFG, "n_experts": 4, "moe_capacity_factor": 8.0,
+                             "layout": "contig", "remat": False})
+    cfg_dense = ModelConfig(**{**CFG, "layout": "contig", "remat": False})
+    params = init_params(jax.random.PRNGKey(0), cfg_moe)
+    # make all experts identical to expert 0
+    for layer in params["layers"]:
+        for name in ("w_gate", "w_up", "w_down"):
+            layer[name] = jnp.broadcast_to(layer[name][:1], layer[name].shape)
+    dense = init_params(jax.random.PRNGKey(0), cfg_dense)
+    for dl, ml in zip(dense["layers"], params["layers"]):
+        for shared in ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"):
+            dl[shared] = ml[shared]
+        for name in ("w_gate", "w_up", "w_down"):
+            dl[name] = ml[name][0]
+    dense["embed"], dense["final_norm"], dense["lm_head"] = (
+        params["embed"], params["final_norm"], params["lm_head"])
+
+    b, seq = 2, 32
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (b, seq), 0, cfg_moe.vocab)
+    pos = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None], (b, seq))
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
+    lm, aux = forward_with_aux(params, tokens, pos, cfg_moe, mesh)
+    ld = forward(dense, tokens, pos, cfg_dense, mesh)
+    check_close(lm, ld, rtol=2e-4, atol=2e-4, msg="moe==dense w/ tied experts")
+    assert float(aux) > 0
+
+
+def test_moe_model_trains_with_remat():
+    """The production default (remat=True: jax.checkpoint over the MoE
+    shard_map with the (x, aux) carry) must train."""
+    cfg = ModelConfig(**{**CFG, "n_experts": 4, "expert_axis": "dp",
+                         "moe_capacity_factor": 4.0, "remat": True})
+    tcfg = TrainConfig(lr=1e-3)
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh)
+    step = make_train_step(cfg, tcfg, mesh)
+    batch = make_batch(jax.random.PRNGKey(1), cfg, mesh, batch=2, seq=64)
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
