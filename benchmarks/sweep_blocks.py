@@ -23,7 +23,8 @@ def main():
     p.add_argument("--fwd", default="2048x2048,2048x4096,1024x4096",
                    help="comma list of BQxBKV (fwd), empty to skip")
     p.add_argument("--bwd", default="1024x2048,1024x4096,2048x2048,512x4096",
-                   help="comma list of BQxBKV (bwd), empty to skip")
+                   help="comma list of BQxBKV (bwd-only, fused kernel) or "
+                        "BQxBKVxsplit (split dq / dkdv kernels); empty to skip")
     p.add_argument("--fwd-compute", default="",
                    help="comma list of BQxBKVxBKC (fwd with compute sub-block)")
     args = p.parse_args()
@@ -71,24 +72,51 @@ def main():
             record({"pass": "fwd", "bq": bq, "bkv": bkv, "bkc": bkc,
                     "error": f"{type(e).__name__}: {e}"[:200]})
 
-    for bqb, bkvb in parse(args.bwd):
+    bwd_cfgs = [c for c in args.bwd.split(",") if c]
+    if bwd_cfgs:
+        # bwd-only timing isolates the kernel being tuned: one fwd run
+        # provides the (lse, delta) inputs every bwd config reuses
+        from burst_attn_tpu.ops.masks import round_spec
+        from burst_attn_tpu.ops.pallas_flash import (
+            _flash_attention_fwd_impl, flash_bwd,
+        )
+
+        scale = d**-0.5
+        spec = round_spec(jnp.int32(0), jnp.int32(0), seq, seq, True, "contig")
+
+        @jax.jit
+        def prep(q, k, v, do):
+            o, lse = _flash_attention_fwd_impl(q, k, v, None, True, 2048, 2048)
+            delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), -1)
+            return delta, lse
+
         try:
-            @jax.jit
-            def fb(q, k, v, do, bqb=bqb, bkvb=bkvb):
-                def loss(q, k, v):
-                    o = flash_attention(q, k, v, None, True, 2048, 2048, bqb, bkvb)
-                    return jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32))
-                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-                return (jnp.sum(dq.astype(jnp.float32))
-                        + jnp.sum(dk.astype(jnp.float32))
-                        + jnp.sum(dv.astype(jnp.float32)))
-            t = bench_fn(fb, q, k, v, do)
-            record({"pass": "fwd+bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
-                    "ms": round(t * 1e3, 2),
-                    "tflops": round(flops(b, seq, n, d, "fwd_bwd", True) / t / 1e12, 1)})
-        except Exception as e:  # noqa: BLE001
-            record({"pass": "fwd+bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
-                    "error": f"{type(e).__name__}: {e}"[:200]})
+            delta, lse = jax.block_until_ready(prep(q, k, v, do))
+        except Exception as e:  # noqa: BLE001 - record so the sweep's silence
+            record({"pass": "bwd", "error": f"prep: {type(e).__name__}: {e}"[:200]})
+            return
+
+        for c in bwd_cfgs:
+            parts = c.split("x")
+            bqb, bkvb = int(parts[0]), int(parts[1])
+            if len(parts) > 2 and parts[2] != "split":
+                record({"pass": "bwd", "error": f"bad config {c!r}: third "
+                        "token must be 'split'"})
+                continue
+            fused = len(parts) <= 2
+            try:
+                f = jax.jit(lambda q, k, v, do, delta, lse, bqb=bqb, bkvb=bkvb,
+                            fused=fused: sum(
+                    jnp.sum(g.astype(jnp.float32)) for g in flash_bwd(
+                        do, q, k, v, delta, lse, scale, spec,
+                        block_q=bqb, block_kv=bkvb, fused=fused)))
+                t = bench_fn(f, q, k, v, do, delta, lse)
+                record({"pass": "bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
+                        "fused": fused, "ms": round(t * 1e3, 2),
+                        "tflops": round(flops(b, seq, n, d, "bwd", True) / t / 1e12, 1)})
+            except Exception as e:  # noqa: BLE001
+                record({"pass": "bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
+                        "fused": fused, "error": f"{type(e).__name__}: {e}"[:200]})
 
 
 if __name__ == "__main__":
