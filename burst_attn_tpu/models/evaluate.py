@@ -1,0 +1,66 @@
+"""Held-out evaluation: mean next-token cross entropy / perplexity over a
+token file, using the same sharded forward as training (no optimizer)."""
+
+import math
+
+import jax
+import numpy as np
+
+from .train import batch_from_host, loss_fn
+from .transformer import ModelConfig
+from ..data import DataLoader
+
+
+def make_eval_step(cfg: ModelConfig, mesh):
+    """Jitted (params, batch) -> mean cross entropy (no MoE aux term)."""
+
+    def step(params, batch):
+        return loss_fn(params, batch["tokens"], batch["positions"],
+                       batch["labels"], cfg, mesh)
+
+    return jax.jit(step)
+
+
+class Evaluator:
+    """Reusable held-out eval: the jitted step is compiled once and the
+    (sequential, unshuffled) loader stays open across rounds — a long run's
+    periodic evals pay execution cost only, not an XLA recompile plus a
+    loader setup per round.  Each __call__ rewinds to the stream start so
+    every eval sees the same batches."""
+
+    def __init__(self, cfg: ModelConfig, mesh, data_path, *, batch: int,
+                 seq_len: int, max_batches: int = 32):
+        self._step = make_eval_step(cfg, mesh)
+        self._cfg, self._mesh = cfg, mesh
+        self._loader = DataLoader(
+            data_path, batch, seq_len,
+            shard_id=jax.process_index(), num_shards=jax.process_count(),
+            shuffle=False,
+        )
+        self._n = min(max_batches,
+                      max(1, self._loader.windows_per_epoch // batch))
+
+    def __call__(self, params) -> dict:
+        self._loader.seek(0)
+        losses = []
+        for _ in range(self._n):
+            x, y = self._loader.next()
+            losses.append(
+                self._step(params, batch_from_host(x, y, self._cfg, self._mesh)))
+        loss = float(np.mean([float(l) for l in losses]))
+        return {"eval_loss": loss, "ppl": math.exp(min(loss, 50.0))}
+
+    def close(self):
+        self._loader.close()
+
+
+def evaluate(params, cfg: ModelConfig, mesh, data_path, *, batch: int,
+             seq_len: int, max_batches: int = 32, seed: int = 1):
+    """One-shot convenience wrapper around Evaluator."""
+    del seed  # sequential eval is deterministic; kept for API stability
+    ev = Evaluator(cfg, mesh, data_path, batch=batch, seq_len=seq_len,
+                   max_batches=max_batches)
+    try:
+        return ev(params)
+    finally:
+        ev.close()

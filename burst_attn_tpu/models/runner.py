@@ -46,6 +46,9 @@ class RunConfig:
     log_every: int = 10
     seed: int = 0
     loader_threads: int = 2
+    eval_data_path: Optional[str] = None
+    eval_every: int = 500
+    eval_batches: int = 16
 
 
 def fit(cfg: ModelConfig, tcfg: TrainConfig, run: RunConfig, mesh):
@@ -73,6 +76,26 @@ def fit(cfg: ModelConfig, tcfg: TrainConfig, run: RunConfig, mesh):
     step_fn = make_train_step(cfg, tcfg, mesh)
     timer = StepTimer()
     history = []
+
+    evaluator = None
+    if run.eval_data_path:
+        from .evaluate import Evaluator
+
+        evaluator = Evaluator(
+            cfg, mesh, run.eval_data_path, batch=run.batch,
+            seq_len=run.seq_len, max_batches=run.eval_batches,
+        )
+
+    def maybe_eval(step):
+        if evaluator is None:
+            return
+        if (step + 1) % run.eval_every and step + 1 != run.steps:
+            return
+        metrics = evaluator(state[0])
+        row = {"step": step + 1, **{k: round(v, 4) for k, v in metrics.items()}}
+        history.append(row)
+        if primary:
+            log.info("%s", json.dumps(row))
     try:
         with DataLoader(
             run.data_path, run.batch, run.seq_len,
@@ -96,6 +119,7 @@ def fit(cfg: ModelConfig, tcfg: TrainConfig, run: RunConfig, mesh):
                     history.append(row)
                     if primary:
                         log.info("%s", json.dumps(row))
+                maybe_eval(step)
                 if ckpt and ((step + 1) % run.ckpt_every == 0 or step + 1 == run.steps):
                     ckpt.save(step + 1, state)
     finally:
@@ -103,6 +127,8 @@ def fit(cfg: ModelConfig, tcfg: TrainConfig, run: RunConfig, mesh):
         # crash case is exactly when the newest checkpoint matters
         if ckpt:
             ckpt.close()
+        if evaluator is not None:
+            evaluator.close()
     s = timer.summary()
     if s["steps"] and primary:
         log.info("done: %d steps, mean %.3fs/step", s["steps"], s["mean_s"])
@@ -130,8 +156,13 @@ def main(argv=None):
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=500)
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--eval-data", default=None,
+                   help="held-out BATD token file (perplexity eval)")
+    p.add_argument("--eval-every", type=int, default=500)
+    p.add_argument("--eval-batches", type=int, default=16)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--grad-accum", type=int, default=1)
     p.add_argument("--vocab", type=int, default=32768)
     p.add_argument("--d-model", type=int, default=1024)
     p.add_argument("--n-layers", type=int, default=8)
@@ -184,11 +215,13 @@ def main(argv=None):
         layout=args.layout,
         remat=not args.no_remat,
     )
-    tcfg = TrainConfig(lr=args.lr)
+    tcfg = TrainConfig(lr=args.lr, grad_accum=args.grad_accum)
     run = RunConfig(
         data_path=args.data, steps=args.steps, batch=args.batch,
         seq_len=args.seq_len, ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every, log_every=args.log_every, seed=args.seed,
+        eval_data_path=args.eval_data, eval_every=args.eval_every,
+        eval_batches=args.eval_batches,
     )
     fit(cfg, tcfg, run, mesh)
 

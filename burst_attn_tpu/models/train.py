@@ -36,6 +36,7 @@ class TrainConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     moe_aux_weight: float = 0.01  # weight of the MoE load-balancing loss
+    grad_accum: int = 1  # microbatches per optimizer step (scan inside jit)
 
 
 def make_mesh(axis_sizes: dict, devices=None) -> Mesh:
@@ -110,17 +111,22 @@ def init_train_state(key, cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     return jax.jit(init_fn, out_shardings=out_shardings)(key)
 
 
-def loss_fn(params, tokens, positions, labels, cfg: ModelConfig, mesh,
-            moe_aux_weight: float = 0.0):
-    """Mean next-token cross entropy (fp32) + weighted MoE aux loss.
-    labels < 0 are masked out."""
+def _loss_parts(params, tokens, positions, labels, cfg: ModelConfig, mesh):
+    """(sum of masked nll, MoE aux) — the linear pieces of the objective."""
     logits, aux = forward_with_aux(params, tokens, positions, cfg, mesh)
     valid = labels >= 0
     labels_safe = jnp.where(valid, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels_safe[..., None], axis=-1)[..., 0]
-    nll = jnp.where(valid, nll, 0.0)
-    ce = jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(jnp.where(valid, nll, 0.0)), aux
+
+
+def loss_fn(params, tokens, positions, labels, cfg: ModelConfig, mesh,
+            moe_aux_weight: float = 0.0):
+    """Mean next-token cross entropy (fp32) + weighted MoE aux loss.
+    labels < 0 are masked out."""
+    nll_sum, aux = _loss_parts(params, tokens, positions, labels, cfg, mesh)
+    ce = nll_sum / jnp.maximum(jnp.sum(labels >= 0), 1)
     return ce + moe_aux_weight * aux
 
 
@@ -131,13 +137,59 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     sharded (dp, sp).
     """
     opt = _optimizer(tcfg)
+    aux_w = tcfg.moe_aux_weight if cfg.n_experts else 0.0
+    accum = tcfg.grad_accum
+
+    def grad_of(params, batch):
+        return jax.value_and_grad(loss_fn)(
+            params, batch["tokens"], batch["positions"], batch["labels"], cfg,
+            mesh, moe_aux_weight=aux_w,
+        )
 
     def step(state, batch):
         params, opt_state = state
-        loss, grads = jax.value_and_grad(loss_fn)(
-            params, batch["tokens"], batch["positions"], batch["labels"], cfg, mesh,
-            moe_aux_weight=tcfg.moe_aux_weight if cfg.n_experts else 0.0,
-        )
+        if accum == 1:
+            loss, grads = grad_of(params, batch)
+        else:
+            b0 = batch["tokens"].shape[0]
+            if b0 % accum:
+                raise ValueError(f"batch {b0} not divisible by grad_accum {accum}")
+            if cfg.batch_axis is not None:
+                dp = mesh.shape[cfg.batch_axis]
+                if (b0 // accum) % dp:
+                    raise ValueError(
+                        f"microbatch {b0 // accum} (batch {b0} / grad_accum "
+                        f"{accum}) not divisible by {cfg.batch_axis!r} mesh "
+                        f"size {dp}")
+            # split the batch dim into `accum` microbatches inside ONE jit —
+            # large effective batch, constant memory.  The masked mean is
+            # normalized by the GLOBAL valid count (known upfront from the
+            # labels alone), so uneven masking across microbatches yields
+            # exactly the full-batch objective: the aux term is folded into
+            # each microbatch scalar with weight v_total/accum so one grad
+            # accumulation covers both pieces.
+            v_total = jnp.maximum(
+                jnp.sum(batch["labels"] >= 0).astype(jnp.float32), 1.0)
+            mb = jax.tree.map(
+                lambda a: a.reshape(accum, a.shape[0] // accum, *a.shape[1:]),
+                batch,
+            )
+
+            def micro_scalar(params, micro):
+                nll_sum, aux = _loss_parts(
+                    params, micro["tokens"], micro["positions"],
+                    micro["labels"], cfg, mesh)
+                return nll_sum + aux_w * aux * (v_total / accum)
+
+            def body(carry, micro):
+                s_c, grads_c = carry
+                s, grads = jax.value_and_grad(micro_scalar)(params, micro)
+                return (s_c + s, jax.tree.map(jnp.add, grads_c, grads)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (s_sum, grads), _ = jax.lax.scan(body, (jnp.float32(0.0), zeros), mb)
+            loss = s_sum / v_total
+            grads = jax.tree.map(lambda g: g / v_total, grads)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         gnorm = optax.global_norm(grads)
