@@ -25,6 +25,7 @@ import numpy as np
 
 from .train import (
     TrainConfig, batch_from_host, init_train_state, make_mesh, make_train_step,
+    prefetch_batches,
 )
 from .transformer import ModelConfig
 from ..data import DataLoader
@@ -104,10 +105,11 @@ def fit(cfg: ModelConfig, tcfg: TrainConfig, run: RunConfig, mesh):
         ) as dl:
             if start_step:
                 dl.seek(start_step)
+            batches = prefetch_batches(dl, cfg, mesh)
             for step in range(start_step, run.steps):
-                x, y = dl.next()
+                batch = next(batches)
                 with timer as t:
-                    state, metrics = step_fn(state, batch_from_host(x, y, cfg, mesh))
+                    state, metrics = step_fn(state, batch)
                     t.watch(state)
                 if (step + 1) % run.log_every == 0 or step + 1 == run.steps:
                     row = {

@@ -237,6 +237,30 @@ def batch_from_host(tokens, labels, cfg: ModelConfig, mesh: Mesh):
     }
 
 
+def prefetch_batches(dl, cfg: ModelConfig, mesh: Mesh, depth: int = 2):
+    """Generator keeping `depth` device batches in flight: host->device
+    transfer of batch N+1..N+depth overlaps the step running on batch N
+    (device_put is async; the loader's worker threads fill the windows).
+    `dl` is a data.DataLoader (or any (inputs, targets) iterator)."""
+    from collections import deque
+
+    q = deque()
+    it = iter(dl)
+    try:
+        for _ in range(depth):
+            x, y = next(it)
+            q.append(batch_from_host(x, y, cfg, mesh))
+        while True:
+            nxt = q.popleft()
+            x, y = next(it)
+            q.append(batch_from_host(x, y, cfg, mesh))
+            yield nxt
+    except StopIteration:
+        pass  # finite iterator: drain what is already in flight
+    while q:
+        yield q.popleft()
+
+
 def make_batch(key, cfg: ModelConfig, mesh: Mesh, batch: int, seq: int):
     """Synthetic LM batch in layout order, placed with (dp, sp) sharding."""
     world = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))

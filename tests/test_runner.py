@@ -155,3 +155,16 @@ def test_grad_accum_exact_with_uneven_masking(data_path):
         return float(metrics["loss"])
 
     assert abs(loss_with(1) - loss_with(2)) < 1e-5
+
+
+def test_prefetch_drains_finite_iterator():
+    from burst_attn_tpu.models.train import prefetch_batches
+
+    mesh = make_mesh({"sp": 2})
+    cfg = _cfg(batch_axis=None, head_axis=None, layout="contig")
+    rng = np.random.default_rng(0)
+    src = [(rng.integers(0, 512, (2, 128)), rng.integers(0, 512, (2, 128)))
+           for _ in range(5)]
+    out = list(prefetch_batches(iter(src), cfg, mesh, depth=2))
+    assert len(out) == 5
+    np.testing.assert_array_equal(np.asarray(out[-1]["tokens"]), src[-1][0])
