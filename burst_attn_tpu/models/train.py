@@ -250,14 +250,13 @@ def prefetch_batches(dl, cfg: ModelConfig, mesh: Mesh, depth: int = 2):
         for _ in range(depth):
             x, y = next(it)
             q.append(batch_from_host(x, y, cfg, mesh))
-        while True:
-            nxt = q.popleft()
-            x, y = next(it)
-            q.append(batch_from_host(x, y, cfg, mesh))
-            yield nxt
     except StopIteration:
-        pass  # finite iterator: drain what is already in flight
-    while q:
+        pass  # source shorter than depth
+    else:
+        for x, y in it:
+            q.append(batch_from_host(x, y, cfg, mesh))
+            yield q.popleft()
+    while q:  # finite iterator: drain what is already in flight
         yield q.popleft()
 
 
