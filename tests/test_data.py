@@ -115,3 +115,18 @@ def test_too_small_file_rejected(tmp_path):
     write_token_file(p, np.arange(10))
     with pytest.raises(ValueError):
         DataLoader(p, batch=1, seq_len=100)
+
+
+def test_prepare_cli_byte_level(tmp_path):
+    from burst_attn_tpu.data.prepare import main
+
+    a, b = tmp_path / "a.txt", tmp_path / "b.txt"
+    a.write_text("hello world")
+    b.write_text("abc")
+    out = tmp_path / "corpus.batd"
+    main([str(a), str(b), "--out", str(out), "--vocab-offset", "2",
+          "--doc-sep", "1"])
+    toks = read_token_file(out)
+    assert len(toks) == 11 + 1 + 3
+    assert toks[11] == 1  # separator between docs
+    assert toks[0] == ord("h") + 2
