@@ -1,6 +1,7 @@
 from .transformer import ModelConfig, init_params, forward, forward_with_aux, param_specs
 from .train import TrainConfig, make_mesh, init_train_state, train_step, loss_fn
 from .decode import Cache, forward_cached, generate, init_cache, prefill
+from .dist_decode import DistCache, dist_generate, dist_prefill
 
 __all__ = [
     "ModelConfig",
@@ -18,4 +19,7 @@ __all__ = [
     "generate",
     "init_cache",
     "prefill",
+    "DistCache",
+    "dist_generate",
+    "dist_prefill",
 ]

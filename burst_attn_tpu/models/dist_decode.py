@@ -1,0 +1,233 @@
+"""Distributed long-context inference: ring prefill with a SEQUENCE-SHARDED
+KV cache, then LSE-merged decode across the shards.
+
+models/decode.py keeps the whole cache on one replica — fine up to the HBM
+of a single chip, but this framework's point is sequences that need the
+ring.  Here the prompt's KV cache never leaves its sequence shards:
+
+  * prefill: the training forward (burst ring attention over `sp`, any
+    layout) runs once over the prompt, capturing each layer's rope'd K/V.
+    The cache stays sharded [B, Nkv, S/W, D] per device, in LAYOUT order —
+    decode never needs the order: a new token attends ALL cached tokens, and
+    attention is permutation-invariant when everything is visible.
+  * decode: per layer, the new token's q computes a PARTIAL online-softmax
+    against the local cache shard; the partials merge across the `sp` axis
+    in log space (pmax of the row max, psum of the rescaled sum/accumulator
+    — the same merge the ring uses, ops/tile.py), then merge once more with
+    a small REPLICATED buffer holding the tokens generated so far.  New
+    tokens append to that replicated buffer: O(steps) memory, no shard
+    surgery, exact attention.
+
+Single-axis sp mesh (pass the same mesh used for prefill). Generated-token
+budget = the replicated buffer size = `steps`.
+"""
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .transformer import ModelConfig, _mlp, _rms_norm, _rope
+from ..parallel import layouts
+from ..parallel.burst import burst_attn
+
+
+class DistCache(NamedTuple):
+    # per layer, sequence-sharded over sp (layout order), dtype = cfg.dtype
+    k_shard: Tuple[jax.Array, ...]   # each [B, Nkv, S, D]
+    v_shard: Tuple[jax.Array, ...]
+    # per layer, replicated recent-token buffers
+    k_new: Tuple[jax.Array, ...]     # each [B, Nkv, R, D]
+    v_new: Tuple[jax.Array, ...]
+    n_new: jax.Array                 # scalar int32: valid positions in *_new
+
+
+def _qkv(p, x, positions, cfg):
+    h = _rms_norm(x, p["attn_norm"])
+    q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
+    k = jnp.einsum("bsd,dnh->bnsh", h, p["wk"])
+    v = jnp.einsum("bsd,dnh->bnsh", h, p["wv"])
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def dist_prefill(params, tokens, cfg: ModelConfig, mesh, *, gen_budget: int):
+    """Absorb a [B, S] prompt (natural order) with the sharded forward.
+
+    Returns (last_logits [B, vocab] fp32, DistCache).  S must divide by the
+    sp world; gen_budget sizes the replicated recent-KV buffers.
+    """
+    b, s = tokens.shape
+    world = 1
+    for a in cfg.seq_axes:
+        world *= mesh.shape[a]
+    perm = layouts.seq_permutation(cfg.layout, s, world)
+    pos = jnp.broadcast_to(jnp.asarray(perm, jnp.int32)[None, :], (b, s))
+    tokens_l = jnp.take(tokens, jnp.asarray(perm), axis=1)
+
+    seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
+    act_spec = NamedSharding(mesh, P(cfg.batch_axis, seq_spec, None))
+    kv_spec = NamedSharding(mesh, P(cfg.batch_axis, None, seq_spec, None))
+
+    x = params["embed"].astype(cfg.dtype)[tokens_l]
+    x = lax.with_sharding_constraint(x, act_spec)
+    ks, vs = [], []
+    for p in params["layers"]:
+        q, k, v = _qkv(p, x, pos, cfg)
+        k = lax.with_sharding_constraint(k.astype(cfg.dtype), kv_spec)
+        v = lax.with_sharding_constraint(v.astype(cfg.dtype), kv_spec)
+        ks.append(k)
+        vs.append(v)
+        o = burst_attn(
+            q, k, v, mesh=mesh, seq_axes=cfg.seq_axes, causal=cfg.causal,
+            layout=cfg.layout, backend=cfg.attn_backend,
+            block_q=cfg.block_q, block_kv=cfg.block_kv,
+            batch_axes=cfg.batch_axis, head_axes=cfg.head_axis,
+        )
+        x = x + jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+        # inference=True: drop-free MoE routing, matching decode.py's prefill
+        m, _ = _mlp(p, x, cfg, mesh, inference=True)
+        x = lax.with_sharding_constraint(x + m, act_spec)
+
+    xf = _rms_norm(x, params["final_norm"])
+    # only ONE position feeds decoding; the full [B, S, vocab] fp32 logits
+    # would be GBs at the contexts this module exists for.  The LAST token
+    # in natural order sits at layout position inv_perm[s-1].
+    last_pos = int(layouts.inverse_permutation(perm)[s - 1])
+    last_logits = jnp.einsum("bd,vd->bv", xf[:, last_pos], params["lm_head"],
+                             preferred_element_type=jnp.float32)
+
+    shape_new = (b, cfg.n_kv_heads, gen_budget, cfg.d_head)
+    zeros_new = tuple(jnp.zeros(shape_new, cfg.dtype)
+                      for _ in range(cfg.n_layers))
+    cache = DistCache(tuple(ks), tuple(vs), zeros_new,
+                      tuple(jnp.zeros(shape_new, cfg.dtype)
+                            for _ in range(cfg.n_layers)),
+                      jnp.int32(0))
+    return last_logits, cache
+
+
+def _merge(parts):
+    """Log-space merge of [(m, l, acc)] partials (m [B,N,1], l [B,N,1],
+    acc [B,N,1,D] unnormalized)."""
+    m_g = parts[0][0]
+    for m, _, _ in parts[1:]:
+        m_g = jnp.maximum(m_g, m)
+    l_g = sum(l * jnp.exp(m - m_g) for m, l, _ in parts)
+    acc_g = sum(acc * jnp.exp(m - m_g)[..., None] for m, _, acc in parts)
+    return acc_g / jnp.maximum(l_g, 1e-30)[..., None]
+
+
+def _partial_attn(q, k, v, scale, n_valid=None):
+    """Unnormalized online-softmax partial of q [B,N,1,D] against k/v
+    [B,Nk,T,D]; positions >= n_valid masked.  Returns (m, l, acc) with
+    leading [B, N, 1] shape.  GQA via a grouped query axis — the dominant
+    cache buffers are never repeated (decode.py's convention)."""
+    b, n, _, d = q.shape
+    nk, t = k.shape[1], k.shape[2]
+    qg = q.reshape(b, nk, n // nk, 1, d)
+    s = jnp.einsum("bngid,bnjd->bngij", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    if n_valid is not None:
+        cols = jnp.arange(t, dtype=jnp.int32)
+        s = jnp.where(cols[None, None, None, None, :] < n_valid, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)
+    # fully-masked partial (empty recent buffer): exp(-inf - -inf) guard
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bngij,bnjd->bngid", p, v.astype(jnp.float32))
+    m = jnp.where(jnp.isfinite(m), m, -1e30)  # neutral under max-merge
+    return (m.reshape(b, n, 1), l.reshape(b, n, 1),
+            acc.reshape(b, n, 1, d))
+
+
+def dist_decode_step(params, token, position, cache: DistCache,
+                     cfg: ModelConfig, mesh):
+    """One token: [B] int32 -> (fp32 logits [B, vocab], updated cache)."""
+    sp_axes = cfg.seq_axes
+    scale = cfg.d_head**-0.5
+
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B,1,d]
+    pos = jnp.broadcast_to(position[None, None], (x.shape[0], 1)).astype(jnp.int32)
+
+    k_new, v_new = [], []
+    for li, p in enumerate(params["layers"]):
+        q, k, v = _qkv(p, x, pos, cfg)
+
+        def shard_partial(q, kc, vc):
+            m, l, acc = _partial_attn(q, kc, vc, scale)
+            # merge across the sequence shards in log space
+            m_g = lax.pmax(m, sp_axes)
+            w = jnp.exp(m - m_g)
+            l_g = lax.psum(l * w, sp_axes)
+            acc_g = lax.psum(acc * w[..., None], sp_axes)
+            return m_g, l_g, acc_g
+
+        seq_spec = sp_axes if len(sp_axes) > 1 else sp_axes[0]
+        m_c, l_c, acc_c = jax.shard_map(
+            shard_partial, mesh=mesh,
+            in_specs=(P(cfg.batch_axis, None, None, None),
+                      P(cfg.batch_axis, None, seq_spec, None),
+                      P(cfg.batch_axis, None, seq_spec, None)),
+            out_specs=(P(cfg.batch_axis, None, None),
+                       P(cfg.batch_axis, None, None),
+                       P(cfg.batch_axis, None, None, None)),
+            check_vma=False,
+        )(q, cache.k_shard[li], cache.v_shard[li])
+
+        # recent generated tokens (replicated) + the token being computed
+        kr = lax.dynamic_update_slice(
+            cache.k_new[li], k.astype(cfg.dtype), (0, 0, cache.n_new, 0))
+        vr = lax.dynamic_update_slice(
+            cache.v_new[li], v.astype(cfg.dtype), (0, 0, cache.n_new, 0))
+        k_new.append(kr)
+        v_new.append(vr)
+        m_r, l_r, acc_r = _partial_attn(q, kr, vr, scale,
+                                        n_valid=cache.n_new + 1)
+        o = _merge([(m_c, l_c, acc_c), (m_r, l_r, acc_r)]).astype(cfg.dtype)
+        x = x + jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+        m_out, _ = _mlp(p, x, cfg, inference=True)
+        x = x + m_out
+
+    xf = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,vd->bsv", xf, params["lm_head"],
+                        preferred_element_type=jnp.float32)[:, 0]
+    cache = DistCache(cache.k_shard, cache.v_shard, tuple(k_new),
+                      tuple(v_new), cache.n_new + 1)
+    return logits, cache
+
+
+def dist_generate(params, prompt, cfg: ModelConfig, mesh, *, steps: int,
+                  temperature: float = 0.0, rng=None):
+    """Greedy/sampled generation with the sequence-sharded prompt cache.
+
+    prompt [B, S] natural order; returns [B, steps] tokens.  The decode loop
+    is a python loop over jitted steps (the cache pytree's shardings are
+    stable, so each step reuses one compiled program).
+    """
+    b, s = prompt.shape
+    last_logits, cache = jax.jit(
+        partial(dist_prefill, cfg=cfg, mesh=mesh, gen_budget=steps)
+    )(params, prompt)
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+
+    def pick(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    step_fn = jax.jit(partial(dist_decode_step, cfg=cfg, mesh=mesh))
+    keys = jax.random.split(rng, steps + 1)
+    token = pick(last_logits, keys[0])
+    out = [token]
+    for i in range(steps - 1):
+        logits, cache = step_fn(params, token, jnp.int32(s + i), cache)
+        token = pick(logits, keys[i + 1])
+        out.append(token)
+    return jnp.stack(out, axis=1)
