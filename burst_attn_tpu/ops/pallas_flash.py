@@ -219,43 +219,72 @@ def _fwd_kernel(
     # [bq, bkv] score matrix — the kernel is VPU-bound, not MXU-bound
     q = q_ref[0, 0, :, :] * (scale * LOG2E)
 
-    def _update(u, mask):
-        """Fold kv sub-block u (bkv_compute wide) into the running state.
-        The memory block (bkv) is split into compute sub-blocks (splash-style
-        bkv vs bkv_compute) so sub-block u+1's score matmul is independent of
-        sub-block u's VPU softmax chain — ILP the scheduler can overlap."""
+    def _score(u):
         cs = pl.ds(u * bkv_compute, bkv_compute)
-        s = jax.lax.dot_general(
+        return jax.lax.dot_general(
             q, k_ref[0, 0, cs, :], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    def _softmax(s, mask, m_prev, l_prev):
+        """VPU half of one sub-block fold: returns (m_new, l_new, alpha, p)."""
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
-        m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.where(m_prev >= m_new, 1.0, jnp.exp2(m_prev - m_new))
         p = jnp.exp2(s - m_new)
         if mask is not None:
             # guards the all-masked-row nan (s = m_new = -inf)
             p = jnp.where(mask, p, 0.0)
-        m_scr[:] = m_new
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0, 0, cs, :]
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype) if cast_p else p,
-            v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        return m_new, l_new, alpha, p.astype(v_ref.dtype) if cast_p else p
+
+    def _pv(u, p):
+        cs = pl.ds(u * bkv_compute, bkv_compute)
+        return jax.lax.dot_general(
+            p, v_ref[0, 0, cs, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    def _sweep(masked):
+        """Three-stage software pipeline over compute sub-blocks (splash-style
+        bkv vs bkv_compute).  With in-order issue and async MXU execution, the
+        stagger means no MXU op ever waits on the VPU softmax chain:
+
+            issue s(u+1)      [MXU]  — independent of everything in flight
+            softmax(u)        [VPU]  — consumes s(u), overlaps s(u+1)
+            acc += pv(u-1)    [MXU]  — its p tile was finished LAST iteration
+
+        The acc update is deferred one sub-block (the alpha rescale composes:
+        acc_u = acc_{u-1}*alpha_u + pv_u applied one step late), drained after
+        the loop.  State (m, l, acc) is loop-carried by VALUE and written back
+        to scratch once per grid step.  With a single sub-block
+        (bkv_compute == bkv) this degenerates to the plain serial fold."""
+        m, l, acc = m_scr[:], l_scr[:], acc_scr[:]
+        n_sub = bkv // bkv_compute
+        s_cur = _score(0)
+        pend = None  # (u, alpha, p) awaiting its pv matmul + acc fold
+        for u in range(n_sub):
+            s_next = _score(u + 1) if u + 1 < n_sub else None
+            mask = (
+                _block_mask(spec_ref, r0, c0 + u * bkv_compute, bq, bkv_compute)
+                if masked else None
+            )
+            m, l, alpha, p = _softmax(s_cur, mask, m, l)
+            if pend is not None:
+                acc = acc * pend[1] + _pv(pend[0], pend[2])
+            pend = (u, alpha, p)
+            s_cur = s_next
+        acc = acc * pend[1] + _pv(pend[0], pend[2])
+        m_scr[:], l_scr[:], acc_scr[:] = m, l, acc
 
     @pl.when(live & full)
     def _compute_fast():
-        for u in range(bkv // bkv_compute):
-            _update(u, None)
+        _sweep(False)
 
     @pl.when(live & ~full)
     def _compute_masked():
-        for u in range(bkv // bkv_compute):
-            _update(u, _block_mask(spec_ref, r0, c0 + u * bkv_compute, bq, bkv_compute))
+        _sweep(True)
 
     @pl.when(j == n_kv_blocks - 1)
     def _finish():
@@ -275,8 +304,10 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
 
     q [B,N,S,D]; k, v [B,Nk,Skv,D] (GQA when Nk < N); m, lse [B,N,S] f32;
     acc [B,N,S,D] f32.  `spec` scalars may be traced values.
-    `block_kv_compute` (<= block_kv, default equal) sets the in-kernel
-    compute sub-block width (see _fwd_kernel._update).
+    `block_kv_compute` (<= block_kv) sets the in-kernel compute sub-block
+    width (see _fwd_kernel._sweep); the default min(block_kv, 1024) is the
+    measured v5e optimum (two pipelined sub-blocks per 2048 memory block:
+    150 vs 134 TFLOPs/s plain at seq=64K; 512 regresses).
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -285,7 +316,9 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     group = _gqa_group(n, n_kv)
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
-    bkc = bkv if block_kv_compute is None else _pick_block(bkv, block_kv_compute)
+    if block_kv_compute is None:
+        block_kv_compute = min(bkv, 1024)
+    bkc = _pick_block(bkv, block_kv_compute)
     lp = _pick_block(bq, 128)
     nqb = s_q // bq
     nkb = s_kv // bkv
@@ -379,12 +412,13 @@ def _dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        p = jnp.exp2(s - lse_scr[:])
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)
+        # dp is independent of the softmax: issue it before the VPU chain
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        p = jnp.exp2(s - lse_scr[:])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         # the trailing *scale of ds is deferred to _finish (constant across j)
         ds = p * (dp - delta_scr[:])
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
@@ -450,19 +484,20 @@ def _dkdv_kernel(
             q * (scale * LOG2E), k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        # dp is independent of the softmax: issue it before the VPU chain
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
         p = jnp.exp2(s - lse_row)
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
+        # trailing *scale of ds deferred to _finish; dk uses the RAW q block
+        ds = p * (dp - delta_row)
         # dv += p^T @ do
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        # trailing *scale of ds deferred to _finish; dk uses the RAW q block
-        ds = p * (dp - delta_row)
         # dk += ds^T @ q
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -508,7 +543,7 @@ def _bwd_fused_kernel(
     spec_ref,
     do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref, dq_in_ref,
     dq_out_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr,
+    dk_scr, dv_scr, p_pend, ds_pend, do_pend, q_pend, pend_flag,
     *, scale, bq, bkv, lp, n_q_blocks, group,
 ):
     j = pl.program_id(2)
@@ -521,6 +556,7 @@ def _bwd_fused_kernel(
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
+        pend_flag[0] = 0
 
     imin = _q_imin(spec_ref, j, bq, bkv, n_q_blocks)
     # clamped steps (iq < imin) revisit block imin, whose live visit came just
@@ -529,6 +565,29 @@ def _bwd_fused_kernel(
     clamped = iq < imin
     live = _block_has_work(spec_ref, r0, c0, bq, bkv) & ~clamped
     full = _block_full(spec_ref, r0, c0, bq, bkv)
+
+    def _flush():
+        """Deferred dv/dk accumulation for the previous live step's tiles.
+        Issued at step START, before this step's s/dp matmuls, so the MXU
+        queue [dv, dk, s, dp] is entirely independent of this step's VPU
+        softmax chain — the chain overlaps those four matmuls instead of
+        stalling the dv/dk/dq ones every step.  (Measured on v5e: flush
+        first 169.6 TFLOPs/s; flush nested after s/dp inside the compute
+        branches 165.2; no deferral at all 166.5 — the conditional nesting
+        costs more than the reordering buys.)"""
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p_pend[:], do_pend[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds_pend[:], q_pend[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        pend_flag[0] = 0
+
+    @pl.when(pend_flag[0] == 1)
+    def _flush_prev():
+        _flush()
 
     def _accum(mask):
         q = q_ref[0, 0, :, :]
@@ -539,31 +598,33 @@ def _bwd_fused_kernel(
         lse_row = jnp.where(lse_row == NEG_INF, BIG_LSE, lse_row * LOG2E)
         delta_row = _read_rows(delta_ref, iq, bq, lp)
 
+        # s and dp are independent MXU ops issued back to back; the VPU
+        # p/ds chain overlaps them and the flush matmuls queued next
         s = jax.lax.dot_general(
             q * (scale * LOG2E), k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        p = jnp.exp2(s - lse_row)
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)
-        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        p = jnp.exp2(s - lse_row)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         ds = p * (dp - delta_row)
-        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
         # in-place dq accumulation (ds*scale deferred to the caller's epilog
         # would lose the per-visit accumulation — apply it here instead)
         dq_out_ref[0, 0, :, :] = dq_in_ref[0, 0, :, :] + scale * jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        # dv/dk contributions are NOT applied here: stash the tiles (in the
+        # bf16 the matmuls would cast to anyway — numerics unchanged) and let
+        # the next step's _flush issue them behind its own s/dp
+        p_pend[:] = p.astype(do.dtype)
+        ds_pend[:] = ds.astype(q.dtype)
+        do_pend[:] = do
+        q_pend[:] = q
+        pend_flag[0] = 1
 
     @pl.when(live & full)
     def _compute_fast():
@@ -581,6 +642,11 @@ def _bwd_fused_kernel(
 
     @pl.when(t == n_q_blocks * group - 1)
     def _finish():
+        # drain: this sweep's last live step just stashed its pend tiles
+        @pl.when(pend_flag[0] == 1)
+        def _drain():
+            _flush()
+
         dk_ref[0, 0, :, :] = dk_scr[:] * scale
         dv_ref[0, 0, :, :] = dv_scr[:]
 
@@ -639,6 +705,14 @@ def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
             scratch_shapes=[
                 pltpu.VMEM((bkv, d), jnp.float32),
                 pltpu.VMEM((bkv, d), jnp.float32),
+                # deferred-flush pend tiles (see _bwd_fused_kernel._flush);
+                # p/do pend follow do.dtype (p is cast to it), ds/q follow
+                # q.dtype — flash_bwd allows do.dtype != q.dtype
+                pltpu.VMEM((bq, bkv), do.dtype),
+                pltpu.VMEM((bq, bkv), q.dtype),
+                pltpu.VMEM((bq, d), do.dtype),
+                pltpu.VMEM((bq, d), q.dtype),
+                pltpu.SMEM((1,), jnp.int32),
             ],
         ),
         out_shape=[

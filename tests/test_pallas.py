@@ -81,8 +81,14 @@ def test_bwd_matches_tile(qkv, layout, qp, kp, causal):
         np.testing.assert_allclose(y, x, rtol=1e-4, atol=1e-4, err_msg=name)
 
 
-@pytest.mark.parametrize("block_q,block_kv", [(16, 32), (32, 16), (64, 64)])
-def test_block_shape_independence(qkv, block_q, block_kv):
+@pytest.mark.parametrize(
+    "block_q,block_kv,block_kv_compute",
+    [(16, 32, None), (32, 16, None), (64, 64, None),
+     # sub-block pipeline (_fwd_kernel._sweep with n_sub > 1) — the
+     # production default is two 1024-wide sub-blocks per 2048 memory block
+     (16, 32, 8), (32, 32, 16), (64, 64, 16)],
+)
+def test_block_shape_independence(qkv, block_q, block_kv, block_kv_compute):
     """Different tilings must give the same numerics (mask/bounds logic)."""
     q, k, v, _ = qkv
     spec = round_spec(jnp.int32(1), jnp.int32(1), S, S, True, "zigzag")
@@ -90,7 +96,7 @@ def test_block_shape_independence(qkv, block_q, block_kv):
     ref = tile.tile_fwd(q, k, v, *st, SCALE, spec)
     got = pallas_flash.flash_fwd(
         q, k, v, *st, SCALE, spec, block_q=block_q, block_kv=block_kv,
-        interpret=True, cast_p=False,
+        block_kv_compute=block_kv_compute, interpret=True, cast_p=False,
     )
     np.testing.assert_allclose(got[2], ref[2], rtol=1e-4, atol=1e-4)
 
