@@ -23,8 +23,9 @@ def main():
     p.add_argument("--fwd", default="2048x2048,2048x4096,1024x4096",
                    help="comma list of BQxBKV (fwd), empty to skip")
     p.add_argument("--bwd", default="1024x2048,1024x4096,2048x2048,512x4096",
-                   help="comma list of BQxBKV (bwd-only, fused kernel) or "
-                        "BQxBKVxsplit (split dq / dkdv kernels); empty to skip")
+                   help="comma list of BQxBKV (bwd-only, fused kernel), "
+                        "BQxBKVxsplit (split dq / dkdv kernels), or "
+                        "BQxBKVxtri (wrapped-diagonal causal grid); empty to skip")
     p.add_argument("--fwd-compute", default="",
                    help="comma list of BQxBKVxBKC (fwd with compute sub-block)")
     args = p.parse_args()
@@ -33,7 +34,7 @@ def main():
     import jax.numpy as jnp
 
     from benchmarks.benchmark import bench_fn, flops
-    from burst_attn_tpu.ops.pallas_flash import flash_attention
+    from burst_attn_tpu.ops.pallas_flash import flash_attention, tri_bwd_supported
 
     if jax.default_backend() != "tpu":
         print("sweep_blocks: not on TPU; refusing to record numbers", file=sys.stderr)
@@ -99,24 +100,32 @@ def main():
         for c in bwd_cfgs:
             parts = c.split("x")
             bqb, bkvb = int(parts[0]), int(parts[1])
-            if len(parts) > 2 and parts[2] != "split":
+            if len(parts) > 2 and parts[2] not in ("split", "tri"):
                 record({"pass": "bwd", "error": f"bad config {c!r}: third "
-                        "token must be 'split'"})
+                        "token must be 'split' or 'tri'"})
                 continue
-            fused = len(parts) <= 2
+            fused = len(parts) <= 2 or parts[2] == "tri"
+            tri = len(parts) > 2 and parts[2] == "tri"
+            # record which kernel actually runs: flash_bwd silently falls
+            # back to the rectangular fused kernel when the tri gate fails
+            tri_eff = tri and tri_bwd_supported(
+                seq, seq, n, nkv, d, block_q=bqb, block_kv=bkvb)
+            row = {"pass": "bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
+                   "fused": fused, "tri": tri_eff}
+            if tri and not tri_eff:
+                row["tri_requested_fell_back"] = True
             try:
                 f = jax.jit(lambda q, k, v, do, delta, lse, bqb=bqb, bkvb=bkvb,
-                            fused=fused: sum(
+                            fused=fused, tri=tri: sum(
                     jnp.sum(g.astype(jnp.float32)) for g in flash_bwd(
                         do, q, k, v, delta, lse, scale, spec,
-                        block_q=bqb, block_kv=bkvb, fused=fused)))
+                        block_q=bqb, block_kv=bkvb, fused=fused, triangular=tri)))
                 t = bench_fn(f, q, k, v, do, delta, lse)
-                record({"pass": "bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
-                        "fused": fused, "ms": round(t * 1e3, 2),
-                        "tflops": round(flops(b, seq, n, d, "bwd", True) / t / 1e12, 1)})
+                row.update(ms=round(t * 1e3, 2),
+                           tflops=round(flops(b, seq, n, d, "bwd", True) / t / 1e12, 1))
             except Exception as e:  # noqa: BLE001
-                record({"pass": "bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
-                        "fused": fused, "error": f"{type(e).__name__}: {e}"[:200]})
+                row.update(ms=None, error=f"{type(e).__name__}: {e}"[:200])
+            record(row)
 
 
 if __name__ == "__main__":

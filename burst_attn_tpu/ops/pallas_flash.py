@@ -187,19 +187,47 @@ def _unpack(x):
 # forward
 
 
+def _tri_coords(nqb):
+    """Wrapped-diagonal coordinates for the static-causal triangular grid.
+
+    Grid dims (b, h, p, j') with p in [0, nqb/2), j' in [0, nqb+1): row pair
+    p covers q-block p (kv-blocks 0..p, segment A = j' <= p) then q-block
+    nqb-1-p (kv-blocks 0..nqb-1-p, segment B) — (p+1) + (nqb-p) = nqb+1
+    steps, ALL live.  The rectangular grid spends ~half its steps on
+    clamped/dead causal blocks (~1.9us each of pure grid overhead on v5e at
+    seq=64K, where causal fwd measured 150 TFLOPs/s vs 172 non-causal —
+    the all-live grid closes most of that gap; the measured value is
+    recorded in README.md's performance section and sweep_blocks output).
+    Requires block_q == block_kv and an even q-block count."""
+    p_ = pl.program_id(2)
+    j_ = pl.program_id(3)
+    segb = j_ > p_
+    i = jnp.where(segb, nqb - 1 - p_, p_)
+    j = jnp.where(segb, j_ - p_ - 1, j_)
+    is_init = (j_ == 0) | (j_ == p_ + 1)
+    is_fin = (j_ == p_) | (j_ == nqb)
+    return i, j, is_init, is_fin
+
+
 def _fwd_kernel(
     spec_ref,
     q_ref, k_ref, v_ref, m_in_ref, lse_in_ref, acc_in_ref,
     m_out_ref, lse_out_ref, acc_out_ref,
     m_scr, l_scr, acc_scr,
-    *, scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p,
+    *, scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p, tri,
 ):
-    i = pl.program_id(2)
-    j = pl.program_id(3)
+    if tri:
+        nqb = n_kv_blocks  # square: bq == bkv, s_q == s_kv
+        i, j, is_init, is_fin = _tri_coords(nqb)
+    else:
+        i = pl.program_id(2)
+        j = pl.program_id(3)
+        is_init = j == 0
+        is_fin = j == n_kv_blocks - 1
     r0 = i * bq
     c0 = j * bkv
 
-    @pl.when(j == 0)
+    @pl.when(is_init)
     def _init():
         m0 = _read_rows(m_in_ref, i, bq, lp)
         lse0 = _read_rows(lse_in_ref, i, bq, lp)
@@ -209,10 +237,18 @@ def _fwd_kernel(
         l_scr[:] = jnp.where(m0 == NEG_INF, 0.0, jnp.exp(lse0 - m0))
         acc_scr[:] = acc_in_ref[0, 0, :, :]
 
-    live = _block_has_work(spec_ref, r0, c0, bq, bkv) & (
-        j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks)
-    )
-    full = _block_full(spec_ref, r0, c0, bq, bkv)
+    if tri:
+        # every tri step is live; only the diagonal (segment-end) block is
+        # partially masked
+        fast_cond = ~is_fin
+        masked_cond = is_fin
+    else:
+        live = _block_has_work(spec_ref, r0, c0, bq, bkv) & (
+            j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks)
+        )
+        full = _block_full(spec_ref, r0, c0, bq, bkv)
+        fast_cond = live & full
+        masked_cond = live & ~full
 
     # scale (and the base-2 conversion) folded into the [bq, d] q block
     # (one small mul, hoisted out of the sub-block loop) instead of the
@@ -278,15 +314,15 @@ def _fwd_kernel(
         acc = acc * pend[1] + _pv(pend[0], pend[2])
         m_scr[:], l_scr[:], acc_scr[:] = m, l, acc
 
-    @pl.when(live & full)
+    @pl.when(fast_cond)
     def _compute_fast():
         _sweep(False)
 
-    @pl.when(live & ~full)
+    @pl.when(masked_cond)
     def _compute_masked():
         _sweep(True)
 
-    @pl.when(j == n_kv_blocks - 1)
+    @pl.when(is_fin)
     def _finish():
         m = m_scr[:] * LN2  # back to the natural-log domain
         l = l_scr[:]
@@ -298,7 +334,7 @@ def _fwd_kernel(
 
 def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
               block_q=1024, block_kv=1024, block_kv_compute=None,
-              interpret=None, cast_p=True):
+              interpret=None, cast_p=True, triangular=False):
     """One online-softmax ring round on TPU.  Same contract as
     ops/tile.py:tile_fwd: returns updated (m, lse, acc).
 
@@ -308,6 +344,16 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     width (see _fwd_kernel._sweep); the default min(block_kv, 1024) is the
     measured v5e optimum (two pipelined sub-blocks per 2048 memory block:
     150 vs 134 TFLOPs/s plain at seq=64K; 512 regresses).
+
+    `triangular=True` selects the wrapped-diagonal all-live grid (see
+    _tri_coords) — valid ONLY when the caller statically knows `spec` is
+    full-window causal: q_lo=0, q_hi=S, kv_hi=S, causal, offset in {0, -1}
+    (at block granularity both offsets have work confined to kv-block
+    j <= q-block i with only the diagonal block partial, which is what the
+    grid assumes; the diagonal's mask itself uses the real spec scalars, so
+    both offsets compute correctly — the striped ring rounds rely on this).
+    Falls back to the rectangular grid when the square-tiling preconditions
+    don't hold.
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -322,12 +368,24 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     lp = _pick_block(bq, 128)
     nqb = s_q // bq
     nkb = s_kv // bkv
-    q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group)
+    tri = bool(triangular) and bq == bkv and s_q == s_kv and nqb % 2 == 0 and nqb >= 2
+    if tri:
+        def q_map(b_, h, p, jp, sp):
+            return (b_, h, jnp.where(jp > p, nqb - 1 - p, p), 0)
 
-    grid = (b, n, nqb, nkb)
+        def kv_map(b_, h, p, jp, sp):
+            return (b_, h // group, jnp.where(jp > p, jp - p - 1, jp), 0)
+
+        def state_map(b_, h, p, jp, sp):
+            return (b_, h, 0, 0)
+
+        grid = (b, n, nqb // 2, nqb + 1)
+    else:
+        q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group)
+        grid = (b, n, nqb, nkb)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, bq=bq, bkv=bkv, bkv_compute=bkc, lp=lp,
-        n_kv_blocks=nkb, cast_p=cast_p,
+        n_kv_blocks=nkb, cast_p=cast_p, tri=tri,
     )
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     out_shape = [
@@ -519,6 +577,70 @@ def _dkdv_kernel(
 
 
 # ---------------------------------------------------------------------------
+# shared fused-backward tile body (used by both the rectangular and the
+# wrapped-diagonal fused kernels, which differ only in scheduling and in
+# where dq accumulates — threaded in via `dq_update`)
+
+
+def _flush_dk(dk_scr, ds_pend, q_pend, pend_flag):
+    """Deferred dk accumulation for the previous live step's ds tile.
+    Issued at step START, before this step's s/dp matmuls, so the MXU
+    queue [dk, s, dp, dv] is entirely independent of this step's VPU
+    p/ds chain: p is ready when dv's turn comes (one matmul after its
+    dependency s), and ds is ready when the final dq issues — no MXU op
+    waits on the VPU in steady state.  dv is NOT deferred: its operand p
+    is finished two matmul-slots before dv's queue position, so deferring
+    it only adds scratch-stash traffic.  (Measured on v5e at seq=64K:
+    no deferral 166.5 TFLOPs/s; dv+dk deferred 169.6; flush nested after
+    s/dp instead of step start 165.2.)"""
+    dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+        ds_pend[:], q_pend[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    pend_flag[0] = 0
+
+
+def _bwd_accum_tile(
+    do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
+    dv_scr, ds_pend, q_pend, pend_flag,
+    iq, mask, *, scale, bq, lp, dq_update,
+):
+    """One fused-backward block pair: s/dp matmuls, p/ds VPU chain, inline
+    dv accumulation, dq via `dq_update(ds, k)`, and the dk pend stash (in
+    the bf16 the matmul would cast to anyway — numerics unchanged; the next
+    step's _flush_dk issues it behind that step's own s/dp)."""
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    do = do_ref[0, 0, :, :]
+    lse_row = _read_rows(lse_ref, iq, bq, lp)
+    lse_row = jnp.where(lse_row == NEG_INF, BIG_LSE, lse_row * LOG2E)
+    delta_row = _read_rows(delta_ref, iq, bq, lp)
+
+    # s and dp are independent MXU ops issued back to back; the VPU
+    # p/ds chain overlaps them and the flush matmul queued before
+    s = jax.lax.dot_general(
+        q * (scale * LOG2E), k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    p = jnp.exp2(s - lse_row)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_row)
+    dq_update(ds, k)
+    ds_pend[:] = ds.astype(q.dtype)
+    q_pend[:] = q
+    pend_flag[0] = 1
+
+
+# ---------------------------------------------------------------------------
 # backward: fused kernel (dq + dk + dv in one pass)
 #
 # The split dq/dkdv kernels each recompute s and dp — 7 matmuls and 2
@@ -543,7 +665,7 @@ def _bwd_fused_kernel(
     spec_ref,
     do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref, dq_in_ref,
     dq_out_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, p_pend, ds_pend, do_pend, q_pend, pend_flag,
+    dk_scr, dv_scr, ds_pend, q_pend, pend_flag,
     *, scale, bq, bkv, lp, n_q_blocks, group,
 ):
     j = pl.program_id(2)
@@ -566,65 +688,24 @@ def _bwd_fused_kernel(
     live = _block_has_work(spec_ref, r0, c0, bq, bkv) & ~clamped
     full = _block_full(spec_ref, r0, c0, bq, bkv)
 
-    def _flush():
-        """Deferred dv/dk accumulation for the previous live step's tiles.
-        Issued at step START, before this step's s/dp matmuls, so the MXU
-        queue [dv, dk, s, dp] is entirely independent of this step's VPU
-        softmax chain — the chain overlaps those four matmuls instead of
-        stalling the dv/dk/dq ones every step.  (Measured on v5e: flush
-        first 169.6 TFLOPs/s; flush nested after s/dp inside the compute
-        branches 165.2; no deferral at all 166.5 — the conditional nesting
-        costs more than the reordering buys.)"""
-        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p_pend[:], do_pend[:], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds_pend[:], q_pend[:], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        pend_flag[0] = 0
-
     @pl.when(pend_flag[0] == 1)
     def _flush_prev():
-        _flush()
+        _flush_dk(dk_scr, ds_pend, q_pend, pend_flag)
 
-    def _accum(mask):
-        q = q_ref[0, 0, :, :]
-        k = k_ref[0, 0, :, :]
-        v = v_ref[0, 0, :, :]
-        do = do_ref[0, 0, :, :]
-        lse_row = _read_rows(lse_ref, iq, bq, lp)
-        lse_row = jnp.where(lse_row == NEG_INF, BIG_LSE, lse_row * LOG2E)
-        delta_row = _read_rows(delta_ref, iq, bq, lp)
-
-        # s and dp are independent MXU ops issued back to back; the VPU
-        # p/ds chain overlaps them and the flush matmuls queued next
-        s = jax.lax.dot_general(
-            q * (scale * LOG2E), k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        p = jnp.exp2(s - lse_row)
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)
-        ds = p * (dp - delta_row)
+    def _dq_update(ds, k):
         # in-place dq accumulation (ds*scale deferred to the caller's epilog
         # would lose the per-visit accumulation — apply it here instead)
         dq_out_ref[0, 0, :, :] = dq_in_ref[0, 0, :, :] + scale * jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        # dv/dk contributions are NOT applied here: stash the tiles (in the
-        # bf16 the matmuls would cast to anyway — numerics unchanged) and let
-        # the next step's _flush issue them behind its own s/dp
-        p_pend[:] = p.astype(do.dtype)
-        ds_pend[:] = ds.astype(q.dtype)
-        do_pend[:] = do
-        q_pend[:] = q
-        pend_flag[0] = 1
+
+    def _accum(mask):
+        _bwd_accum_tile(
+            do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
+            dv_scr, ds_pend, q_pend, pend_flag,
+            iq, mask, scale=scale, bq=bq, lp=lp, dq_update=_dq_update,
+        )
 
     @pl.when(live & full)
     def _compute_fast():
@@ -645,10 +726,177 @@ def _bwd_fused_kernel(
         # drain: this sweep's last live step just stashed its pend tiles
         @pl.when(pend_flag[0] == 1)
         def _drain():
-            _flush()
+            _flush_dk(dk_scr, ds_pend, q_pend, pend_flag)
 
         dk_ref[0, 0, :, :] = dk_scr[:] * scale
         dv_ref[0, 0, :, :] = dv_scr[:]
+
+
+def _bwd_fused_tri_kernel(
+    spec_ref,
+    do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
+    dq_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, ds_pend, q_pend, pend_flag,
+    *, scale, bq, bkv, lp, nqb, nkb, ratio,
+):
+    """Wrapped-diagonal causal backward (static full-window causal with
+    offset 0 or -1 — see the flash_fwd docstring's triangular contract —
+    and group=1).
+
+    Grid (b, h, p, c) with p in [0, nkb/2), c in [0, C] where
+    C = 2*nqb - ratio*(nkb-1), ratio = bkv//bq: pair p processes kv-block
+    nkb-1-p (segment A: its live q-blocks, descending) then kv-block p
+    (segment B) — every step computes a live block, eliminating the
+    rectangular grid's ~half dead steps.  dq accumulates IN the whole-head
+    output buffer (constant block index -> VMEM-resident until the head
+    changes), so there is no in-place HBM aliasing and no write/read
+    separation constraint.  dk/dv write at segment ends through an output
+    index map lagged one step (jsel(c-1)), with one trailing no-compute step
+    (c == C) to flush the final dk pend and write segment B's dk/dv.
+    """
+    p = pl.program_id(2)
+    c = pl.program_id(3)
+    j_hi = nkb - 1 - p
+    len_a = nqb - ratio * j_hi
+    ncols = 2 * nqb - ratio * (nkb - 1)
+    seg_b = c >= len_a
+    iq = jnp.where(seg_b, nqb - 1 - (c - len_a), nqb - 1 - c)
+    jk = jnp.where(seg_b, p, j_hi)
+    r0 = iq * bq
+    c0 = jk * bkv
+
+    compute = c < ncols
+
+    @pl.when((p == 0) & (c == 0))
+    def _init_head():
+        dq_ref[0, 0, :, :] = jnp.zeros_like(dq_ref[0, 0, :, :])
+        pend_flag[0] = 0
+
+    # flush the previous step's deferred dk BEFORE this step's matmuls and
+    # before any segment reinit (the pend belongs to the previous segment's
+    # kv block when c == len_a)
+    @pl.when(pend_flag[0] == 1)
+    def _flush_prev():
+        _flush_dk(dk_scr, ds_pend, q_pend, pend_flag)
+
+    # segment writeout: at c == len_a write segment A's dk/dv (out index map
+    # lags one step, so the block still points at kv j_hi); at c == ncols
+    # (the trailing step) write segment B's
+    @pl.when((c == len_a) | (c == ncols))
+    def _writeout():
+        dk_ref[0, 0, :, :] = dk_scr[:] * scale
+        dv_ref[0, 0, :, :] = dv_scr[:]
+
+    @pl.when((c == 0) | (c == len_a))
+    def _init_seg():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # the diagonal blocks are the trailing `ratio` steps of each segment
+    full = jnp.where(seg_b, c < ncols - ratio, c < len_a - ratio)
+
+    def _dq_update(ds, k):
+        # dq accumulates straight into the resident whole-head out buffer
+        rows = pl.ds(iq * bq, bq)
+        dq_ref[0, 0, rows, :] = dq_ref[0, 0, rows, :] + scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    def _accum(mask):
+        _bwd_accum_tile(
+            do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
+            dv_scr, ds_pend, q_pend, pend_flag,
+            iq, mask, scale=scale, bq=bq, lp=lp, dq_update=_dq_update,
+        )
+
+    @pl.when(compute & full)
+    def _compute_fast():
+        _accum(None)
+
+    @pl.when(compute & ~full)
+    def _compute_masked():
+        _accum(_block_mask(spec_ref, r0, c0, bq, bkv))
+
+
+def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
+                         block_q, block_kv, interpret):
+    b, n, s_q, d = q.shape
+    s_kv = k.shape[2]
+    bq = _pick_block(s_q, block_q)
+    bkv = _pick_block(s_kv, block_kv)
+    lp = _pick_block(bq, 128)
+    nqb = s_q // bq
+    nkb = s_kv // bkv
+    ratio = bkv // bq
+    ncols = 2 * nqb - ratio * (nkb - 1)
+
+    def iq_of(p, c):
+        j_hi = nkb - 1 - p
+        len_a = nqb - ratio * j_hi
+        i = jnp.where(c >= len_a, nqb - 1 - (c - len_a), nqb - 1 - c)
+        return jnp.clip(i, 0, nqb - 1)
+
+    def q_map(b_, h, p, c, sp):
+        return (b_, h, iq_of(p, c), 0)
+
+    def kv_map(b_, h, p, c, sp):
+        return (b_, h, jnp.where(c >= nqb - ratio * (nkb - 1 - p), p, nkb - 1 - p), 0)
+
+    def kv_out_map(b_, h, p, c, sp):
+        # lagged one step so the c == len_a / c == ncols writeouts land on
+        # the segment that just ended
+        cl = jnp.maximum(c, 1) - 1
+        return (b_, h, jnp.where(cl >= nqb - ratio * (nkb - 1 - p), p, nkb - 1 - p), 0)
+
+    def state_map(b_, h, p, c, sp):
+        return (b_, h, 0, 0)
+
+    def dq_map(b_, h, p, c, sp):
+        return (b_, h, 0, 0)
+
+    state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_tri_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp,
+            nqb=nqb, nkb=nkb, ratio=ratio,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, n, nkb // 2, ncols + 1),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), q_map),
+                pl.BlockSpec((1, 1, bq, d), q_map),
+                pl.BlockSpec((1, 1, bkv, d), kv_map),
+                pl.BlockSpec((1, 1, bkv, d), kv_map),
+                state_block,
+                state_block,
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, s_q, d), dq_map),
+                pl.BlockSpec((1, 1, bkv, d), kv_out_map),
+                pl.BlockSpec((1, 1, bkv, d), kv_out_map),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bkv, d), jnp.float32),
+                pltpu.VMEM((bkv, d), jnp.float32),
+                pltpu.VMEM((bq, bkv), q.dtype),
+                pltpu.VMEM((bq, d), q.dtype),
+                pltpu.SMEM((1,), jnp.int32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, s_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, n, s_kv, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, n, s_kv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT,
+            dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(_spec_array(spec), do, q, k, v, _pack(delta, lp), _pack(lse, lp))
+    return dq, dk, dv
 
 
 def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
@@ -706,11 +954,8 @@ def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
                 pltpu.VMEM((bkv, d), jnp.float32),
                 pltpu.VMEM((bkv, d), jnp.float32),
                 # deferred-flush pend tiles (see _bwd_fused_kernel._flush);
-                # p/do pend follow do.dtype (p is cast to it), ds/q follow
-                # q.dtype — flash_bwd allows do.dtype != q.dtype
-                pltpu.VMEM((bq, bkv), do.dtype),
+                # q.dtype matches the casts the stash performs
                 pltpu.VMEM((bq, bkv), q.dtype),
-                pltpu.VMEM((bq, d), do.dtype),
                 pltpu.VMEM((bq, d), q.dtype),
                 pltpu.SMEM((1,), jnp.int32),
             ],
@@ -731,8 +976,24 @@ def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
     return dq, dk, dv
 
 
+def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv) -> bool:
+    """Whether flash_bwd(triangular=True) will actually use the
+    wrapped-diagonal kernel (vs silently falling back to the rectangular
+    fused kernel): group=1 only, square even block tiling, and the
+    whole-head dq output buffer must fit the VMEM budget."""
+    bq = _pick_block(s_q, block_q)
+    bkv = _pick_block(s_kv, block_kv)
+    nkb = s_kv // bkv
+    return (
+        n == n_kv and s_q == s_kv and bkv % bq == 0
+        and nkb % 2 == 0 and nkb >= 2
+        and s_q * d * 4 <= 48 * 1024 * 1024
+    )
+
+
 def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
-              block_q=1024, block_kv=1024, interpret=None, fused=None):
+              block_q=1024, block_kv=1024, interpret=None, fused=None,
+              triangular=False):
     """One backward ring round on TPU.  Same contract as ops/tile.py:tile_bwd:
     returns (dq [B,N,S,D], dk [B,Nk,Skv,D], dv [B,Nk,Skv,D]) in float32.
 
@@ -742,7 +1003,11 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     `fused` selects the single-pass dq+dk+dv kernel (default on real TPU when
     the sweep is long enough for its aliasing-separation argument; see
     _bwd_fused_kernel).  The split kernels remain for interpret mode and
-    short sweeps.
+    short sweeps.  `triangular=True` selects the wrapped-diagonal causal
+    grid (same caller contract as flash_fwd's triangular: full-window
+    causal, offset 0 or -1) when tri_bwd_supported() holds; an explicit
+    fused=False takes precedence so the split kernels can always be
+    A/B-compared.
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -754,8 +1019,18 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     lp = _pick_block(bq, 128)
     nqb = s_q // bq
     nkb = s_kv // bkv
+    explicit_split = fused is False
     if fused is None:
         fused = not interpret and (s_q // bq) * group >= 4
+    tri = (
+        bool(triangular) and not explicit_split
+        and tri_bwd_supported(s_q, s_kv, n, n_kv, d, block_q=bq, block_kv=bkv)
+    )
+    if tri:
+        return _flash_bwd_fused_tri(
+            do, q, k, v, delta, lse, scale, spec,
+            block_q=block_q, block_kv=block_kv, interpret=interpret,
+        )
     if fused:
         return _flash_bwd_fused(
             do, q, k, v, delta, lse, scale, spec,
@@ -882,6 +1157,9 @@ def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
     m, lse, acc = flash_fwd(
         q, k, v, m0, lse0, acc0, scale, spec, block_q=block_q, block_kv=block_kv,
         block_kv_compute=block_kv_compute,
+        # the spec here is statically known to be plain full-window causal,
+        # exactly the triangular grid's precondition
+        triangular=causal,
     )
     o = _finalize(m, lse, acc, q.dtype)
     return o, lse
@@ -911,6 +1189,8 @@ def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
     dq, dk, dv = flash_bwd(
         do, q, k, v, delta, lse, scale, spec,
         block_q=block_q_bwd, block_kv=block_kv_bwd,
+        # statically known plain full-window causal here (same as the fwd)
+        triangular=causal,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 

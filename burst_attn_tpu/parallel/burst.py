@@ -47,7 +47,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops import tile as jnp_tile
-from ..ops.masks import round_spec
+from ..ops.masks import full_spec, round_spec
 from .ring import ppermute_next, my_partition, partition_at_round
 
 
@@ -79,6 +79,13 @@ class BurstConfig:
     block_q_bwd: Optional[int] = None
     block_kv_bwd: Optional[int] = None
     deterministic: bool = True
+    # Structural causal scheduling (reference burst_attn_interface.py:221-235,
+    # :303-367): zigzag rounds dispatch through a 3-way lax.cond whose
+    # branches run statically-sliced dense tiles (full q x half kv / half q x
+    # full kv) or a triangular-grid causal tile, instead of one uniform
+    # masked tile whose rectangular grid is ~half dead steps.  Striped rounds
+    # use the triangular grid directly (every round is full-window causal).
+    case_split: bool = True
 
     def bwd_blocks(self) -> Tuple[int, int]:
         bq = self.block_q_bwd if self.block_q_bwd is not None else min(1024, self.block_q)
@@ -90,24 +97,25 @@ class BurstConfig:
 # tile dispatch
 
 
-def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec):
+def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec, triangular=False):
     if cfg.backend == "pallas":
         from ..ops import pallas_flash
 
         return pallas_flash.flash_fwd(
             q, k, v, m, lse, acc, scale, spec,
-            block_q=cfg.block_q, block_kv=cfg.block_kv,
+            block_q=cfg.block_q, block_kv=cfg.block_kv, triangular=triangular,
         )
     return jnp_tile.tile_fwd(q, k, v, m, lse, acc, scale, spec)
 
 
-def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec):
+def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec, triangular=False):
     if cfg.backend == "pallas":
         from ..ops import pallas_flash
 
         bq, bkv = cfg.bwd_blocks()
         return pallas_flash.flash_bwd(
             do, q, k, v, delta, lse, scale, spec, block_q=bq, block_kv=bkv,
+            triangular=triangular,
         )
     return jnp_tile.tile_bwd(do, q, k, v, delta, lse, scale, spec)
 
@@ -137,8 +145,48 @@ def _fwd_impl(q, k, v, cfg: BurstConfig):
 
     def compute(st, kv_c, r):
         kv_part = partition_at_round(r, cfg.intra_axis, cfg.inter_axis)
-        spec = round_spec(part_me, kv_part, s, kv_c[0].shape[2], cfg.causal, cfg.layout)
-        return _tile_fwd(cfg, q, kv_c[0], kv_c[1], *st, scale, spec)
+        k_c, v_c = kv_c
+        s_kv = k_c.shape[2]
+        if cfg.causal and cfg.case_split and cfg.layout == "zigzag" and s_kv == s:
+            # 3-way structural split (reference burst_attn_interface.py:221-235)
+            half = s // 2
+
+            def eq_case(st):
+                # own partition: plain causal on the local layout
+                spec = round_spec(part_me, part_me, s, s_kv, True, "zigzag")
+                return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec,
+                                 triangular=True)
+
+            def past_case(st):
+                # kv's first half entirely in the local past: dense half-kv
+                return _tile_fwd(
+                    cfg, q, k_c[:, :, :half], v_c[:, :, :half], *st, scale,
+                    full_spec(s, half),
+                )
+
+            def future_case(st):
+                # only the local q's second half attends (to all of kv)
+                m, lse, acc = st
+                m2, lse2, acc2 = _tile_fwd(
+                    cfg, q[:, :, half:], k_c, v_c,
+                    m[:, :, half:], lse[:, :, half:], acc[:, :, half:],
+                    scale, full_spec(s - half, s_kv),
+                )
+                cat = lambda a, bpart: jnp.concatenate([a[:, :, :half], bpart], axis=2)
+                return cat(m, m2), cat(lse, lse2), cat(acc, acc2)
+
+            return lax.cond(
+                kv_part == part_me, eq_case,
+                lambda st: lax.cond(kv_part < part_me, past_case, future_case, st),
+                st,
+            )
+        if cfg.causal and cfg.case_split and cfg.layout == "striped" and s_kv == s:
+            # every striped round is full-window causal (offset 0 or -1):
+            # the triangular grid applies round-independently
+            spec = round_spec(part_me, kv_part, s, s_kv, True, "striped")
+            return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec, triangular=True)
+        spec = round_spec(part_me, kv_part, s, s_kv, cfg.causal, cfg.layout)
+        return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec)
 
     kv = (k, v)
     kv_base = kv
@@ -198,12 +246,52 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
         q_part = partition_at_round(r, cfg.intra_axis, cfg.inter_axis)
         # roles flip vs forward: the rotating payload is the query side,
         # local k/v are resident.
-        spec = round_spec(q_part, part_me, s, s, cfg.causal, cfg.layout)
         first, do_r, q_r, lse_r = pay
         if cfg.optimize_bwd_comm:
             delta_r = first
         else:
             delta_r = jnp.sum(first.astype(jnp.float32) * do_r.astype(jnp.float32), axis=-1)
+        if cfg.causal and cfg.case_split and cfg.layout == "zigzag":
+            # 3-way structural split, bwd roles (reference :303-367)
+            half = s // 2
+
+            def eq_case(_):
+                spec = round_spec(part_me, part_me, s, s, True, "zigzag")
+                return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale,
+                                 spec, triangular=True)
+
+            def kv_past_case(_):
+                # resident kv precedes the rotated q side: only kv's first
+                # half participates -> dense tile, zero-padded dk/dv
+                dq_c, dk_h, dv_h = _tile_bwd(
+                    cfg, do_r, q_r, k[:, :, :half], v[:, :, :half],
+                    delta_r, lse_r, scale, full_spec(s, half),
+                )
+                pad = lambda g: jnp.concatenate(
+                    [g, jnp.zeros((b,) + g.shape[1:2] + (s - half, d), g.dtype)], axis=2)
+                return dq_c, pad(dk_h), pad(dv_h)
+
+            def q_future_case(_):
+                # only the rotated q side's second half attends
+                dq_h, dk_c, dv_c = _tile_bwd(
+                    cfg, do_r[:, :, half:], q_r[:, :, half:], k, v,
+                    delta_r[:, :, half:], lse_r[:, :, half:],
+                    scale, full_spec(s - half, s),
+                )
+                dq_c = jnp.concatenate(
+                    [jnp.zeros((b, n, half, d), dq_h.dtype), dq_h], axis=2)
+                return dq_c, dk_c, dv_c
+
+            return lax.cond(
+                q_part == part_me, eq_case,
+                lambda _: lax.cond(part_me < q_part, kv_past_case, q_future_case, None),
+                None,
+            )
+        if cfg.causal and cfg.case_split and cfg.layout == "striped":
+            spec = round_spec(q_part, part_me, s, s, True, "striped")
+            return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec,
+                             triangular=True)
+        spec = round_spec(q_part, part_me, s, s, cfg.causal, cfg.layout)
         return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec)
 
     pay_base = payload
@@ -319,6 +407,7 @@ def burst_attn(
     block_kv_bwd: Optional[int] = None,
     batch_axes=None,
     head_axes=None,
+    case_split: bool = True,
 ) -> jax.Array:
     """Burst attention on global arrays [B, N, S, D]; S must already be in
     layout order (parallel/layouts.to_layout) for causal runs.
@@ -349,6 +438,7 @@ def burst_attn(
         block_kv=block_kv,
         block_q_bwd=block_q_bwd,
         block_kv_bwd=block_kv_bwd,
+        case_split=case_split,
     )
     seq_spec = seq_axes if len(seq_axes) > 1 else intra_axis
     spec = P(batch_axes, head_axes, seq_spec, None)

@@ -105,6 +105,23 @@ def test_pallas_backend_in_ring_interpret():
              backend="pallas", block_q=16, block_kv=16)
 
 
+def test_pallas_striped_triangular_in_ring_interpret():
+    """Striped causal rounds route through the triangular-grid kernels
+    (burst.py case split) — exercise that path inside the ring.
+    seq_per_dev=32 with 16-wide blocks gives nqb=2 per shard, satisfying
+    the tri gates (nqb even, >= 2) so the wrapped-diagonal grid actually
+    runs (kv_heads == n so the bwd group=1 gate holds too)."""
+    run_case((4,), "striped", causal=True, kv_heads=2, n=2, seq_per_dev=32,
+             backend="pallas", block_q=16, block_kv=16)
+
+
+@pytest.mark.parametrize("layout", ["zigzag", "striped"])
+def test_uniform_spec_path_no_case_split(layout):
+    """case_split=False keeps the single uniform masked tile per round
+    (the original scheduling) — both schedulings must match the oracle."""
+    run_case((2, 4), layout, causal=True, case_split=False)
+
+
 def test_bf16_reference_tolerance():
     """bf16 end-to-end within the reference's own tolerance convention
     (rtol 1e-3 / atol 1e-2 in half precision, test/checker.py:10)."""
