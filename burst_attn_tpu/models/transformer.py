@@ -52,8 +52,10 @@ class ModelConfig:
     seq_axes: Tuple[str, ...] = ("sp",)
     batch_axis: Optional[str] = "dp"
     head_axis: Optional[str] = "tp"
-    block_q: int = 2048  # kernel blocks, clamped down for short shards
-    block_kv: int = 2048
+    # kernel blocks; None = per-TPU-generation defaults (ops/tuning.py),
+    # clamped down for short shards
+    block_q: Optional[int] = None
+    block_kv: Optional[int] = None
     remat: bool = True  # jax.checkpoint each block: FLOPs for HBM
     # MoE (parallel/moe.py): n_experts=0 -> dense SwiGLU MLP.  With experts,
     # every layer's MLP becomes a top-k routed MoE; expert_axis names the

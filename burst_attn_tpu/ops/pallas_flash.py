@@ -44,6 +44,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .masks import MaskSpec
+# re-exported here for kernel users; defined in ops/tuning.py so jnp-only
+# paths (burst.py's backend fallback) can resolve blocks without importing
+# this module
+from .tuning import resolve_blocks  # noqa: F401
 
 NEG_INF = float("-inf")
 # stand-in for -inf lse rows in the backward kernels: exp(s - BIG_LSE)
@@ -953,7 +957,7 @@ def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
             scratch_shapes=[
                 pltpu.VMEM((bkv, d), jnp.float32),
                 pltpu.VMEM((bkv, d), jnp.float32),
-                # deferred-flush pend tiles (see _bwd_fused_kernel._flush);
+                # deferred-flush pend tiles (see _flush_dk);
                 # q.dtype matches the casts the stash performs
                 pltpu.VMEM((bq, bkv), q.dtype),
                 pltpu.VMEM((bq, d), q.dtype),
@@ -1129,13 +1133,13 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def flash_attention(q, k, v, scale=None, causal=False, block_q=2048, block_kv=2048,
+def flash_attention(q, k, v, scale=None, causal=False, block_q=None, block_kv=None,
                     block_q_bwd=None, block_kv_bwd=None, block_kv_compute=None):
     """Fused single-device flash attention.  q,k,v [B,N,S,D] -> o [B,N,S,D].
 
-    Default block sizes are the measured v5e optimum at long seq (fwd likes
-    2048x2048; the fused backward 1024x2048).  The bwd blocks default to
-    None = derived from the fwd blocks (min(1024, block_q), block_kv) so a
+    Block sizes default per TPU generation from ops/tuning.py (v5e measured
+    optimum: fwd 2048x2048 with 1024-wide compute sub-blocks, fused backward
+    1024x2048); the bwd blocks never default larger than the fwd blocks so a
     caller who shrinks the fwd blocks for VMEM keeps that budget in bwd.
     block_kv_compute splits the fwd kv memory block into compute sub-blocks
     (see flash_fwd)."""
@@ -1152,6 +1156,8 @@ def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
     b, n, s, d = q.shape
     if scale is None:
         scale = d**-0.5
+    block_q, block_kv, _, _, block_kv_compute = resolve_blocks(
+        block_q, block_kv, block_kv_compute=block_kv_compute)
     spec = round_spec(jnp.int32(0), jnp.int32(0), s, k.shape[2], causal, "contig")
     m0, lse0, acc0 = init_state(b, n, s, d)
     m, lse, acc = flash_fwd(
@@ -1180,10 +1186,8 @@ def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
     d = q.shape[-1]
     if scale is None:
         scale = d**-0.5
-    if block_q_bwd is None:
-        block_q_bwd = min(1024, block_q)
-    if block_kv_bwd is None:
-        block_kv_bwd = block_kv
+    _, _, block_q_bwd, block_kv_bwd = resolve_blocks(
+        block_q, block_kv, block_q_bwd, block_kv_bwd)
     spec = round_spec(jnp.int32(0), jnp.int32(0), q.shape[2], k.shape[2], causal, "contig")
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
     dq, dk, dv = flash_bwd(

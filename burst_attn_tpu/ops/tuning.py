@@ -1,0 +1,85 @@
+"""Per-TPU-generation kernel block-size table.
+
+SURVEY.md §7 build order item 2 calls for a "block-size autotuning table per
+TPU generation": the measured optimum differs per chip (VMEM size, MXU/VPU
+ratio), and the v5e numbers baked into the kernel defaults were found with
+`benchmarks/sweep_blocks.py`.  This table keys those measurements by
+`jax.devices()[0].device_kind` so other generations get a sane starting
+point and a re-sweep has one place to record results.
+
+Values are (fwd block_q, fwd block_kv, fwd block_kv_compute,
+bwd block_q, bwd block_kv).  The v5e row is measured (seq=64K, 32 heads,
+d=128, causal bf16); other rows start from the v5e optimum scaled by VMEM
+headroom and are marked estimated until swept on hardware.
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+
+
+class BlockTable(NamedTuple):
+    fwd_block_q: int
+    fwd_block_kv: int
+    fwd_block_kv_compute: Optional[int]
+    bwd_block_q: int
+    bwd_block_kv: int
+    measured: bool  # False = extrapolated, re-sweep on hardware
+
+
+# keyed by substrings of jax Device.device_kind (lowercased)
+_TABLE = {
+    # measured with benchmarks/sweep_blocks.py on one v5e chip; see
+    # docs/design.md §3 for the cliff analysis
+    "v5 lite": BlockTable(2048, 2048, 1024, 1024, 2048, True),
+    "v5e": BlockTable(2048, 2048, 1024, 1024, 2048, True),
+    # v4/v5p have larger cores (two TensorCores, more VMEM per core);
+    # same shape defaults until swept
+    "v5p": BlockTable(2048, 2048, 1024, 1024, 2048, False),
+    "v4": BlockTable(2048, 2048, 1024, 1024, 2048, False),
+    # v6e (Trillium): bigger MXU; start from the v5e optimum
+    "v6": BlockTable(2048, 2048, 1024, 1024, 2048, False),
+}
+
+_DEFAULT = BlockTable(2048, 2048, 1024, 1024, 2048, False)
+
+
+def block_defaults(device=None) -> BlockTable:
+    """Best-known kernel blocks for `device` (default: first jax device).
+
+    Off-TPU (CPU interpret runs) the values only affect tiling granularity,
+    not correctness; the default row is returned.
+    """
+    if device is None:
+        devs = jax.devices()
+        if not devs:
+            return _DEFAULT
+        device = devs[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, row in _TABLE.items():
+        if key in kind:
+            return row
+    return _DEFAULT
+
+
+def resolve_blocks(block_q=None, block_kv=None, block_q_bwd=None,
+                   block_kv_bwd=None, block_kv_compute="unset"):
+    """Fill unspecified kernel block sizes from the per-generation table.
+
+    The bwd defaults never exceed the (resolved) fwd blocks, so a caller who
+    shrinks the fwd blocks for VMEM keeps that budget in bwd; likewise the
+    compute sub-block never exceeds the kv memory block.  Returns
+    (block_q, block_kv, block_q_bwd, block_kv_bwd) — or a 5-tuple ending in
+    block_kv_compute when it is passed (None = use the table value).
+    """
+    t = block_defaults()
+    bq = t.fwd_block_q if block_q is None else block_q
+    bkv = t.fwd_block_kv if block_kv is None else block_kv
+    bqb = min(t.bwd_block_q, bq) if block_q_bwd is None else block_q_bwd
+    bkvb = min(t.bwd_block_kv, bkv) if block_kv_bwd is None else block_kv_bwd
+    if block_kv_compute == "unset":
+        return bq, bkv, bqb, bkvb
+    if block_kv_compute is None:
+        block_kv_compute = (bkv if t.fwd_block_kv_compute is None
+                            else min(t.fwd_block_kv_compute, bkv))
+    return bq, bkv, bqb, bkvb, block_kv_compute

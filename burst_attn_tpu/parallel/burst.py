@@ -69,13 +69,13 @@ class BurstConfig:
     inter_axis: Optional[str] = None  # set for the hierarchical double ring
     backend: str = "jnp"  # "jnp" | "pallas"
     optimize_bwd_comm: bool = True  # rotate delta=sum(o*do) [B,N,S] f32, not o
-    # v5e-tuned kernel blocks (fwd likes square 2048; the fused bwd 1024x2048);
-    # _pick_block clamps them down for small ring shards.  The bwd blocks
-    # default to None = derived from the fwd blocks (never larger), so a
-    # caller who tunes block_q/block_kv down for VMEM keeps that budget in
-    # the backward pass too.
-    block_q: int = 2048
-    block_kv: int = 2048
+    # kernel blocks; None = resolved from the per-TPU-generation table
+    # (ops/tuning.py) by resolved_blocks() in the tile dispatch, with bwd
+    # blocks never defaulting larger than the fwd ones (a caller who tunes
+    # block_q/block_kv down for VMEM keeps that budget in the backward too).
+    # burst_attn() pre-resolves these at construction.
+    block_q: Optional[int] = None
+    block_kv: Optional[int] = None
     block_q_bwd: Optional[int] = None
     block_kv_bwd: Optional[int] = None
     deterministic: bool = True
@@ -87,10 +87,14 @@ class BurstConfig:
     # use the triangular grid directly (every round is full-window causal).
     case_split: bool = True
 
-    def bwd_blocks(self) -> Tuple[int, int]:
-        bq = self.block_q_bwd if self.block_q_bwd is not None else min(1024, self.block_q)
-        bkv = self.block_kv_bwd if self.block_kv_bwd is not None else self.block_kv
-        return bq, bkv
+    def resolved_blocks(self) -> Tuple[int, int, int, int]:
+        """(block_q, block_kv, block_q_bwd, block_kv_bwd) with None fields
+        filled from the per-TPU-generation table (ops/tuning.py) — the one
+        source of block defaults."""
+        from ..ops.tuning import resolve_blocks
+
+        return resolve_blocks(self.block_q, self.block_kv,
+                              self.block_q_bwd, self.block_kv_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -101,9 +105,10 @@ def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec, triangular=False):
     if cfg.backend == "pallas":
         from ..ops import pallas_flash
 
+        bq, bkv, _, _ = cfg.resolved_blocks()
         return pallas_flash.flash_fwd(
             q, k, v, m, lse, acc, scale, spec,
-            block_q=cfg.block_q, block_kv=cfg.block_kv, triangular=triangular,
+            block_q=bq, block_kv=bkv, triangular=triangular,
         )
     return jnp_tile.tile_fwd(q, k, v, m, lse, acc, scale, spec)
 
@@ -112,7 +117,7 @@ def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec, triangular=False):
     if cfg.backend == "pallas":
         from ..ops import pallas_flash
 
-        bq, bkv = cfg.bwd_blocks()
+        _, _, bq, bkv = cfg.resolved_blocks()
         return pallas_flash.flash_bwd(
             do, q, k, v, delta, lse, scale, spec, block_q=bq, block_kv=bkv,
             triangular=triangular,
@@ -401,8 +406,8 @@ def burst_attn(
     scale: Optional[float] = None,
     backend: str = "auto",
     optimize_bwd_comm: bool = True,
-    block_q: int = 2048,
-    block_kv: int = 2048,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
     block_q_bwd: Optional[int] = None,
     block_kv_bwd: Optional[int] = None,
     batch_axes=None,
@@ -426,6 +431,10 @@ def burst_attn(
         inter_axis, intra_axis = seq_axes
     else:
         raise ValueError(f"seq_axes must have 1 or 2 names, got {seq_axes}")
+    from ..ops.tuning import resolve_blocks
+
+    block_q, block_kv, block_q_bwd, block_kv_bwd = resolve_blocks(
+        block_q, block_kv, block_q_bwd, block_kv_bwd)
     cfg = BurstConfig(
         causal=causal,
         layout=layout,

@@ -60,8 +60,8 @@ def ulysses_attn(
     causal: bool = False,
     scale: Optional[float] = None,
     backend: str = "auto",
-    block_q: int = 2048,
-    block_kv: int = 2048,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
     batch_axes=None,
     head_axes=None,
 ) -> jax.Array:
@@ -87,6 +87,9 @@ def ulysses_attn(
         )
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    from ..ops.tuning import resolve_blocks
+
+    block_q, block_kv, _, _ = resolve_blocks(block_q, block_kv)
     fn = jax.shard_map(
         partial(
             _ulysses_shard,

@@ -138,6 +138,20 @@ def test_triangular_bwd_matches_tile(qkv, block_q, block_kv):
         np.testing.assert_allclose(y, x, rtol=1e-4, atol=1e-4, err_msg=name)
 
 
+def test_block_tuning_table():
+    from burst_attn_tpu.ops.tuning import BlockTable, block_defaults
+    from burst_attn_tpu.ops.pallas_flash import resolve_blocks
+
+    t = block_defaults()
+    assert isinstance(t, BlockTable)
+    assert resolve_blocks() == (t.fwd_block_q, t.fwd_block_kv,
+                                min(t.bwd_block_q, t.fwd_block_q),
+                                min(t.bwd_block_kv, t.fwd_block_kv))
+    # explicit values win; unspecified bwd blocks never exceed the fwd ones
+    assert resolve_blocks(256, 512) == (256, 512, 256, 512)
+    assert resolve_blocks(256, 512, 128, 256) == (256, 512, 128, 256)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_single_device_flash_attention(qkv, causal):
     q, k, v, do = qkv
