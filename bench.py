@@ -99,15 +99,33 @@ def main():
             return (jnp.sum(dq.astype(jnp.float32)) + jnp.sum(dk.astype(jnp.float32))
                     + jnp.sum(dv.astype(jnp.float32)))
 
-        t = _time(fwdbwd, q, k, v, do)
+        fallback = False
+        try:
+            t = _time(fwdbwd, q, k, v, do)
+        except Exception as e:  # noqa: BLE001
+            # escape hatch: if the triangular causal grids fail to compile or
+            # run on this chip/toolchain, remeasure on the rectangular grids
+            # rather than record nothing (BURST_NO_TRI is read at trace time)
+            print(f"bench: triangular path failed ({type(e).__name__}: "
+                  f"{str(e)[:120]}); retrying with BURST_NO_TRI=1",
+                  file=sys.stderr, flush=True)
+            import os
+
+            os.environ["BURST_NO_TRI"] = "1"
+            fallback = True
+            fwdbwd = jax.jit(fwdbwd.__wrapped__)
+            t = _time(fwdbwd, q, k, v, do)
         tflops = 3.5 * flops_fwd(b, seq, n, d, causal) / t / 1e12
         baseline = BASELINE_FWDBWD[seq]
-        print(json.dumps({
+        rec = {
             "metric": f"flash-attn fwd+bwd TFLOPs/s/chip @ seq={seq} causal bf16",
             "value": round(tflops, 2),
             "unit": "TFLOPs/s",
             "vs_baseline": round(tflops / baseline, 4),
-        }))
+        }
+        if fallback:
+            rec["tri_fallback"] = True
+        print(json.dumps(rec))
     else:
         # CPU fallback: correctness-scale run so the driver always gets a line
         from burst_attn_tpu.ops.tile import single_device_attention

@@ -37,6 +37,7 @@ packed back with a native lane-reducing reshape.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +68,13 @@ VMEM_LIMIT = 100 * 1024 * 1024
 
 def _interpret_default():
     return jax.default_backend() != "tpu"
+
+
+def _tri_disabled():
+    """BURST_NO_TRI=1 turns the wrapped-diagonal causal grids off globally
+    (escape hatch: the rectangular grids are the longer-validated path).
+    Checked at trace time; "", "0", and "false" mean off (triangular on)."""
+    return os.environ.get("BURST_NO_TRI", "").strip().lower() not in ("", "0", "false")
 
 
 def _pick_block(seq: int, block: int) -> int:
@@ -372,7 +380,8 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     lp = _pick_block(bq, 128)
     nqb = s_q // bq
     nkb = s_kv // bkv
-    tri = bool(triangular) and bq == bkv and s_q == s_kv and nqb % 2 == 0 and nqb >= 2
+    tri = (bool(triangular) and not _tri_disabled()
+           and bq == bkv and s_q == s_kv and nqb % 2 == 0 and nqb >= 2)
     if tri:
         def q_map(b_, h, p, jp, sp):
             return (b_, h, jnp.where(jp > p, nqb - 1 - p, p), 0)
@@ -1027,7 +1036,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     if fused is None:
         fused = not interpret and (s_q // bq) * group >= 4
     tri = (
-        bool(triangular) and not explicit_split
+        bool(triangular) and not explicit_split and not _tri_disabled()
         and tri_bwd_supported(s_q, s_kv, n, n_kv, d, block_q=bq, block_kv=bkv)
     )
     if tri:

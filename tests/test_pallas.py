@@ -138,6 +138,36 @@ def test_triangular_bwd_matches_tile(qkv, block_q, block_kv):
         np.testing.assert_allclose(y, x, rtol=1e-4, atol=1e-4, err_msg=name)
 
 
+def test_burst_no_tri_escape_hatch(qkv, monkeypatch):
+    """BURST_NO_TRI=1 must route triangular=True calls onto the rectangular
+    grids.  The routing itself is asserted (the tri paths' only coordinate
+    helper is made to explode), not just numerics — the two grids produce
+    identical results so a numerics check could not catch a routing bug."""
+    q, k, v, _ = qkv
+    spec = round_spec(jnp.int32(0), jnp.int32(0), S, S, True, "contig")
+    st = tile.init_state(B, N, S, D)
+    ref = tile.tile_fwd(q, k, v, *st, SCALE, spec)
+
+    def _boom(*a, **k):
+        raise AssertionError("triangular path taken despite BURST_NO_TRI")
+
+    monkeypatch.setattr(pallas_flash, "_tri_coords", _boom)
+    monkeypatch.setattr(pallas_flash, "_bwd_fused_tri_kernel", _boom)
+    monkeypatch.setenv("BURST_NO_TRI", "1")
+    got = pallas_flash.flash_fwd(
+        q, k, v, *st, SCALE, spec, block_q=16, block_kv=16, interpret=True,
+        cast_p=False, triangular=True,
+    )
+    np.testing.assert_allclose(got[2], ref[2], rtol=1e-4, atol=1e-4)
+    # "0"/"false"/"" mean off -> triangular path runs again
+    monkeypatch.setenv("BURST_NO_TRI", "0")
+    with pytest.raises(AssertionError, match="triangular path taken"):
+        pallas_flash.flash_fwd(
+            q, k, v, *st, SCALE, spec, block_q=16, block_kv=16, interpret=True,
+            cast_p=False, triangular=True,
+        )
+
+
 def test_block_tuning_table():
     from burst_attn_tpu.ops.tuning import BlockTable, block_defaults
     from burst_attn_tpu.ops.pallas_flash import resolve_blocks
