@@ -174,6 +174,21 @@ def test_block_tuning_table():
 
     t = block_defaults()
     assert isinstance(t, BlockTable)
+
+    class FakeDev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    # device-kind substring matching: v5e (both spellings) is the measured row
+    assert block_defaults(FakeDev("TPU v5 lite")).measured
+    assert block_defaults(FakeDev("TPU v5e")).measured
+    assert not block_defaults(FakeDev("TPU v4")).measured
+    assert not block_defaults(FakeDev("weird-accelerator")).measured
+    # the v6 row exists (vs. falling through to _DEFAULT, which is identical
+    # today): distinguish by identity against the table's own entry
+    from burst_attn_tpu.ops import tuning as _tuning
+
+    assert block_defaults(FakeDev("TPU v6e")) is _tuning._TABLE["v6"]
     assert resolve_blocks() == (t.fwd_block_q, t.fwd_block_kv,
                                 min(t.bwd_block_q, t.fwd_block_q),
                                 min(t.bwd_block_kv, t.fwd_block_kv))
