@@ -1,0 +1,184 @@
+"""Pipeline-parallel forward for the flagship LM: pp x dp x sp composed.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4 — DP/TP/PP are
+delegated to host frameworks); `parallel/pipeline.py` provides the generic
+GPipe-over-`lax.scan` building block, and this module is its integration
+with the transformer + burst sequence ring (round-1 verdict item 5).
+
+Composition problem: the regular forward path (transformer.forward_with_aux)
+is GSPMD-style — einsums under jit with sharding constraints — and
+`burst_attn` internally opens its own `shard_map` over the sequence axis.
+`shard_map` does not nest, so a pipeline wrapper around that path can't
+work.  TPU-native answer: ONE `shard_map` over the FULL (pp, dp, sp) mesh
+whose body is fully manual per-shard code —
+
+  * GPipe tick loop: stage p holds layers [p*L/P, (p+1)*L/P); activations
+    `lax.ppermute` one hop along `pp` per tick; stage 0 injects microbatch
+    t, the last stage banks finished microbatches (same schedule as
+    parallel/pipeline.py:pipeline_shard).
+  * attention: `burst_attn_shard` — the shard-level custom_vjp ring — runs
+    over `sp` inside each stage (double ring over ("inter","intra") seq
+    axes works the same way).
+  * dp needs no code: the batch dim is sharded by the outer shard_map and
+    parameter cotangents are psum'd across replicated axes by shard_map's
+    transpose.
+
+The backward pipeline schedule is free: jax.grad of scan + ppermute IS the
+reverse schedule (ppermute transposes to the reverse permutation).
+
+Restrictions (explicit errors below): no tensor parallelism (head_axis) and
+no MoE inside the pp path — both would need hand-written megatron/dispatch
+collectives in the manual body; compose them with dp/sp instead.
+
+Parameter layout: `layers` holds stacked leaves [n_layers, ...] (dim 0
+sharded over `pp`), not the regular list-of-dicts — see
+transformer.init_params / stack_layers.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.burst import BurstConfig, burst_attn_shard, _resolve_backend
+# the pure math MUST be shared with the regular path: a numerics change
+# there must not silently break pp=1 vs pp=N parity (_mlp's dense path is
+# per-shard pure math too — cfg=None selects it)
+from .transformer import _mlp, _rms_norm, _rope
+
+
+def stack_layers(layers):
+    """List-of-layer-dicts -> one pytree with a leading [n_layers, ...] axis
+    (the layout the pp path shards over the `pp` mesh axis)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def unstack_layers(stacked, n_layers):
+    """Inverse of stack_layers (e.g. to run a pp checkpoint without pp)."""
+    return [jax.tree.map(lambda a: a[i], stacked) for i in range(n_layers)]
+
+
+def _layer_fwd(p, x, positions, cfg, bcfg: BurstConfig):
+    """One transformer block, per-shard (x [mb, s_local, d]): local einsums
+    + the burst ring over the sequence axes."""
+    h = _rms_norm(x, p["attn_norm"])
+    q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
+    k = jnp.einsum("bsd,dnh->bnsh", h, p["wk"])
+    v = jnp.einsum("bsd,dnh->bnsh", h, p["wv"])
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    o = burst_attn_shard(q, k, v, bcfg)
+    x = x + jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+    return x + _mlp(p, x)[0]
+
+
+def _pp_forward_shard(layers_p, embed, final_norm, lm_head, tokens, positions,
+                      *, cfg, bcfg: BurstConfig, m: int):
+    """Per-shard body: embed -> GPipe ticks over `pp` -> head.
+
+    layers_p: this stage's layers, leaves [L/P, ...]; tokens/positions
+    [b_local, s_local] (dp x sp shard)."""
+    pp = cfg.pp_axis
+    n_stages = lax.axis_size(pp)
+    stage = lax.axis_index(pp)
+    b_l, s_l = tokens.shape
+    x = embed.astype(cfg.dtype)[tokens]
+    d = x.shape[-1]
+    mb = b_l // m
+    x_mb = x.reshape(m, mb, s_l, d)
+    pos_mb = positions.reshape(m, mb, s_l)
+
+    def stage_fn(x, pos):
+        def body(x, p):
+            return _layer_fwd(p, x, pos, cfg, bcfg), None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = lax.scan(body, x, layers_p)
+        return x
+
+    ticks = m + n_stages - 1
+    buf = jnp.zeros_like(x_mb[0])  # activation arriving from the left
+    out = jnp.zeros_like(x_mb)     # banked results (last stage only)
+
+    def tick(carry, t):
+        buf, out = carry
+        inject = lax.dynamic_index_in_dim(
+            x_mb, jnp.minimum(t, m - 1), axis=0, keepdims=False)
+        cur = jnp.where(stage == 0, inject, buf)
+        # the activation at stage s on tick t is microbatch t - s; its
+        # positions (rope) must travel with it.  Clamped: bubble ticks
+        # compute garbage that is never banked.
+        pos = lax.dynamic_index_in_dim(
+            pos_mb, jnp.clip(t - stage, 0, m - 1), axis=0, keepdims=False)
+        y = stage_fn(cur, pos)
+        out_id = t - (n_stages - 1)
+        bank = (stage == n_stages - 1) & (out_id >= 0)
+        banked = lax.dynamic_update_index_in_dim(
+            out, y, jnp.clip(out_id, 0, m - 1), axis=0)
+        out = jnp.where(bank, banked, out)
+        nxt = lax.ppermute(
+            y, pp, [(i, (i + 1) % n_stages) for i in range(n_stages)])
+        return (nxt, out), None
+
+    (_, out), _ = lax.scan(tick, (buf, out), jnp.arange(ticks))
+    # banked outputs live on the last stage; psum replicates them so every
+    # pp shard computes the (cheap) head on its own dp x sp shard
+    xf = lax.psum(out, pp).reshape(b_l, s_l, d)
+    xf = _rms_norm(xf, final_norm)
+    return jnp.einsum("bsd,vd->bsv", xf, lm_head,
+                      preferred_element_type=jnp.float32)
+
+
+def pp_forward_with_aux(params, tokens, positions, cfg, mesh):
+    """Pipeline-parallel forward_with_aux: fp32 logits [B, S, vocab], aux=0.
+
+    Same contract as transformer.forward_with_aux; dispatched from there
+    when cfg.pp_axis is set."""
+    if cfg.head_axis is not None:
+        raise ValueError(
+            "pipeline parallelism does not compose with tensor parallelism "
+            "(head_axis); use pp x dp x sp")
+    if cfg.n_experts:
+        raise ValueError("pipeline parallelism does not compose with MoE")
+    if cfg.attn_strategy != "burst":
+        raise ValueError("pp path supports attn_strategy='burst' only")
+    n_stages = mesh.shape[cfg.pp_axis]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by pp={n_stages}")
+    m = cfg.pp_microbatches
+    dp = mesh.shape[cfg.batch_axis] if cfg.batch_axis else 1
+    b_local = tokens.shape[0] // dp
+    if b_local % m:
+        raise ValueError(
+            f"per-dp-shard batch {b_local} not divisible by "
+            f"pp_microbatches {m}")
+
+    if len(cfg.seq_axes) == 1:
+        inter_axis, intra_axis = None, cfg.seq_axes[0]
+    else:
+        inter_axis, intra_axis = cfg.seq_axes
+    bcfg = BurstConfig(
+        causal=cfg.causal,
+        layout=cfg.layout,
+        intra_axis=intra_axis,
+        inter_axis=inter_axis,
+        backend=_resolve_backend(cfg.attn_backend),
+        block_q=cfg.block_q,
+        block_kv=cfg.block_kv,
+    )
+    seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
+    tok_spec = P(cfg.batch_axis, seq_spec)
+    fn = jax.shard_map(
+        partial(_pp_forward_shard, cfg=cfg, bcfg=bcfg, m=m),
+        mesh=mesh,
+        in_specs=(P(cfg.pp_axis), P(), P(), P(), tok_spec, tok_spec),
+        out_specs=P(cfg.batch_axis, seq_spec, None),
+        check_vma=False,
+    )
+    logits = fn(params["layers"], params["embed"], params["final_norm"],
+                params["lm_head"], tokens, positions)
+    return logits, jnp.float32(0.0)

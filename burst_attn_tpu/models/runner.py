@@ -174,6 +174,8 @@ def main(argv=None):
     p.add_argument("--layout", default="zigzag")
     p.add_argument("--n-experts", type=int, default=0,
                    help="MoE experts per layer (0 = dense MLP)")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="GPipe microbatches for a pp= mesh (default: pp size)")
     p.add_argument("--no-remat", action="store_true")
     p.add_argument("--multihost", action="store_true",
                    help="call multihost.initialize() before touching jax")
@@ -201,10 +203,20 @@ def main(argv=None):
     if args.n_experts:
         expert_axis = "ep" if "ep" in mesh_axes else (
             "dp" if "dp" in mesh_axes else None)
+    # a pp= axis turns on the pipeline-parallel forward (pipeline_lm.py);
+    # microbatches default to the stage count (the GPipe sweet spot floor)
+    pp_axis = "pp" if "pp" in mesh_axes else None
+    if pp_axis and "tp" in mesh_axes:
+        raise SystemExit("--mesh: pp does not compose with tp (use pp x dp x sp)")
+    if args.microbatches and not pp_axis:
+        raise SystemExit("--microbatches requires a pp= axis in --mesh")
     cfg = ModelConfig(
         seq_axes=seq_axes,
         batch_axis="dp" if "dp" in mesh_axes else None,
         head_axis="tp" if "tp" in mesh_axes else None,
+        pp_axis=pp_axis,
+        pp_microbatches=(args.microbatches or mesh_axes.get("pp", 1))
+        if pp_axis else 1,
         n_experts=args.n_experts,
         expert_axis=expert_axis,
         vocab=args.vocab,

@@ -64,6 +64,12 @@ class ModelConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     expert_axis: Optional[str] = None
+    # Pipeline parallelism (models/pipeline_lm.py): pp_axis names the mesh
+    # axis stages shard over; layers are then stored STACKED [n_layers, ...]
+    # (dim 0 sharded over pp) and the forward runs the GPipe schedule.
+    # pp_microbatches must divide the per-dp-shard batch.
+    pp_axis: Optional[str] = None
+    pp_microbatches: int = 1
 
 
 Params = Dict[str, Any]
@@ -107,6 +113,10 @@ def init_params(key, cfg: ModelConfig) -> Params:
                 w_down=dense(_split(ks[5], 2)[1], (f, d)),
             )
         layers.append(layer)
+    if cfg.pp_axis is not None:
+        from .pipeline_lm import stack_layers
+
+        layers = stack_layers(layers)
     return {
         "embed": init(keys[-2], (cfg.vocab, d), cfg.dtype),
         "layers": layers,
@@ -149,6 +159,16 @@ def param_specs(cfg: ModelConfig) -> Params:
             w_up=P(None, tp),
             w_down=P(tp, None),
         )
+    if cfg.pp_axis is not None:
+        # stacked layout: leading stage/layer dim sharded over pp; the pp
+        # path forbids tp, so the remaining dims are replicated
+        layer = {k: P(cfg.pp_axis, *s) for k, s in layer.items()}
+        return {
+            "embed": P(None, None),
+            "layers": layer,
+            "final_norm": P(None),
+            "lm_head": P(None, None),
+        }
     return {
         "embed": P(tp, None),
         "layers": [layer] * cfg.n_layers,
@@ -321,6 +341,10 @@ def forward(params: Params, tokens, positions, cfg: ModelConfig, mesh) -> jax.Ar
 def forward_with_aux(params: Params, tokens, positions, cfg: ModelConfig, mesh):
     """forward + the summed MoE auxiliary load-balancing loss (0 for dense
     models); the trainer adds `moe_aux_weight * aux` to the objective."""
+    if cfg.pp_axis is not None:
+        from .pipeline_lm import pp_forward_with_aux
+
+        return pp_forward_with_aux(params, tokens, positions, cfg, mesh)
     from jax.sharding import NamedSharding
 
     seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]

@@ -86,6 +86,41 @@ def _pick_block(seq: int, block: int) -> int:
     return block
 
 
+# Ragged sequence support: flash_fwd/flash_bwd pad awkward sequence lengths
+# up to a multiple of the packed-stats lane width, so _pick_block always has
+# a >= 128-ish divisor to work with instead of silently degrading to a
+# near-1 block (and a catastrophic grid) on prime/odd lengths.  The pad
+# region is masked out for free: MaskSpec row/col bounds stay in true
+# coordinates, so padded rows/cols fail `rows < q_hi` / `cols < kv_hi` in
+# every kernel mask, and callers slice the outputs back.
+_SEQ_ALIGN = 128
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _padded_len(s: int, block: int) -> int:
+    """Sequence length the kernel should actually run at: `s` itself when the
+    requested block tiles it exactly, or when it fits one small (sub-align)
+    block; otherwise the next 128-aligned length, with the pad masked out.
+    (A 128-aligned s is its own ceiling, so good-divisor cases like
+    s=2176/block=2048 fall through unchanged.)"""
+    if s % block == 0 or (block >= s and s <= _SEQ_ALIGN):
+        return s
+    return _ceil_to(s, _SEQ_ALIGN)
+
+
+def _pad_seq(x, s_pad: int, fill=0.0):
+    """Pad dim 2 (sequence) of [B, N, S, ...] up to s_pad with `fill`."""
+    s = x.shape[2]
+    if s == s_pad:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[2] = (0, s_pad - s)
+    return jnp.pad(x, pad, constant_values=fill)
+
+
 def _spec_array(spec: MaskSpec):
     return jnp.stack(
         [
@@ -372,6 +407,20 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     b, n, s_q, d = q.shape
     n_kv, s_kv = k.shape[1], k.shape[2]
     group = _gqa_group(n, n_kv)
+    sq_pad, skv_pad = _padded_len(s_q, block_q), _padded_len(s_kv, block_kv)
+    if sq_pad != s_q or skv_pad != s_kv:
+        # ragged lengths: pad, run, slice back (spec bounds stay in true
+        # coordinates so the pad region is masked; tri grids assume exact
+        # full-window tiling, so the padded call is rectangular)
+        m2, lse2, acc2 = flash_fwd(
+            _pad_seq(q, sq_pad), _pad_seq(k, skv_pad), _pad_seq(v, skv_pad),
+            _pad_seq(m, sq_pad, float("-inf")),
+            _pad_seq(lse, sq_pad, float("-inf")), _pad_seq(acc, sq_pad),
+            scale, spec, block_q=block_q, block_kv=block_kv,
+            block_kv_compute=block_kv_compute, interpret=interpret,
+            cast_p=cast_p, triangular=False,
+        )
+        return m2[:, :, :s_q], lse2[:, :, :s_q], acc2[:, :, :s_q]
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
     if block_kv_compute is None:
@@ -989,18 +1038,37 @@ def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
     return dq, dk, dv
 
 
+def _tri_bwd_other_residents(bq, bkv, d, itemsize=2):
+    """Estimated VMEM held by everything EXCEPT the whole-head dq output in
+    the triangular fused bwd kernel: double-buffered input blocks (do, q,
+    k, v) and dk/dv f32 output blocks, plus the ds/q deferral stashes.
+    Packed delta/lse blocks are negligible next to these."""
+    blocks = 2 * (2 * bq * d * itemsize      # do, q
+                  + 2 * bkv * d * itemsize   # k, v
+                  + 2 * bkv * d * 4)         # dk, dv out (f32)
+    scratch = bq * bkv * itemsize + bq * d * itemsize  # ds stash, q stash
+    return blocks + scratch
+
+
 def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv) -> bool:
     """Whether flash_bwd(triangular=True) will actually use the
     wrapped-diagonal kernel (vs silently falling back to the rectangular
     fused kernel): group=1 only, square even block tiling, and the
-    whole-head dq output buffer must fit the VMEM budget."""
+    whole-head dq output buffer must fit the VMEM budget.
+
+    The dq budget is derived from VMEM_LIMIT minus an estimate of the other
+    residents, at half utilization — Mosaic's own overheads aren't modeled,
+    and a config that passes this gate but fails to compile has no automatic
+    fallback inside burst_attn (only the BURST_NO_TRI env var), so the gate
+    errs conservative."""
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
     nkb = s_kv // bkv
+    dq_budget = VMEM_LIMIT // 2 - _tri_bwd_other_residents(bq, bkv, d)
     return (
         n == n_kv and s_q == s_kv and bkv % bq == 0
         and nkb % 2 == 0 and nkb >= 2
-        and s_q * d * 4 <= 48 * 1024 * 1024
+        and s_q * d * 4 <= dq_budget
     )
 
 
@@ -1027,6 +1095,19 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     b, n, s_q, d = q.shape
     n_kv, s_kv = k.shape[1], k.shape[2]
     group = _gqa_group(n, n_kv)
+    sq_pad, skv_pad = _padded_len(s_q, block_q), _padded_len(s_kv, block_kv)
+    if sq_pad != s_q or skv_pad != s_kv:
+        # ragged lengths: pad, run, slice back (see flash_fwd).  lse pads
+        # with 0 (not -inf) so the kernels' exp(s - lse) stays finite before
+        # the mask select zeroes the padded rows' contributions.
+        dq, dk, dv = flash_bwd(
+            _pad_seq(do, sq_pad), _pad_seq(q, sq_pad),
+            _pad_seq(k, skv_pad), _pad_seq(v, skv_pad),
+            _pad_seq(delta, sq_pad), _pad_seq(lse, sq_pad),
+            scale, spec, block_q=block_q, block_kv=block_kv,
+            interpret=interpret, fused=fused, triangular=False,
+        )
+        return dq[:, :, :s_q], dk[:, :, :s_kv], dv[:, :, :s_kv]
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
     lp = _pick_block(bq, 128)
@@ -1195,7 +1276,7 @@ def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
     d = q.shape[-1]
     if scale is None:
         scale = d**-0.5
-    _, _, block_q_bwd, block_kv_bwd = resolve_blocks(
+    _, _, block_q_bwd, block_kv_bwd, _ = resolve_blocks(
         block_q, block_kv, block_q_bwd, block_kv_bwd)
     spec = round_spec(jnp.int32(0), jnp.int32(0), q.shape[2], k.shape[2], causal, "contig")
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)

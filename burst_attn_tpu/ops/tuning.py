@@ -13,9 +13,12 @@ d=128, causal bf16); other rows start from the v5e optimum scaled by VMEM
 headroom and are marked estimated until swept on hardware.
 """
 
+import logging
 from typing import NamedTuple, Optional
 
 import jax
+
+logger = logging.getLogger("burst_attn_tpu")
 
 
 class BlockTable(NamedTuple):
@@ -27,13 +30,23 @@ class BlockTable(NamedTuple):
     measured: bool  # False = extrapolated, re-sweep on hardware
 
 
-# keyed by substrings of jax Device.device_kind (lowercased)
+class ResolvedBlocks(NamedTuple):
+    """Uniform return of resolve_blocks(): always all five fields, so call
+    sites never branch on arity (callers that don't use the compute
+    sub-block just ignore the last field)."""
+
+    block_q: int
+    block_kv: int
+    block_q_bwd: int
+    block_kv_bwd: int
+    block_kv_compute: Optional[int]
+
+
 _TABLE = {
     # measured with benchmarks/sweep_blocks.py on one v5e chip; see
     # docs/design.md §3 for the cliff analysis
-    "v5 lite": BlockTable(2048, 2048, 1024, 1024, 2048, True),
     "v5e": BlockTable(2048, 2048, 1024, 1024, 2048, True),
-    # v4/v5p have larger cores (two TensorCores, more VMEM per core);
+    # v4/v5p have larger cores (two TensorCores on v4, more VMEM per core);
     # same shape defaults until swept
     "v5p": BlockTable(2048, 2048, 1024, 1024, 2048, False),
     "v4": BlockTable(2048, 2048, 1024, 1024, 2048, False),
@@ -41,7 +54,67 @@ _TABLE = {
     "v6": BlockTable(2048, 2048, 1024, 1024, 2048, False),
 }
 
+# Ordered (substring, canonical row) aliases over the device_kind strings JAX
+# runtimes actually report — "TPU v5 lite" / "TPU v5e" (v5e), "TPU v5p" and
+# sometimes bare "TPU v5" (v5p), "TPU v6 lite" / "TPU v6e" / Trillium, "TPU
+# v4".  Order matters: the v5e spellings must be tried before the bare "v5"
+# catch-all, and "v5p" before "v5".
+_KIND_ALIASES = (
+    ("v5 lite", "v5e"),
+    ("v5litepod", "v5e"),
+    ("v5e", "v5e"),
+    ("v5p", "v5p"),
+    ("v5", "v5p"),
+    ("v6 lite", "v6"),
+    ("v6e", "v6"),
+    ("trillium", "v6"),
+    ("v6", "v6"),
+    ("v4", "v4"),
+)
+
 _DEFAULT = BlockTable(2048, 2048, 1024, 1024, 2048, False)
+
+_logged_kinds = set()
+
+
+def _log_resolution(kind: str, canonical: Optional[str], row: BlockTable,
+                    platform: str) -> None:
+    """Log each device kind's table resolution once per process — an
+    unmeasured row on real TPU hardware means the defaults are
+    extrapolations and a re-sweep (benchmarks/sweep_blocks.py) is due
+    (round-1 verdict item 8)."""
+    if kind in _logged_kinds:
+        return
+    _logged_kinds.add(kind)
+    if platform != "tpu":
+        return  # off-TPU the values only affect tiling granularity
+    if row.measured:
+        logger.info("kernel blocks for %r: measured row %r", kind, canonical)
+    else:
+        logger.warning(
+            "kernel blocks for %r resolved to %s row (NOT measured on this "
+            "generation — defaults extrapolated from the v5e sweep; run "
+            "`python -m benchmarks.sweep_blocks` and record the optimum in "
+            "burst_attn_tpu/ops/tuning.py)",
+            kind, repr(canonical) if canonical else "the default",
+        )
+
+
+def canonical_kind(device=None):
+    """Canonical generation name ("v5e"/"v5p"/"v4"/"v6") for a device's
+    device_kind, or None when unrecognized — the one place device-kind
+    strings are interpreted (consumers: the block table here, peak-FLOPs
+    tables in benchmarks)."""
+    if device is None:
+        devs = jax.devices()
+        if not devs:
+            return None
+        device = devs[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, canonical in _KIND_ALIASES:
+        if key in kind:
+            return canonical
+    return None
 
 
 def block_defaults(device=None) -> BlockTable:
@@ -56,30 +129,29 @@ def block_defaults(device=None) -> BlockTable:
             return _DEFAULT
         device = devs[0]
     kind = getattr(device, "device_kind", "").lower()
-    for key, row in _TABLE.items():
-        if key in kind:
-            return row
-    return _DEFAULT
+    platform = getattr(device, "platform", "")
+    canonical = canonical_kind(device)
+    row = _TABLE[canonical] if canonical else _DEFAULT
+    _log_resolution(kind, canonical, row, platform)
+    return row
 
 
 def resolve_blocks(block_q=None, block_kv=None, block_q_bwd=None,
-                   block_kv_bwd=None, block_kv_compute="unset"):
+                   block_kv_bwd=None, block_kv_compute=None) -> ResolvedBlocks:
     """Fill unspecified kernel block sizes from the per-generation table.
 
     The bwd defaults never exceed the (resolved) fwd blocks, so a caller who
     shrinks the fwd blocks for VMEM keeps that budget in bwd; likewise the
-    compute sub-block never exceeds the kv memory block.  Returns
-    (block_q, block_kv, block_q_bwd, block_kv_bwd) — or a 5-tuple ending in
-    block_kv_compute when it is passed (None = use the table value).
+    compute sub-block never exceeds the kv memory block.  Always returns a
+    5-field ResolvedBlocks; callers without a compute sub-block ignore the
+    last field.
     """
     t = block_defaults()
     bq = t.fwd_block_q if block_q is None else block_q
     bkv = t.fwd_block_kv if block_kv is None else block_kv
     bqb = min(t.bwd_block_q, bq) if block_q_bwd is None else block_q_bwd
     bkvb = min(t.bwd_block_kv, bkv) if block_kv_bwd is None else block_kv_bwd
-    if block_kv_compute == "unset":
-        return bq, bkv, bqb, bkvb
     if block_kv_compute is None:
         block_kv_compute = (bkv if t.fwd_block_kv_compute is None
                             else min(t.fwd_block_kv_compute, bkv))
-    return bq, bkv, bqb, bkvb, block_kv_compute
+    return ResolvedBlocks(bq, bkv, bqb, bkvb, block_kv_compute)

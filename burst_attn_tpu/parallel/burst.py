@@ -87,10 +87,10 @@ class BurstConfig:
     # use the triangular grid directly (every round is full-window causal).
     case_split: bool = True
 
-    def resolved_blocks(self) -> Tuple[int, int, int, int]:
-        """(block_q, block_kv, block_q_bwd, block_kv_bwd) with None fields
-        filled from the per-TPU-generation table (ops/tuning.py) — the one
-        source of block defaults."""
+    def resolved_blocks(self):
+        """ResolvedBlocks with None fields filled from the
+        per-TPU-generation table (ops/tuning.py) — the one source of block
+        defaults."""
         from ..ops.tuning import resolve_blocks
 
         return resolve_blocks(self.block_q, self.block_kv,
@@ -105,7 +105,8 @@ def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec, triangular=False):
     if cfg.backend == "pallas":
         from ..ops import pallas_flash
 
-        bq, bkv, _, _ = cfg.resolved_blocks()
+        rb = cfg.resolved_blocks()
+        bq, bkv = rb.block_q, rb.block_kv
         return pallas_flash.flash_fwd(
             q, k, v, m, lse, acc, scale, spec,
             block_q=bq, block_kv=bkv, triangular=triangular,
@@ -117,7 +118,8 @@ def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec, triangular=False):
     if cfg.backend == "pallas":
         from ..ops import pallas_flash
 
-        _, _, bq, bkv = cfg.resolved_blocks()
+        rb = cfg.resolved_blocks()
+        bq, bkv = rb.block_q_bwd, rb.block_kv_bwd
         return pallas_flash.flash_bwd(
             do, q, k, v, delta, lse, scale, spec, block_q=bq, block_kv=bkv,
             triangular=triangular,
@@ -177,8 +179,12 @@ def _fwd_impl(q, k, v, cfg: BurstConfig):
                     m[:, :, half:], lse[:, :, half:], acc[:, :, half:],
                     scale, full_spec(s - half, s_kv),
                 )
-                cat = lambda a, bpart: jnp.concatenate([a[:, :, :half], bpart], axis=2)
-                return cat(m, m2), cat(lse, lse2), cat(acc, acc2)
+                # write the updated half back in place rather than
+                # rebuilding the full [B,N,S,D] f32 state via concatenate —
+                # one fewer full-state HBM copy per future round
+                upd = lambda a, bpart: lax.dynamic_update_slice_in_dim(
+                    a, bpart, half, axis=2)
+                return upd(m, m2), upd(lse, lse2), upd(acc, acc2)
 
             return lax.cond(
                 kv_part == part_me, eq_case,
@@ -433,7 +439,7 @@ def burst_attn(
         raise ValueError(f"seq_axes must have 1 or 2 names, got {seq_axes}")
     from ..ops.tuning import resolve_blocks
 
-    block_q, block_kv, block_q_bwd, block_kv_bwd = resolve_blocks(
+    block_q, block_kv, block_q_bwd, block_kv_bwd, _ = resolve_blocks(
         block_q, block_kv, block_q_bwd, block_kv_bwd)
     cfg = BurstConfig(
         causal=causal,

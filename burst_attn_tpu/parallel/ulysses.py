@@ -89,7 +89,7 @@ def ulysses_attn(
         scale = q.shape[-1] ** -0.5
     from ..ops.tuning import resolve_blocks
 
-    block_q, block_kv, _, _ = resolve_blocks(block_q, block_kv)
+    block_q, block_kv = resolve_blocks(block_q, block_kv)[:2]
     fn = jax.shard_map(
         partial(
             _ulysses_shard,

@@ -179,22 +179,31 @@ def test_block_tuning_table():
         def __init__(self, kind):
             self.device_kind = kind
 
-    # device-kind substring matching: v5e (both spellings) is the measured row
-    assert block_defaults(FakeDev("TPU v5 lite")).measured
-    assert block_defaults(FakeDev("TPU v5e")).measured
-    assert not block_defaults(FakeDev("TPU v4")).measured
-    assert not block_defaults(FakeDev("weird-accelerator")).measured
-    # the v6 row exists (vs. falling through to _DEFAULT, which is identical
-    # today): distinguish by identity against the table's own entry
+    # device-kind matching over the strings real runtimes report
     from burst_attn_tpu.ops import tuning as _tuning
 
+    assert block_defaults(FakeDev("TPU v5 lite")).measured
+    assert block_defaults(FakeDev("TPU v5e")).measured
+    assert block_defaults(FakeDev("TPU v5p")) is _tuning._TABLE["v5p"]
+    # some runtimes report bare "TPU v5" for v5p — must not fall to _DEFAULT
+    assert block_defaults(FakeDev("TPU v5")) is _tuning._TABLE["v5p"]
+    assert block_defaults(FakeDev("TPU v4")) is _tuning._TABLE["v4"]
+    assert not block_defaults(FakeDev("TPU v4")).measured
+    assert not block_defaults(FakeDev("weird-accelerator")).measured
     assert block_defaults(FakeDev("TPU v6e")) is _tuning._TABLE["v6"]
-    assert resolve_blocks() == (t.fwd_block_q, t.fwd_block_kv,
-                                min(t.bwd_block_q, t.fwd_block_q),
-                                min(t.bwd_block_kv, t.fwd_block_kv))
-    # explicit values win; unspecified bwd blocks never exceed the fwd ones
-    assert resolve_blocks(256, 512) == (256, 512, 256, 512)
-    assert resolve_blocks(256, 512, 128, 256) == (256, 512, 128, 256)
+    assert block_defaults(FakeDev("TPU v6 lite")) is _tuning._TABLE["v6"]
+    # resolve_blocks always returns the uniform 5-field shape
+    rb = resolve_blocks()
+    assert rb == (t.fwd_block_q, t.fwd_block_kv,
+                  min(t.bwd_block_q, t.fwd_block_q),
+                  min(t.bwd_block_kv, t.fwd_block_kv),
+                  min(t.fwd_block_kv_compute, t.fwd_block_kv))
+    # explicit values win; unspecified bwd blocks never exceed the fwd ones;
+    # the compute sub-block never exceeds the kv memory block
+    assert resolve_blocks(256, 512)[:4] == (256, 512, 256, 512)
+    assert resolve_blocks(256, 512).block_kv_compute == 512
+    assert resolve_blocks(256, 512, 128, 256)[:4] == (256, 512, 128, 256)
+    assert resolve_blocks(block_kv_compute=512).block_kv_compute == 512
 
 
 @pytest.mark.parametrize("causal", [False, True])

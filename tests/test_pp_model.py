@@ -1,0 +1,113 @@
+"""Pipeline parallelism in the flagship trainer: pp x dp x sp composition
+(models/pipeline_lm.py), loss/grad parity vs the regular (pp=1) forward,
+and the config guard rails.  Round-1 verdict item 5."""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from burst_attn_tpu.models import ModelConfig, init_params
+from burst_attn_tpu.models.pipeline_lm import stack_layers, unstack_layers
+from burst_attn_tpu.models.train import (
+    TrainConfig, init_train_state, loss_fn, make_batch, make_mesh,
+    make_train_step,
+)
+
+CFG = ModelConfig(
+    vocab=128, d_model=64, n_layers=4, n_heads=2, n_kv_heads=2, d_head=32,
+    d_ff=128, dtype=jnp.float32, attn_backend="jnp", remat=False,
+    batch_axis=None, head_axis=None, seq_axes=("sp",),
+)
+
+
+def _pp_cfg(base=CFG, m=2, **kw):
+    return replace(base, pp_axis="pp", pp_microbatches=m, **kw)
+
+
+def test_pp_loss_and_grad_parity():
+    mesh1 = make_mesh({"sp": 2})
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    batch = make_batch(jax.random.PRNGKey(1), CFG, mesh1, batch=2, seq=32)
+    args = (batch["tokens"], batch["positions"], batch["labels"])
+
+    loss1, grads1 = jax.value_and_grad(loss_fn)(params, *args, CFG, mesh1)
+
+    cfg_pp = _pp_cfg()
+    mesh_pp = make_mesh({"pp": 2, "sp": 2})
+    params_pp = {**params, "layers": stack_layers(params["layers"])}
+    batch_pp = make_batch(jax.random.PRNGKey(1), cfg_pp, mesh_pp, batch=2,
+                          seq=32)
+    args_pp = (batch_pp["tokens"], batch_pp["positions"], batch_pp["labels"])
+    loss_pp, grads_pp = jax.value_and_grad(loss_fn)(
+        params_pp, *args_pp, cfg_pp, mesh_pp)
+
+    np.testing.assert_allclose(float(loss_pp), float(loss1), rtol=1e-5)
+    # stacked layer grads match the per-layer grads of the regular path
+    un = unstack_layers(grads_pp["layers"], CFG.n_layers)
+    for i in range(CFG.n_layers):
+        for k in grads1["layers"][i]:
+            np.testing.assert_allclose(
+                np.asarray(un[i][k]), np.asarray(grads1["layers"][i][k]),
+                rtol=1e-4, atol=1e-5, err_msg=f"layer {i} {k}")
+    for k in ("embed", "final_norm", "lm_head"):
+        np.testing.assert_allclose(
+            np.asarray(grads_pp[k]), np.asarray(grads1[k]),
+            rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_pp_remat_matches():
+    cfg_pp = _pp_cfg()
+    mesh_pp = make_mesh({"pp": 2, "sp": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg_pp)
+    batch = make_batch(jax.random.PRNGKey(1), cfg_pp, mesh_pp, batch=2, seq=32)
+    args = (batch["tokens"], batch["positions"], batch["labels"])
+    loss = loss_fn(params, *args, cfg_pp, mesh_pp)
+    loss_r = loss_fn(params, *args, replace(cfg_pp, remat=True), mesh_pp)
+    np.testing.assert_allclose(float(loss_r), float(loss), rtol=1e-6)
+
+
+def test_pp_dp_sp_train_step():
+    # the verdict's done-condition composition, plus dp: pp=2 x dp=2 x sp=2
+    cfg = _pp_cfg(batch_axis="dp")
+    mesh = make_mesh({"pp": 2, "dp": 2, "sp": 2})
+    tcfg = TrainConfig()
+    state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh)
+    step = make_train_step(cfg, tcfg, mesh)
+    batch = make_batch(jax.random.PRNGKey(1), cfg, mesh, batch=4, seq=32)
+    w0 = np.asarray(jax.tree.leaves(state[0])[0]).copy()
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["grad_norm"]))
+    w1 = np.asarray(jax.tree.leaves(state[0])[0])
+    assert not np.allclose(w0, w1), "params did not update"
+
+
+def test_pp_striped_layout():
+    cfg = _pp_cfg(layout="striped")
+    mesh = make_mesh({"pp": 2, "sp": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch = make_batch(jax.random.PRNGKey(1), cfg, mesh, batch=2, seq=32)
+    loss = loss_fn(params, batch["tokens"], batch["positions"],
+                   batch["labels"], cfg, mesh)
+    assert np.isfinite(float(loss))
+
+
+def test_pp_guard_rails():
+    mesh = make_mesh({"pp": 2, "sp": 2})
+    batch_cfg = _pp_cfg()
+    params = init_params(jax.random.PRNGKey(0), batch_cfg)
+    batch = make_batch(jax.random.PRNGKey(1), batch_cfg, mesh, batch=2, seq=32)
+    args = (batch["tokens"], batch["positions"], batch["labels"])
+
+    with pytest.raises(ValueError, match="tensor parallelism"):
+        loss_fn(params, *args, _pp_cfg(head_axis="tp"), mesh)
+    with pytest.raises(ValueError, match="not divisible by pp"):
+        loss_fn(params, *args, _pp_cfg(n_layers=3), mesh)
+    with pytest.raises(ValueError, match="pp_microbatches"):
+        loss_fn(params, *args, _pp_cfg(m=4), mesh)
+    with pytest.raises(ValueError, match="MoE"):
+        loss_fn(params, *args,
+                _pp_cfg(n_experts=2, expert_axis=None), mesh)
