@@ -26,9 +26,13 @@ whose body is fully manual per-shard code —
 The backward pipeline schedule is free: jax.grad of scan + ppermute IS the
 reverse schedule (ppermute transposes to the reverse permutation).
 
-Restrictions (explicit errors below): no tensor parallelism (head_axis) and
-no MoE inside the pp path — both would need hand-written megatron/dispatch
-collectives in the manual body; compose them with dp/sp instead.
+Tensor parallelism composes too: the megatron collectives GSPMD would infer
+for the regular path are hand-written in `_layer_fwd` (column-sliced
+qkv/gate/up, row-sliced wo/down, one psum over `tp` after each of attention
+and the MLP).  Embeddings/lm_head stay replicated in pp mode (vocab-dim
+sharding would need a masked-lookup + psum in the manual body for marginal
+memory win).  MoE inside the pp path is still excluded (explicit error) —
+its expert dispatch is the one remaining hand-written collective.
 
 Parameter layout: `layers` holds stacked leaves [n_layers, ...] (dim 0
 sharded over `pp`), not the regular list-of-dicts — see
@@ -46,7 +50,7 @@ from ..parallel.burst import BurstConfig, burst_attn_shard, _resolve_backend
 # the pure math MUST be shared with the regular path: a numerics change
 # there must not silently break pp=1 vs pp=N parity (_mlp's dense path is
 # per-shard pure math too — cfg=None selects it)
-from .transformer import _mlp, _rms_norm, _rope
+from .transformer import _mlp, _rms_norm, _rope, param_specs
 
 
 def stack_layers(layers):
@@ -61,8 +65,14 @@ def unstack_layers(stacked, n_layers):
 
 
 def _layer_fwd(p, x, positions, cfg, bcfg: BurstConfig):
-    """One transformer block, per-shard (x [mb, s_local, d]): local einsums
-    + the burst ring over the sequence axes."""
+    """One transformer block, per-shard (x [mb, s_local, d]).
+
+    Tensor parallelism is hand-written megatron: qkv/gate/up weights arrive
+    column-sliced over `tp` (so the einsums run on the local head/ffn
+    shard), wo/down row-sliced, and the two psums below reduce the partial
+    outputs — exactly the collectives GSPMD infers for the regular path's
+    param_specs, made explicit because this body is inside shard_map."""
+    tp = cfg.head_axis
     h = _rms_norm(x, p["attn_norm"])
     q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
     k = jnp.einsum("bsd,dnh->bnsh", h, p["wk"])
@@ -70,8 +80,14 @@ def _layer_fwd(p, x, positions, cfg, bcfg: BurstConfig):
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     o = burst_attn_shard(q, k, v, bcfg)
-    x = x + jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
-    return x + _mlp(p, x)[0]
+    attn = jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+    if tp is not None:
+        attn = lax.psum(attn, tp)
+    x = x + attn
+    mlp_out = _mlp(p, x)[0]
+    if tp is not None:
+        mlp_out = lax.psum(mlp_out, tp)
+    return x + mlp_out
 
 
 def _pp_forward_shard(layers_p, embed, final_norm, lm_head, tokens, positions,
@@ -138,9 +154,16 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh):
     Same contract as transformer.forward_with_aux; dispatched from there
     when cfg.pp_axis is set."""
     if cfg.head_axis is not None:
-        raise ValueError(
-            "pipeline parallelism does not compose with tensor parallelism "
-            "(head_axis); use pp x dp x sp")
+        if cfg.head_axis not in mesh.shape:
+            raise ValueError(
+                f"head_axis {cfg.head_axis!r} is not an axis of the mesh "
+                f"{dict(mesh.shape)}; set head_axis=None (ModelConfig "
+                "defaults it to 'tp') or add the axis to the mesh")
+        tp_size = mesh.shape[cfg.head_axis]
+        if cfg.n_heads % tp_size or cfg.n_kv_heads % tp_size:
+            raise ValueError(
+                f"n_heads {cfg.n_heads} / n_kv_heads {cfg.n_kv_heads} not "
+                f"divisible by {cfg.head_axis!r} mesh size {tp_size}")
     if cfg.n_experts:
         raise ValueError("pipeline parallelism does not compose with MoE")
     if cfg.attn_strategy != "burst":
@@ -172,10 +195,15 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh):
     )
     seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
     tok_spec = P(cfg.batch_axis, seq_spec)
+    # full per-leaf specs, not a P(pp) prefix: with tp the qkv/gate/up/wo/
+    # down leaves are column/row-sliced over head_axis too, and a prefix
+    # spec would hand every tp shard the full weights (double-counted after
+    # the body's psums)
+    layer_specs = param_specs(cfg)["layers"]
     fn = jax.shard_map(
         partial(_pp_forward_shard, cfg=cfg, bcfg=bcfg, m=m),
         mesh=mesh,
-        in_specs=(P(cfg.pp_axis), P(), P(), P(), tok_spec, tok_spec),
+        in_specs=(layer_specs, P(), P(), P(), tok_spec, tok_spec),
         out_specs=P(cfg.batch_axis, seq_spec, None),
         check_vma=False,
     )

@@ -206,8 +206,6 @@ def main(argv=None):
     # a pp= axis turns on the pipeline-parallel forward (pipeline_lm.py);
     # microbatches default to the stage count (the GPipe sweet spot floor)
     pp_axis = "pp" if "pp" in mesh_axes else None
-    if pp_axis and "tp" in mesh_axes:
-        raise SystemExit("--mesh: pp does not compose with tp (use pp x dp x sp)")
     if args.microbatches and not pp_axis:
         raise SystemExit("--microbatches requires a pp= axis in --mesh")
     cfg = ModelConfig(

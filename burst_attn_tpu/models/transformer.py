@@ -160,8 +160,11 @@ def param_specs(cfg: ModelConfig) -> Params:
             w_down=P(tp, None),
         )
     if cfg.pp_axis is not None:
-        # stacked layout: leading stage/layer dim sharded over pp; the pp
-        # path forbids tp, so the remaining dims are replicated
+        # stacked layout: leading stage/layer dim sharded over pp, with the
+        # per-leaf tp axes PRESERVED in the trailing dims — pipeline_lm
+        # passes these specs as shard_map in_specs, and its hand-written
+        # megatron psums assume column/row-sliced weights (replicating them
+        # here would double-count after the psums)
         layer = {k: P(cfg.pp_axis, *s) for k, s in layer.items()}
         return {
             "embed": P(None, None),

@@ -85,6 +85,40 @@ def test_pp_dp_sp_train_step():
     assert not np.allclose(w0, w1), "params did not update"
 
 
+def test_pp_tp_sp_parity():
+    # pp x tp x sp: hand-written megatron psums in the pp body must match
+    # the regular GSPMD tp path exactly
+    cfg_tp = replace(CFG, head_axis="tp")
+    mesh_tp = make_mesh({"tp": 2, "sp": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg_tp)
+    batch = make_batch(jax.random.PRNGKey(1), cfg_tp, mesh_tp, batch=2, seq=32)
+    args = (batch["tokens"], batch["positions"], batch["labels"])
+    loss1, grads1 = jax.value_and_grad(loss_fn)(params, *args, cfg_tp, mesh_tp)
+
+    cfg_pp = _pp_cfg(head_axis="tp")
+    mesh_pp = make_mesh({"pp": 2, "tp": 2, "sp": 2})
+    params_pp = {**params, "layers": stack_layers(params["layers"])}
+    batch_pp = make_batch(jax.random.PRNGKey(1), cfg_pp, mesh_pp, batch=2,
+                          seq=32)
+    args_pp = (batch_pp["tokens"], batch_pp["positions"], batch_pp["labels"])
+    loss_pp, grads_pp = jax.value_and_grad(loss_fn)(
+        params_pp, *args_pp, cfg_pp, mesh_pp)
+
+    np.testing.assert_allclose(float(loss_pp), float(loss1), rtol=1e-5)
+    un = unstack_layers(grads_pp["layers"], CFG.n_layers)
+    for i in range(CFG.n_layers):
+        for k in grads1["layers"][i]:
+            np.testing.assert_allclose(
+                np.asarray(un[i][k]), np.asarray(grads1["layers"][i][k]),
+                rtol=1e-4, atol=1e-5, err_msg=f"layer {i} {k}")
+    # replicated params: shard_map's transpose must psum their cotangents
+    # across tp without over-counting
+    for k in ("embed", "final_norm", "lm_head"):
+        np.testing.assert_allclose(
+            np.asarray(grads_pp[k]), np.asarray(grads1[k]),
+            rtol=1e-4, atol=1e-5, err_msg=k)
+
+
 def test_pp_pallas_backend_parity():
     # the Pallas kernels (interpret mode on CPU) inside the pp path match
     # the jnp tile — kernels-in-pipeline certification
@@ -116,8 +150,11 @@ def test_pp_guard_rails():
     batch = make_batch(jax.random.PRNGKey(1), batch_cfg, mesh, batch=2, seq=32)
     args = (batch["tokens"], batch["positions"], batch["labels"])
 
-    with pytest.raises(ValueError, match="tensor parallelism"):
+    with pytest.raises(ValueError, match="is not an axis of the mesh"):
         loss_fn(params, *args, _pp_cfg(head_axis="tp"), mesh)
+    mesh_tp4 = make_mesh({"pp": 2, "tp": 4, "sp": 1})
+    with pytest.raises(ValueError, match="not divisible by 'tp'"):
+        loss_fn(params, *args, _pp_cfg(head_axis="tp"), mesh_tp4)
     with pytest.raises(ValueError, match="not divisible by pp"):
         loss_fn(params, *args, _pp_cfg(n_layers=3), mesh)
     with pytest.raises(ValueError, match="pp_microbatches"):
