@@ -29,10 +29,12 @@ reverse schedule (ppermute transposes to the reverse permutation).
 Tensor parallelism composes too: the megatron collectives GSPMD would infer
 for the regular path are hand-written in `_layer_fwd` (column-sliced
 qkv/gate/up, row-sliced wo/down, one psum over `tp` after each of attention
-and the MLP).  Embeddings/lm_head stay replicated in pp mode (vocab-dim
-sharding would need a masked-lookup + psum in the manual body for marginal
-memory win).  MoE inside the pp path is still excluded (explicit error) —
-its expert dispatch is the one remaining hand-written collective.
+and the MLP).  So does MoE: `moe_shard` is already a per-shard function, so
+the pp body calls it directly with the expert dim sliced over `ep` by the
+outer shard_map; per-stage aux losses accumulate over live ticks only
+(bubble ticks compute garbage) and psum over pp.  Embeddings/lm_head stay
+replicated in pp mode (vocab-dim sharding would need a masked-lookup + psum
+in the manual body for marginal memory win).
 
 Parameter layout: `layers` holds stacked leaves [n_layers, ...] (dim 0
 sharded over `pp`), not the regular list-of-dicts — see
@@ -64,14 +66,41 @@ def unstack_layers(stacked, n_layers):
     return [jax.tree.map(lambda a: a[i], stacked) for i in range(n_layers)]
 
 
+def _moe_block(p, x, cfg):
+    """Per-shard routed MoE (training path): the same moe_shard call the
+    regular path's _mlp makes inside ITS shard_map, minus the wrapper —
+    here the outer pp shard_map has already sliced the expert dim over
+    `ep`.  Routing groups are this stage's (microbatch x seq-shard) tokens.
+    Returns (out, aux) with aux pmean'd over every token-sharding axis."""
+    from ..parallel.moe import MoEParams, capacity_for, moe_shard
+
+    h = _rms_norm(x, p["mlp_norm"])
+    bb, ss, dd = h.shape
+    tokens = bb * ss
+    cap = capacity_for(tokens, cfg.n_experts, cfg.moe_top_k,
+                       cfg.moe_capacity_factor)
+    mp = MoEParams(p["router"], p["w_gate"], p["w_up"], p["w_down"])
+    y, aux, _ = moe_shard(mp, h.reshape(tokens, dd), top_k=cfg.moe_top_k,
+                          capacity=cap, axis=cfg.expert_axis)
+    rest = tuple(a for a in (cfg.batch_axis, *cfg.seq_axes)
+                 if a is not None and a != cfg.expert_axis)
+    if rest:
+        aux = lax.pmean(aux, rest)
+    return y.reshape(bb, ss, dd), aux
+
+
 def _layer_fwd(p, x, positions, cfg, bcfg: BurstConfig):
-    """One transformer block, per-shard (x [mb, s_local, d]).
+    """One transformer block, per-shard (x [mb, s_local, d]) ->
+    (x, aux_loss).
 
     Tensor parallelism is hand-written megatron: qkv/gate/up weights arrive
     column-sliced over `tp` (so the einsums run on the local head/ffn
     shard), wo/down row-sliced, and the two psums below reduce the partial
     outputs — exactly the collectives GSPMD infers for the regular path's
-    param_specs, made explicit because this body is inside shard_map."""
+    param_specs, made explicit because this body is inside shard_map.
+    MoE layers (cfg.n_experts) route per-stage token groups over `ep`;
+    expert weights are replicated across tp (as in the regular path), so
+    the MoE output needs no tp psum."""
     tp = cfg.head_axis
     h = _rms_norm(x, p["attn_norm"])
     q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
@@ -84,10 +113,13 @@ def _layer_fwd(p, x, positions, cfg, bcfg: BurstConfig):
     if tp is not None:
         attn = lax.psum(attn, tp)
     x = x + attn
-    mlp_out = _mlp(p, x)[0]
-    if tp is not None:
-        mlp_out = lax.psum(mlp_out, tp)
-    return x + mlp_out
+    if cfg.n_experts:
+        mlp_out, aux = _moe_block(p, x, cfg)
+    else:
+        mlp_out, aux = _mlp(p, x)[0], jnp.float32(0.0)
+        if tp is not None:
+            mlp_out = lax.psum(mlp_out, tp)
+    return x + mlp_out, aux
 
 
 def _pp_forward_shard(layers_p, embed, final_norm, lm_head, tokens, positions,
@@ -107,29 +139,35 @@ def _pp_forward_shard(layers_p, embed, final_norm, lm_head, tokens, positions,
     pos_mb = positions.reshape(m, mb, s_l)
 
     def stage_fn(x, pos):
-        def body(x, p):
-            return _layer_fwd(p, x, pos, cfg, bcfg), None
+        def body(carry, p):
+            x, aux = carry
+            x, aux_l = _layer_fwd(p, x, pos, cfg, bcfg)
+            return (x, aux + aux_l), None
 
         if cfg.remat:
             body = jax.checkpoint(body)
-        x, _ = lax.scan(body, x, layers_p)
-        return x
+        (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), layers_p)
+        return x, aux
 
     ticks = m + n_stages - 1
     buf = jnp.zeros_like(x_mb[0])  # activation arriving from the left
     out = jnp.zeros_like(x_mb)     # banked results (last stage only)
 
     def tick(carry, t):
-        buf, out = carry
+        buf, out, aux_acc = carry
         inject = lax.dynamic_index_in_dim(
             x_mb, jnp.minimum(t, m - 1), axis=0, keepdims=False)
         cur = jnp.where(stage == 0, inject, buf)
         # the activation at stage s on tick t is microbatch t - s; its
         # positions (rope) must travel with it.  Clamped: bubble ticks
         # compute garbage that is never banked.
+        mb_id = t - stage
         pos = lax.dynamic_index_in_dim(
-            pos_mb, jnp.clip(t - stage, 0, m - 1), axis=0, keepdims=False)
-        y = stage_fn(cur, pos)
+            pos_mb, jnp.clip(mb_id, 0, m - 1), axis=0, keepdims=False)
+        y, aux_t = stage_fn(cur, pos)
+        # MoE aux from bubble ticks (garbage activations) must not count
+        live = (mb_id >= 0) & (mb_id < m)
+        aux_acc = aux_acc + jnp.where(live, aux_t, 0.0)
         out_id = t - (n_stages - 1)
         bank = (stage == n_stages - 1) & (out_id >= 0)
         banked = lax.dynamic_update_index_in_dim(
@@ -137,22 +175,32 @@ def _pp_forward_shard(layers_p, embed, final_norm, lm_head, tokens, positions,
         out = jnp.where(bank, banked, out)
         nxt = lax.ppermute(
             y, pp, [(i, (i + 1) % n_stages) for i in range(n_stages)])
-        return (nxt, out), None
+        return (nxt, out, aux_acc), None
 
-    (_, out), _ = lax.scan(tick, (buf, out), jnp.arange(ticks))
+    (_, out, aux_acc), _ = lax.scan(
+        tick, (buf, out, jnp.float32(0.0)), jnp.arange(ticks))
     # banked outputs live on the last stage; psum replicates them so every
-    # pp shard computes the (cheap) head on its own dp x sp shard
+    # pp shard computes the (cheap) head on its own dp x sp shard.  aux:
+    # each stage holds its own layers' aux summed over its m live ticks —
+    # psum over pp completes the layer sum, / m averages microbatches
+    # (identical to the regular path when m == 1).
+    aux = lax.psum(aux_acc, pp) / m
     xf = lax.psum(out, pp).reshape(b_l, s_l, d)
     xf = _rms_norm(xf, final_norm)
-    return jnp.einsum("bsd,vd->bsv", xf, lm_head,
-                      preferred_element_type=jnp.float32)
+    logits = jnp.einsum("bsd,vd->bsv", xf, lm_head,
+                        preferred_element_type=jnp.float32)
+    return logits, aux
 
 
 def pp_forward_with_aux(params, tokens, positions, cfg, mesh):
-    """Pipeline-parallel forward_with_aux: fp32 logits [B, S, vocab], aux=0.
+    """Pipeline-parallel forward_with_aux: fp32 logits [B, S, vocab] + the
+    MoE aux loss (0 for dense models).
 
     Same contract as transformer.forward_with_aux; dispatched from there
-    when cfg.pp_axis is set."""
+    when cfg.pp_axis is set.  With pp_microbatches > 1 the MoE aux (and
+    routing groups) are per-microbatch — the mean over microbatches, which
+    differs from the regular path's full-batch routing exactly the way
+    grad-accumulation microbatching does; m == 1 matches it exactly."""
     if cfg.head_axis is not None:
         if cfg.head_axis not in mesh.shape:
             raise ValueError(
@@ -164,8 +212,21 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh):
             raise ValueError(
                 f"n_heads {cfg.n_heads} / n_kv_heads {cfg.n_kv_heads} not "
                 f"divisible by {cfg.head_axis!r} mesh size {tp_size}")
-    if cfg.n_experts:
-        raise ValueError("pipeline parallelism does not compose with MoE")
+        if not cfg.n_experts and cfg.d_ff % tp_size:
+            raise ValueError(
+                f"d_ff {cfg.d_ff} not divisible by {cfg.head_axis!r} mesh "
+                f"size {tp_size} (the dense MLP weights are column-sliced "
+                "over tp)")
+    if cfg.n_experts and cfg.expert_axis is not None:
+        if cfg.expert_axis not in mesh.shape:
+            raise ValueError(
+                f"expert_axis {cfg.expert_axis!r} is not an axis of the "
+                f"mesh {dict(mesh.shape)}")
+        ep_size = mesh.shape[cfg.expert_axis]
+        if cfg.n_experts % ep_size:
+            raise ValueError(
+                f"n_experts {cfg.n_experts} not divisible by "
+                f"expert_axis {cfg.expert_axis!r} size {ep_size}")
     if cfg.attn_strategy != "burst":
         raise ValueError("pp path supports attn_strategy='burst' only")
     n_stages = mesh.shape[cfg.pp_axis]
@@ -204,9 +265,9 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh):
         partial(_pp_forward_shard, cfg=cfg, bcfg=bcfg, m=m),
         mesh=mesh,
         in_specs=(layer_specs, P(), P(), P(), tok_spec, tok_spec),
-        out_specs=P(cfg.batch_axis, seq_spec, None),
+        out_specs=(P(cfg.batch_axis, seq_spec, None), P()),
         check_vma=False,
     )
-    logits = fn(params["layers"], params["embed"], params["final_norm"],
-                params["lm_head"], tokens, positions)
-    return logits, jnp.float32(0.0)
+    logits, aux = fn(params["layers"], params["embed"], params["final_norm"],
+                     params["lm_head"], tokens, positions)
+    return logits, aux

@@ -297,8 +297,10 @@ def _mlp(p, x, cfg: Optional[ModelConfig] = None, mesh=None, inference=False):
                     )
                     y, aux = yc.reshape(tokens, dd), jnp.mean(aux)
             else:
-                cap = max(1, int(cfg.moe_capacity_factor * cfg.moe_top_k
-                                 * tokens / cfg.n_experts))
+                from ..parallel.moe import capacity_for
+
+                cap = capacity_for(tokens, cfg.n_experts, cfg.moe_top_k,
+                                   cfg.moe_capacity_factor)
                 y, aux = route(mp, h2, cap)
             # moe_shard pmeans over the expert axis; average the remaining
             # token-sharding axes so aux is replicated

@@ -133,6 +133,15 @@ def moe_shard(p: MoEParams, x, *, top_k: int, capacity: int, axis: Optional[str]
     return y.astype(x.dtype), aux, dropped
 
 
+def capacity_for(tokens: int, experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Per-group expert slot count — the ONE place the capacity rounding
+    policy lives (consumers: moe_apply, the LM's dense-path _mlp, and the
+    pipeline-parallel _moe_block; a policy change must move all three in
+    lockstep or pp-vs-regular MoE parity silently breaks)."""
+    return max(1, int(capacity_factor * top_k * tokens / experts))
+
+
 def moe_apply(p: MoEParams, x, *, mesh=None, axis: Optional[str] = "ep",
               top_k: int = 2, capacity_factor: float = 1.25):
     """MoE layer on [B, T, d] (or [T, d]) tokens.
@@ -148,11 +157,8 @@ def moe_apply(p: MoEParams, x, *, mesh=None, axis: Optional[str] = "ep",
     b, t, d = x.shape
     e = p.router.shape[1]
 
-    def capacity_for(tokens: int, experts: int) -> int:
-        return max(1, int(capacity_factor * top_k * tokens / experts))
-
     if mesh is None or axis is None:
-        cap = capacity_for(b * t, e)
+        cap = capacity_for(b * t, e, top_k, capacity_factor)
         y, aux, dropped = moe_shard(
             p, x.reshape(b * t, d), top_k=top_k, capacity=cap, axis=None
         )
@@ -164,7 +170,7 @@ def moe_apply(p: MoEParams, x, *, mesh=None, axis: Optional[str] = "ep",
         raise ValueError(f"experts {e} not divisible by ep axis size {ep}")
     if t % ep:
         raise ValueError(f"tokens {t} not divisible by ep axis size {ep}")
-    cap = capacity_for(b * t // ep, e)
+    cap = capacity_for(b * t // ep, e, top_k, capacity_factor)
 
     def body(p_shard, x_shard):
         bb, tt, _ = x_shard.shape
