@@ -1,0 +1,94 @@
+"""Root-cause probe for the bkv=4096 VMEM cliff (round-1 verdict item 4).
+
+Round-1 sweeps found a CLIFF, not a slope: fwd 2048x4096 / bwd 2048x2048 /
+1024x4096 collapse to ~56-76 TFLOPs/s while 2048x2048 reaches 150+.  The
+suspects: (a) Mosaic retiling/layout pathology once the f32 score tile
+exceeds some internal budget, (b) VMEM double-buffering pressure forcing
+serialization, (c) the compute-sub-block pipeline losing its overlap.
+
+This probe separates them by sweeping the compute sub-block at fixed
+memory block (same VMEM residency, different inner tiling) and capturing a
+per-config XLA trace: if (a), all bkc settings at bkv=4096 stay slow; if
+(b), small bkc recovers; the traces show whether the kernel serializes
+against DMA (gaps) or just runs uniformly slower (layout).
+
+    BURST_NO_TRI=1 python -m benchmarks.cliff_probe --trace-root cliff_traces
+
+(BURST_NO_TRI pins every config to the rectangular grid the round-1 cliff
+was measured on; the square control would otherwise take the triangular
+path while the 4096 configs can't, muddying the comparison.)
+"""
+
+import argparse
+import json
+import sys
+
+
+CONFIGS = [
+    # (block_q, block_kv, block_kv_compute) — None = kernel default
+    (2048, 2048, 1024),   # the v5e optimum (control)
+    (2048, 4096, 1024),   # the cliff
+    (2048, 4096, 512),    # cliff with smaller compute tile
+    (2048, 4096, 2048),   # cliff with bigger compute tile
+    (1024, 4096, 1024),   # cliff at half q block
+    (2048, 4096, 4096),   # no sub-blocking at all
+]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=65536)
+    ap.add_argument("--heads", type=int, default=32)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--trace-root", default=None,
+                    help="capture one XLA trace per config under this dir")
+    ap.add_argument("--out", default="cliff_probe.jsonl")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print("cliff_probe: not on TPU; refusing to record numbers",
+              file=sys.stderr)
+        sys.exit(1)
+
+    from benchmarks.benchmark import bench_fn, flops
+    from burst_attn_tpu.ops.pallas_flash import flash_attention
+
+    b, n, d, s = 1, args.heads, args.dim, args.seq
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, n, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, n, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, n, s, d), jnp.bfloat16)
+
+    for bq, bkv, bkc in CONFIGS:
+        fwd = jax.jit(
+            lambda q, k, v, bq=bq, bkv=bkv, bkc=bkc: jnp.sum(
+                flash_attention(q, k, v, None, True, bq, bkv,
+                                block_kv_compute=bkc).astype(jnp.float32)))
+        try:
+            t = bench_fn(fwd, q, k, v)
+        except Exception as e:  # a config may simply fail to compile
+            rec = {"block_q": bq, "block_kv": bkv, "block_kv_compute": bkc,
+                   "error": repr(e)[:300]}
+            print(json.dumps(rec), flush=True)
+            with open(args.out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            continue
+        tflops = flops(b, s, n, d, "fwd", True) / t / 1e12
+        rec = {"block_q": bq, "block_kv": bkv, "block_kv_compute": bkc,
+               "seq": s, "fwd_ms": round(t * 1e3, 3),
+               "fwd_tflops": round(tflops, 2)}
+        if args.trace_root:
+            tdir = f"{args.trace_root}/bq{bq}_bkv{bkv}_bkc{bkc}"
+            with jax.profiler.trace(tdir):
+                float(fwd(q, k, v))
+            rec["trace"] = tdir
+        print(json.dumps(rec), flush=True)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

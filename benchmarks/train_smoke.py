@@ -26,10 +26,19 @@ import numpy as np
 PEAK_BF16 = {"v5e": 197e12, "v4": 275e12, "v5p": 459e12, "v6": 918e12}
 
 
-def peak_flops(device) -> float:
+def peak_flops(device):
+    """(peak bf16 FLOPs/s, known) — falls back to the v5e peak for an
+    unrecognized generation, flagged so the recorded MFU is not mistaken
+    for a calibrated number."""
     from burst_attn_tpu.ops.tuning import canonical_kind
 
-    return PEAK_BF16.get(canonical_kind(device), 197e12)
+    kind = canonical_kind(device)
+    if kind in PEAK_BF16:
+        return PEAK_BF16[kind], True
+    print(f"train_smoke: unrecognized device kind "
+          f"{getattr(device, 'device_kind', '?')!r}; MFU uses the v5e peak",
+          file=sys.stderr)
+    return 197e12, False
 
 
 def main(argv=None):
@@ -76,7 +85,9 @@ def main(argv=None):
     batch = make_batch(jax.random.PRNGKey(1), cfg, mesh, batch=args.batch,
                        seq=args.seq)
 
-    for _ in range(args.warmup):
+    # at least one warmup: the first call compiles, and `metrics` must be
+    # bound before the sync below
+    for _ in range(max(1, args.warmup)):
         state, metrics = step(state, batch)
     float(metrics["loss"])  # sync
 
@@ -102,7 +113,8 @@ def main(argv=None):
                   * args.n_heads * (args.d_model // args.n_heads) / 2)
     flops_step = 6.0 * n_params * tokens + attn_flops
     dev = jax.devices()[0]
-    mfu = flops_step / step_s / peak_flops(dev)
+    peak, peak_known = peak_flops(dev)
+    mfu = flops_step / step_s / peak
     rec = {
         "device": dev.device_kind, "params": n_params, "batch": args.batch,
         "seq": args.seq, "d_model": args.d_model, "n_layers": args.n_layers,
@@ -111,6 +123,8 @@ def main(argv=None):
         "tokens_per_s": round(tok_per_s, 1),
         "model_tflops_per_s": round(flops_step / step_s / 1e12, 1),
         "mfu": round(mfu, 4),
+        "peak_bf16_tflops": peak / 1e12,
+        "peak_extrapolated": not peak_known,
         "trace_dir": args.trace_dir,
     }
     print(json.dumps(rec))

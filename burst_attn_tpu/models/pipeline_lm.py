@@ -52,7 +52,7 @@ from ..parallel.burst import BurstConfig, burst_attn_shard, _resolve_backend
 # the pure math MUST be shared with the regular path: a numerics change
 # there must not silently break pp=1 vs pp=N parity (_mlp's dense path is
 # per-shard pure math too — cfg=None selects it)
-from .transformer import _mlp, _rms_norm, _rope, param_specs
+from .transformer import _attn_out, _mlp, _qkv_proj, _rms_norm, param_specs
 
 
 def stack_layers(layers):
@@ -102,14 +102,9 @@ def _layer_fwd(p, x, positions, cfg, bcfg: BurstConfig):
     expert weights are replicated across tp (as in the regular path), so
     the MoE output needs no tp psum."""
     tp = cfg.head_axis
-    h = _rms_norm(x, p["attn_norm"])
-    q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
-    k = jnp.einsum("bsd,dnh->bnsh", h, p["wk"])
-    v = jnp.einsum("bsd,dnh->bnsh", h, p["wv"])
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q, k, v = _qkv_proj(p, x, positions, cfg)
     o = burst_attn_shard(q, k, v, bcfg)
-    attn = jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+    attn = _attn_out(p, o)
     if tp is not None:
         attn = lax.psum(attn, tp)
     x = x + attn
@@ -229,6 +224,14 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh):
                 f"expert_axis {cfg.expert_axis!r} size {ep_size}")
     if cfg.attn_strategy != "burst":
         raise ValueError("pp path supports attn_strategy='burst' only")
+    if cfg.pp_axis not in mesh.shape:
+        raise ValueError(
+            f"pp_axis {cfg.pp_axis!r} is not an axis of the mesh "
+            f"{dict(mesh.shape)}")
+    if cfg.batch_axis is not None and cfg.batch_axis not in mesh.shape:
+        raise ValueError(
+            f"batch_axis {cfg.batch_axis!r} is not an axis of the mesh "
+            f"{dict(mesh.shape)}; set batch_axis=None or add a dp axis")
     n_stages = mesh.shape[cfg.pp_axis]
     if cfg.n_layers % n_stages:
         raise ValueError(

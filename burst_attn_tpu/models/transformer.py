@@ -197,13 +197,25 @@ def _rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
-def _attention(p, x, positions, cfg: ModelConfig, mesh):
+def _qkv_proj(p, x, positions, cfg: ModelConfig):
+    """Norm + qkv projections + rotary — shared by the regular and
+    pipeline-parallel paths (a numerics change here must hit both, or the
+    pp-vs-regular parity tests break)."""
     h = _rms_norm(x, p["attn_norm"])
     q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
     k = jnp.einsum("bsd,dnh->bnsh", h, p["wk"])
     v = jnp.einsum("bsd,dnh->bnsh", h, p["wv"])
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    return (_rope(q, positions, cfg.rope_theta),
+            _rope(k, positions, cfg.rope_theta), v)
+
+
+def _attn_out(p, o):
+    """Output projection (row-parallel under tp) — shared like _qkv_proj."""
+    return jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+
+
+def _attention(p, x, positions, cfg: ModelConfig, mesh):
+    q, k, v = _qkv_proj(p, x, positions, cfg)
     if cfg.attn_strategy == "ulysses":
         if len(cfg.seq_axes) != 1:
             raise ValueError("ulysses supports a single sequence axis")
@@ -242,7 +254,7 @@ def _attention(p, x, positions, cfg: ModelConfig, mesh):
             f"unknown attn_strategy {cfg.attn_strategy!r}; "
             "expected 'burst' or 'ulysses'"
         )
-    return jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+    return _attn_out(p, o)
 
 
 def _mlp(p, x, cfg: Optional[ModelConfig] = None, mesh=None, inference=False):

@@ -1,0 +1,66 @@
+#!/bin/bash
+# Phase-2 TPU follow-ons (round-1 verdict items 2-4): waits for
+# scripts/tpu_watch.sh to finish its tests->sweep->bench sequence, then runs
+#   4. scaling tables  (seq 64K/128K b=1, batch 2/4 @32K, flash method)
+#   5. train-step MFU smoke + XLA trace
+#   6. bkv=4096 cliff probe (rect grids) + per-config traces
+# Results land in results_scaling.jsonl / results_smoke.jsonl /
+# cliff_probe.jsonl and trace dirs for the round artifacts.
+cd /root/repo || exit 1
+LOG=${TPU_WATCH2_LOG:-/root/repo/.tpu_watch2.log}
+exec >>"$LOG" 2>&1
+
+probe() {
+  timeout 180 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null
+}
+
+wait_for_tpu() {
+  while true; do
+    echo "[$(date -u +%F' '%T)] probing TPU"
+    if probe; then echo "[$(date -u +%F' '%T)] TPU UP"; return 0; fi
+    sleep 90
+  done
+}
+
+run_stage() {
+  local name="$1"; shift
+  local tmo="$1"; shift
+  for attempt in 1 2 3; do
+    echo "=== [$(date -u +%F' '%T)] stage $name (attempt $attempt) ==="
+    timeout "$tmo" "$@"
+    local rc=$?
+    echo "=== stage $name rc=$rc ==="
+    [ $rc -eq 0 ] && return 0
+    sleep 30
+    wait_for_tpu
+  done
+  return 1
+}
+
+# phase 1 owns the chip until its log says ALL DONE (never run two TPU
+# pythons at once); bail out to plain TPU-wait if phase 1 isn't running
+echo "[$(date -u +%F' '%T)] waiting for phase 1 (tpu_watch.sh) to finish"
+while pgrep -f "tpu_watc[h].sh" >/dev/null; do
+  grep -q "ALL DONE" /root/repo/.tpu_watch.log 2>/dev/null && break
+  sleep 120
+done
+wait_for_tpu
+
+run_stage scaling-seq 7200 python -m benchmarks.benchmark \
+  --methods flash --seqs 65536,131072 --causal --mesh 1 \
+  --out /root/repo/results_scaling.jsonl
+sleep 15
+run_stage scaling-b2 5400 python -m benchmarks.benchmark \
+  --methods flash --seqs 32768 --batch 2 --causal --mesh 1 \
+  --out /root/repo/results_scaling.jsonl
+sleep 15
+run_stage scaling-b4 5400 python -m benchmarks.benchmark \
+  --methods flash --seqs 32768 --batch 4 --causal --mesh 1 \
+  --out /root/repo/results_scaling.jsonl
+sleep 15
+run_stage smoke 5400 python -m benchmarks.train_smoke \
+  --trace-dir /root/repo/trace_smoke --out /root/repo/results_smoke.jsonl
+sleep 15
+run_stage cliff 10800 env BURST_NO_TRI=1 python -m benchmarks.cliff_probe \
+  --trace-root /root/repo/cliff_traces --out /root/repo/cliff_probe.jsonl
+echo "=== [$(date -u +%F' '%T)] PHASE2 ALL DONE ==="

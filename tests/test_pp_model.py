@@ -197,6 +197,17 @@ def test_pp_pallas_backend_parity():
     np.testing.assert_allclose(float(l_pl), float(l_jnp), rtol=1e-5)
 
 
+def test_pp_double_ring():
+    # pp composed with the hierarchical double ring (inter x intra seq axes)
+    cfg = _pp_cfg(seq_axes=("inter", "intra"))
+    mesh = make_mesh({"pp": 2, "inter": 2, "intra": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch = make_batch(jax.random.PRNGKey(1), cfg, mesh, batch=2, seq=64)
+    loss = loss_fn(params, batch["tokens"], batch["positions"],
+                   batch["labels"], cfg, mesh)
+    assert np.isfinite(float(loss))
+
+
 def test_pp_striped_layout():
     cfg = _pp_cfg(layout="striped")
     mesh = make_mesh({"pp": 2, "sp": 2})
