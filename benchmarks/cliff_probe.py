@@ -12,16 +12,20 @@ per-config XLA trace: if (a), all bkc settings at bkv=4096 stay slow; if
 (b), small bkc recovers; the traces show whether the kernel serializes
 against DMA (gaps) or just runs uniformly slower (layout).
 
-    BURST_NO_TRI=1 python -m benchmarks.cliff_probe --trace-root cliff_traces
+    python -m benchmarks.cliff_probe --trace-root cliff_traces
 
-(BURST_NO_TRI pins every config to the rectangular grid the round-1 cliff
-was measured on; the square control would otherwise take the triangular
-path while the 4096 configs can't, muddying the comparison.)
+The probe pins BURST_NO_TRI=1 itself (checked at trace time, so an
+in-process set works): every config must use the rectangular grid the
+round-1 cliff was measured on — the square control would otherwise take
+the triangular path while the 4096 configs can't, muddying the comparison.
 """
 
 import argparse
 import json
+import os
 import sys
+
+os.environ["BURST_NO_TRI"] = "1"
 
 
 CONFIGS = [
@@ -79,7 +83,7 @@ def main(argv=None):
         tflops = flops(b, s, n, d, "fwd", True) / t / 1e12
         rec = {"block_q": bq, "block_kv": bkv, "block_kv_compute": bkc,
                "seq": s, "fwd_ms": round(t * 1e3, 3),
-               "fwd_tflops": round(tflops, 2)}
+               "fwd_tflops": round(tflops, 2), "grid": "rect"}
         if args.trace_root:
             tdir = f"{args.trace_root}/bq{bq}_bkv{bkv}_bkc{bkc}"
             with jax.profiler.trace(tdir):

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .transformer import ModelConfig, _mlp, _rms_norm, _rope
+from .transformer import ModelConfig, _attn_out, _mlp, _qkv_proj, _rms_norm
 
 
 class LayerCache(NamedTuple):
@@ -86,12 +86,7 @@ def _cached_attention(p, x, positions, lc: LayerCache, cache_len, cfg: ModelConf
     itself, so the flash path applies and the cache buffers are write-only.
     """
     b, t, _ = x.shape
-    h = _rms_norm(x, p["attn_norm"])
-    q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
-    k = jnp.einsum("bsd,dnh->bnsh", h, p["wk"])
-    v = jnp.einsum("bsd,dnh->bnsh", h, p["wv"])
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q, k, v = _qkv_proj(p, x, positions, cfg)
 
     ck = lax.dynamic_update_slice(lc.k, k.astype(lc.k.dtype), (0, 0, cache_len, 0))
     cv = lax.dynamic_update_slice(lc.v, v.astype(lc.v.dtype), (0, 0, cache_len, 0))
@@ -112,7 +107,7 @@ def _cached_attention(p, x, positions, lc: LayerCache, cache_len, cfg: ModelConf
         prob = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
         o = jnp.einsum("bngij,bnjh->bngih", prob, cv)
         o = o.reshape(q.shape[0], cfg.n_heads, t, cfg.d_head)
-    out = jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+    out = _attn_out(p, o)
     return out, LayerCache(ck, cv)
 
 

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .transformer import ModelConfig, _mlp, _rms_norm, _rope
+from .transformer import ModelConfig, _attn_out, _mlp, _qkv_proj, _rms_norm
 from ..parallel import layouts
 from ..parallel.burst import burst_attn
 
@@ -43,16 +43,6 @@ class DistCache(NamedTuple):
     k_new: Tuple[jax.Array, ...]     # each [B, Nkv, R, D]
     v_new: Tuple[jax.Array, ...]
     n_new: jax.Array                 # scalar int32: valid positions in *_new
-
-
-def _qkv(p, x, positions, cfg):
-    h = _rms_norm(x, p["attn_norm"])
-    q = jnp.einsum("bsd,dnh->bnsh", h, p["wq"])
-    k = jnp.einsum("bsd,dnh->bnsh", h, p["wk"])
-    v = jnp.einsum("bsd,dnh->bnsh", h, p["wv"])
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
-    return q, k, v
 
 
 def dist_prefill(params, tokens, cfg: ModelConfig, mesh, *, gen_budget: int):
@@ -77,7 +67,7 @@ def dist_prefill(params, tokens, cfg: ModelConfig, mesh, *, gen_budget: int):
     x = lax.with_sharding_constraint(x, act_spec)
     ks, vs = [], []
     for p in params["layers"]:
-        q, k, v = _qkv(p, x, pos, cfg)
+        q, k, v = _qkv_proj(p, x, pos, cfg)
         k = lax.with_sharding_constraint(k.astype(cfg.dtype), kv_spec)
         v = lax.with_sharding_constraint(v.astype(cfg.dtype), kv_spec)
         ks.append(k)
@@ -88,7 +78,7 @@ def dist_prefill(params, tokens, cfg: ModelConfig, mesh, *, gen_budget: int):
             block_q=cfg.block_q, block_kv=cfg.block_kv,
             batch_axes=cfg.batch_axis, head_axes=cfg.head_axis,
         )
-        x = x + jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+        x = x + _attn_out(p, o)
         # inference=True: drop-free MoE routing, matching decode.py's prefill
         m, _ = _mlp(p, x, cfg, mesh, inference=True)
         x = lax.with_sharding_constraint(x + m, act_spec)
@@ -158,7 +148,7 @@ def dist_decode_step(params, token, position, cache: DistCache,
 
     k_new, v_new = [], []
     for li, p in enumerate(params["layers"]):
-        q, k, v = _qkv(p, x, pos, cfg)
+        q, k, v = _qkv_proj(p, x, pos, cfg)
 
         def shard_partial(q, kc, vc):
             m, l, acc = _partial_attn(q, kc, vc, scale)
@@ -191,7 +181,7 @@ def dist_decode_step(params, token, position, cache: DistCache,
         m_r, l_r, acc_r = _partial_attn(q, kr, vr, scale,
                                         n_valid=cache.n_new + 1)
         o = _merge([(m_c, l_c, acc_c), (m_r, l_r, acc_r)]).astype(cfg.dtype)
-        x = x + jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
+        x = x + _attn_out(p, o)
         m_out, _ = _mlp(p, x, cfg, inference=True)
         x = x + m_out
 

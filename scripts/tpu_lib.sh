@@ -1,0 +1,35 @@
+# Shared helpers for the TPU watcher scripts (sourced by tpu_watch.sh and
+# tpu_watch2.sh).  The axon tunnel can be down for hours and a second TPU
+# python loses the init race against the first, so: probe in a SUBPROCESS
+# with a timeout (an in-process hung tunnel hangs `import jax`
+# unrecoverably), and retry every stage after re-probing.
+
+probe() {
+  timeout 180 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null
+}
+
+wait_for_tpu() {
+  while true; do
+    echo "[$(date -u +%F' '%T)] probing TPU"
+    if probe; then
+      echo "[$(date -u +%F' '%T)] TPU UP"
+      return 0
+    fi
+    sleep 90
+  done
+}
+
+run_stage() {
+  local name="$1"; shift
+  local tmo="$1"; shift
+  for attempt in 1 2 3; do
+    echo "=== [$(date -u +%F' '%T)] stage $name (attempt $attempt) ==="
+    timeout "$tmo" "$@"
+    local rc=$?
+    echo "=== stage $name rc=$rc ==="
+    [ $rc -eq 0 ] && return 0
+    sleep 30
+    wait_for_tpu
+  done
+  return 1
+}

@@ -1,44 +1,13 @@
 #!/bin/bash
-# Round-2 TPU watcher: wait for the axon tunnel, then run the validation
-# sequence the round-1 verdict asked for:
+# Round-2 TPU watcher, phase 1: wait for the axon tunnel, then run the
+# validation sequence the round-1 verdict asked for:
 #   1. BURST_TESTS_TPU=1 pytest tests/test_fused_bwd.py  (tri kernels on-chip)
 #   2. block sweep for the tri fwd/bwd rows
 #   3. python bench.py  (driver headline metric)
-# Each stage retries once after re-probing: two TPU processes racing for the
-# tunnel can make the second fail with "UNAVAILABLE: TPU backend setup".
 cd /root/repo || exit 1
 LOG=${TPU_WATCH_LOG:-/root/repo/.tpu_watch.log}
 exec >>"$LOG" 2>&1
-
-probe() {
-  timeout 180 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null
-}
-
-wait_for_tpu() {
-  while true; do
-    echo "[$(date -u +%F' '%T)] probing TPU"
-    if probe; then
-      echo "[$(date -u +%F' '%T)] TPU UP"
-      return 0
-    fi
-    sleep 90
-  done
-}
-
-run_stage() {
-  local name="$1"; shift
-  local tmo="$1"; shift
-  for attempt in 1 2 3; do
-    echo "=== [$(date -u +%F' '%T)] stage $name (attempt $attempt) ==="
-    timeout "$tmo" "$@"
-    local rc=$?
-    echo "=== stage $name rc=$rc ==="
-    [ $rc -eq 0 ] && return 0
-    sleep 30
-    wait_for_tpu
-  done
-  return 1
-}
+. /root/repo/scripts/tpu_lib.sh
 
 wait_for_tpu
 run_stage tri-tests 5400 env BURST_TESTS_TPU=1 python -m pytest tests/test_fused_bwd.py -q

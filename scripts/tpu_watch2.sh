@@ -9,33 +9,7 @@
 cd /root/repo || exit 1
 LOG=${TPU_WATCH2_LOG:-/root/repo/.tpu_watch2.log}
 exec >>"$LOG" 2>&1
-
-probe() {
-  timeout 180 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null
-}
-
-wait_for_tpu() {
-  while true; do
-    echo "[$(date -u +%F' '%T)] probing TPU"
-    if probe; then echo "[$(date -u +%F' '%T)] TPU UP"; return 0; fi
-    sleep 90
-  done
-}
-
-run_stage() {
-  local name="$1"; shift
-  local tmo="$1"; shift
-  for attempt in 1 2 3; do
-    echo "=== [$(date -u +%F' '%T)] stage $name (attempt $attempt) ==="
-    timeout "$tmo" "$@"
-    local rc=$?
-    echo "=== stage $name rc=$rc ==="
-    [ $rc -eq 0 ] && return 0
-    sleep 30
-    wait_for_tpu
-  done
-  return 1
-}
+. /root/repo/scripts/tpu_lib.sh
 
 # phase 1 owns the chip until its log says ALL DONE (never run two TPU
 # pythons at once); bail out to plain TPU-wait if phase 1 isn't running
@@ -61,6 +35,6 @@ sleep 15
 run_stage smoke 5400 python -m benchmarks.train_smoke \
   --trace-dir /root/repo/trace_smoke --out /root/repo/results_smoke.jsonl
 sleep 15
-run_stage cliff 10800 env BURST_NO_TRI=1 python -m benchmarks.cliff_probe \
+run_stage cliff 10800 python -m benchmarks.cliff_probe \
   --trace-root /root/repo/cliff_traces --out /root/repo/cliff_probe.jsonl
 echo "=== [$(date -u +%F' '%T)] PHASE2 ALL DONE ==="

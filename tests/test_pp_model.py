@@ -197,15 +197,24 @@ def test_pp_pallas_backend_parity():
     np.testing.assert_allclose(float(l_pl), float(l_jnp), rtol=1e-5)
 
 
-def test_pp_double_ring():
-    # pp composed with the hierarchical double ring (inter x intra seq axes)
-    cfg = _pp_cfg(seq_axes=("inter", "intra"))
-    mesh = make_mesh({"pp": 2, "inter": 2, "intra": 2})
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    batch = make_batch(jax.random.PRNGKey(1), cfg, mesh, batch=2, seq=64)
-    loss = loss_fn(params, batch["tokens"], batch["positions"],
-                   batch["labels"], cfg, mesh)
-    assert np.isfinite(float(loss))
+def test_pp_double_ring_parity():
+    # pp composed with the hierarchical double ring (inter x intra seq
+    # axes) matches the regular double-ring path
+    cfg_r = replace(CFG, seq_axes=("inter", "intra"))
+    mesh_r = make_mesh({"inter": 2, "intra": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg_r)
+    batch = make_batch(jax.random.PRNGKey(1), cfg_r, mesh_r, batch=2, seq=64)
+    loss1 = loss_fn(params, batch["tokens"], batch["positions"],
+                    batch["labels"], cfg_r, mesh_r)
+
+    cfg_pp = _pp_cfg(base=cfg_r)
+    mesh_pp = make_mesh({"pp": 2, "inter": 2, "intra": 2})
+    params_pp = {**params, "layers": stack_layers(params["layers"])}
+    batch_pp = make_batch(jax.random.PRNGKey(1), cfg_pp, mesh_pp, batch=2,
+                          seq=64)
+    loss_pp = loss_fn(params_pp, batch_pp["tokens"], batch_pp["positions"],
+                      batch_pp["labels"], cfg_pp, mesh_pp)
+    np.testing.assert_allclose(float(loss_pp), float(loss1), rtol=1e-5)
 
 
 def test_pp_striped_layout():

@@ -71,6 +71,38 @@ def test_fit_resume_continues_stream(data_path, tmp_path):
     )
 
 
+def test_fit_pp_with_checkpoint_resume(data_path, tmp_path):
+    """fit() on a pp x sp mesh: the stacked-layer state trains, checkpoints,
+    and resumes to the same loss stream as an uninterrupted run."""
+    pytest.importorskip("orbax.checkpoint")
+    from dataclasses import replace
+
+    mesh = make_mesh({"pp": 2, "sp": 2})
+    cfg = replace(_cfg(batch_axis=None, head_axis=None,
+                       pp_axis="pp", pp_microbatches=2), n_layers=2)
+    tcfg = TrainConfig(lr=1e-3)
+    ckpt = str(tmp_path / "ckpt_pp")
+
+    run_all = RunConfig(data_path=data_path, steps=4, batch=2, seq_len=128,
+                        log_every=1)
+    _, hist_all = fit(cfg, tcfg, run_all, mesh)
+    assert all(np.isfinite(h["loss"]) for h in hist_all)
+    assert 4.5 < hist_all[0]["loss"] < 8.5
+
+    run_a = RunConfig(data_path=data_path, steps=2, batch=2, seq_len=128,
+                      ckpt_dir=ckpt, ckpt_every=100, log_every=1)
+    fit(cfg, tcfg, run_a, mesh)
+    run_b = RunConfig(data_path=data_path, steps=4, batch=2, seq_len=128,
+                      ckpt_dir=ckpt, ckpt_every=100, log_every=1)
+    _, hist_b = fit(cfg, tcfg, run_b, mesh)
+    assert hist_b[0]["step"] == 3
+    np.testing.assert_allclose(
+        [h["loss"] for h in hist_b],
+        [h["loss"] for h in hist_all[2:]],
+        rtol=2e-4,
+    )
+
+
 def test_grad_accum_matches_full_batch(data_path):
     """grad_accum=2 over batch 4 must produce the same mean loss and mean
     gradients as one full-batch step (up to f32 reduction-order noise —
