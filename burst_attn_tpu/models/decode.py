@@ -152,10 +152,40 @@ def prefill(params, tokens, cfg: ModelConfig, max_seq: int):
     return _forward_cached_impl(params, tokens, positions, cache, cfg, fresh=True)
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "max_seq", "temperature"))
+def sample_logits(logits, key, *, temperature: float = 0.0, top_k=None,
+                  top_p=None):
+    """[B, V] logits -> [B] sampled token ids.
+
+    temperature == 0 is greedy (top_k/top_p ignored).  Otherwise softmax
+    sampling at `temperature`, after optional top-k truncation and/or
+    top-p (nucleus) truncation — the kept set is the smallest prefix of
+    the sorted distribution whose probability reaches top_p.  All
+    selection is done by masking to -inf so the op stays one fused
+    [B, V]-wide program (no gathers of dynamic width)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        k_eff = min(int(top_k), logits.shape[-1])  # top_k > vocab = keep all
+        kth = jnp.sort(logits, axis=-1)[:, -k_eff][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum_before = jnp.cumsum(probs, axis=-1) - probs
+        keep = cum_before < top_p  # always keeps the argmax
+        thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "max_seq", "temperature",
+                                   "top_k", "top_p"))
 def generate(params, prompt, cfg: ModelConfig, *, steps: int, max_seq: int,
-             temperature: float = 0.0, rng=None):
-    """Greedy (temperature=0) or sampled generation.
+             temperature: float = 0.0, top_k=None, top_p=None, rng=None):
+    """Greedy (temperature=0) or sampled (temperature/top_k/top_p)
+    generation.
 
     prompt: [B, T] int32.  Returns [B, steps] generated tokens.  The decode
     loop is a lax.scan — one compiled program, no per-token dispatch.
@@ -168,9 +198,8 @@ def generate(params, prompt, cfg: ModelConfig, *, steps: int, max_seq: int,
     rng, first_key = jax.random.split(rng)
 
     def pick(logits_last, key):
-        if temperature > 0.0:
-            return jax.random.categorical(key, logits_last / temperature, axis=-1)
-        return jnp.argmax(logits_last, axis=-1)
+        return sample_logits(logits_last, key, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
 
     first = pick(logits[:, -1], first_key)
 

@@ -194,23 +194,29 @@ def dist_decode_step(params, token, position, cache: DistCache,
 
 
 def dist_generate(params, prompt, cfg: ModelConfig, mesh, *, steps: int,
-                  temperature: float = 0.0, rng=None):
+                  temperature: float = 0.0, top_k=None, top_p=None, rng=None):
     """Greedy/sampled generation with the sequence-sharded prompt cache.
 
     prompt [B, S] natural order; returns [B, steps] tokens.  The decode loop
     is a python loop over jitted steps (the cache pytree's shardings are
-    stable, so each step reuses one compiled program).
+    stable, so each step reuses one compiled program).  Sampling semantics
+    (temperature / top-k / top-p) are decode.sample_logits's.
     """
+    from .decode import sample_logits
+
     b, s = prompt.shape
     last_logits, cache = jax.jit(
         partial(dist_prefill, cfg=cfg, mesh=mesh, gen_budget=steps)
     )(params, prompt)
     rng = jax.random.PRNGKey(0) if rng is None else rng
 
+    # jitted with the sampling config closed over (Python constants): the
+    # per-token path must stay one cached program per step, not ~8 eager
+    # full-vocab dispatches through the device tunnel
+    @jax.jit
     def pick(logits, key):
-        if temperature > 0.0:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        return sample_logits(logits, key, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
 
     step_fn = jax.jit(partial(dist_decode_step, cfg=cfg, mesh=mesh))
     keys = jax.random.split(rng, steps + 1)

@@ -145,3 +145,74 @@ def test_generate_with_tp_sharded_params():
     )
     out = generate(sharded, prompt, cfg, steps=6, max_seq=64)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sample_logits_semantics():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from burst_attn_tpu.models.decode import sample_logits
+
+    logits = jnp.log(jnp.array([[0.6, 0.3, 0.08, 0.02]], jnp.float32))
+    keys = jax.random.split(jax.random.PRNGKey(0), 64)
+
+    # temperature 0 = greedy regardless of truncation args
+    assert int(sample_logits(logits, keys[0])[0]) == 0
+    assert int(sample_logits(logits, keys[0], temperature=0.0, top_k=3)[0]) == 0
+
+    # top_k=1 degenerates to greedy for any key
+    got = {int(sample_logits(logits, k, temperature=1.0, top_k=1)[0])
+           for k in keys}
+    assert got == {0}
+
+    # top_k=2: samples stay within the two best tokens, and both appear
+    got = {int(sample_logits(logits, k, temperature=1.0, top_k=2)[0])
+           for k in keys}
+    assert got == {0, 1}
+
+    # top_p=0.5: smallest prefix reaching 0.5 is {token 0}
+    got = {int(sample_logits(logits, k, temperature=1.0, top_p=0.5)[0])
+           for k in keys}
+    assert got == {0}
+
+    # top_p=0.95 keeps {0, 1, 2}, excludes the 2% tail
+    got = {int(sample_logits(logits, k, temperature=1.0, top_p=0.95)[0])
+           for k in keys}
+    assert got == {0, 1, 2}
+
+    # top_p always keeps the argmax even when it alone exceeds top_p
+    got = {int(sample_logits(logits, k, temperature=1.0, top_p=0.1)[0])
+           for k in keys}
+    assert got == {0}
+
+    # batch dim: rows sampled independently
+    two = jnp.concatenate([logits, logits[:, ::-1]], axis=0)
+    out = sample_logits(two, keys[0], temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out), [0, 3])
+
+
+def test_generate_with_sampling(setup):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from burst_attn_tpu.models.decode import generate
+
+    cfg, params, _ = setup
+    prompt = jnp.array([[3, 5, 7, 11]], jnp.int32)
+    toks = generate(params, prompt, cfg, steps=6, max_seq=32,
+                    temperature=0.8, top_k=8, top_p=0.9,
+                    rng=jax.random.PRNGKey(7))
+    assert toks.shape == (1, 6)
+    arr = np.asarray(toks)
+    assert ((0 <= arr) & (arr < cfg.vocab)).all()
+    # same rng -> same stream; different rng -> (almost surely) different
+    toks2 = generate(params, prompt, cfg, steps=6, max_seq=32,
+                     temperature=0.8, top_k=8, top_p=0.9,
+                     rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(arr, np.asarray(toks2))
+    toks3 = generate(params, prompt, cfg, steps=6, max_seq=32,
+                     temperature=0.8, top_k=8, top_p=0.9,
+                     rng=jax.random.PRNGKey(8))
+    assert not np.array_equal(arr, np.asarray(toks3))
