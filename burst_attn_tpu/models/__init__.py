@@ -1,9 +1,13 @@
 from .transformer import ModelConfig, init_params, forward, forward_with_aux, param_specs
 from .train import TrainConfig, make_mesh, init_train_state, train_step, loss_fn
-from .decode import Cache, forward_cached, generate, init_cache, prefill
+from .decode import Cache, forward_cached, generate, init_cache, prefill, sample_logits
 from .dist_decode import DistCache, dist_generate, dist_prefill
+from .pipeline_lm import stack_layers, unstack_layers
 
 __all__ = [
+    "sample_logits",
+    "stack_layers",
+    "unstack_layers",
     "ModelConfig",
     "init_params",
     "forward",
