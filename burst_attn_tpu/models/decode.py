@@ -45,7 +45,7 @@ def init_cache(cfg: ModelConfig, batch: int, max_seq: int) -> Cache:
     return Cache(layers, jnp.int32(0))
 
 
-def _flash_prompt_attention(q, k, v, use_flash=None):
+def _flash_prompt_attention(q, k, v, use_flash=None, window=None):
     """Causal self-attention over a fresh prompt — O(T) memory via the flash
     tile instead of the [T, max_seq] score matrix (which makes long-context
     prefill impossible: 32 heads x 32K x 32K f32 scores is ~137 GB).
@@ -66,7 +66,7 @@ def _flash_prompt_attention(q, k, v, use_flash=None):
         if pad:
             cfgp = [(0, 0), (0, 0), (0, pad), (0, 0)]
             q, k, v = (jnp.pad(a, cfgp) for a in (q, k, v))
-        o = flash_attention(q, k, v, None, True)
+        o = flash_attention(q, k, v, None, True, window=window)
         return o[:, :, :t] if pad else o
     from ..ops.tile import single_device_attention
 
@@ -75,7 +75,7 @@ def _flash_prompt_attention(q, k, v, use_flash=None):
     if group > 1:
         k = jnp.repeat(k, group, axis=1)
         v = jnp.repeat(v, group, axis=1)
-    return single_device_attention(q, k, v, causal=True)
+    return single_device_attention(q, k, v, causal=True, window=window)
 
 
 def _cached_attention(p, x, positions, lc: LayerCache, cache_len, cfg: ModelConfig,
@@ -92,7 +92,8 @@ def _cached_attention(p, x, positions, lc: LayerCache, cache_len, cfg: ModelConf
     cv = lax.dynamic_update_slice(lc.v, v.astype(lc.v.dtype), (0, 0, cache_len, 0))
 
     if fresh:
-        o = _flash_prompt_attention(q, k.astype(lc.k.dtype), v.astype(lc.v.dtype))
+        o = _flash_prompt_attention(q, k.astype(lc.k.dtype),
+                                    v.astype(lc.v.dtype), window=cfg.window)
     else:
         # GQA via a grouped query axis — never materialize a repeated cache
         # (at decode the [B, Nkv, max_seq, D] buffers dominate memory traffic)
@@ -103,7 +104,12 @@ def _cached_attention(p, x, positions, lc: LayerCache, cache_len, cfg: ModelConf
         ) * (cfg.d_head**-0.5)
         rows = jnp.arange(t, dtype=jnp.int32)[:, None]
         cols = jnp.arange(ck.shape[2], dtype=jnp.int32)[None, :]
-        s = jnp.where(cols <= cache_len + rows, s, float("-inf"))
+        visible = cols <= cache_len + rows
+        if cfg.window is not None:
+            # sliding window carries into decode: a query at global position
+            # cache_len + row sees only its last `window` positions
+            visible = visible & (cols > cache_len + rows - cfg.window)
+        s = jnp.where(visible, s, float("-inf"))
         prob = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
         o = jnp.einsum("bngij,bnjh->bngih", prob, cv)
         o = o.reshape(q.shape[0], cfg.n_heads, t, cfg.d_head)

@@ -51,6 +51,14 @@ def dist_prefill(params, tokens, cfg: ModelConfig, mesh, *, gen_budget: int):
     Returns (last_logits [B, vocab] fp32, DistCache).  S must divide by the
     sp world; gen_budget sizes the replicated recent-KV buffers.
     """
+    if cfg.window is not None:
+        # the sharded-cache decode step LSE-merges ALL old-cache shards; a
+        # window would need per-shard global-position masking there —
+        # unimplemented, and silently decoding full-causal would be a
+        # train/inference mismatch
+        raise NotImplementedError(
+            "dist_decode does not support sliding-window models yet; use "
+            "models.generate (single-chip decode supports cfg.window)")
     b, s = tokens.shape
     world = 1
     for a in cfg.seq_axes:

@@ -256,6 +256,7 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh):
         backend=_resolve_backend(cfg.attn_backend),
         block_q=cfg.block_q,
         block_kv=cfg.block_kv,
+        window=cfg.window,
     )
     seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
     tok_spec = P(cfg.batch_axis, seq_spec)

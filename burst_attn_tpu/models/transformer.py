@@ -49,6 +49,9 @@ class ModelConfig:
     attn_strategy: str = "burst"  # "burst" (ring) | "ulysses" (all-to-all)
     layout: str = "zigzag"  # ring layouts; ulysses uses natural order
     attn_backend: str = "auto"
+    # sliding-window causal attention (tokens each query may see, incl.
+    # itself); requires layout="contig" — see parallel/burst.py
+    window: Optional[int] = None
     seq_axes: Tuple[str, ...] = ("sp",)
     batch_axis: Optional[str] = "dp"
     head_axis: Optional[str] = "tp"
@@ -232,7 +235,7 @@ def _attention(p, x, positions, cfg: ModelConfig, mesh):
             q, k, v, mesh=mesh, seq_axis=cfg.seq_axes[0], causal=cfg.causal,
             backend=cfg.attn_backend, block_q=cfg.block_q,
             block_kv=cfg.block_kv, batch_axes=cfg.batch_axis,
-            head_axes=cfg.head_axis,
+            head_axes=cfg.head_axis, window=cfg.window,
         )
     elif cfg.attn_strategy == "burst":
         o = burst_attn(
@@ -248,6 +251,7 @@ def _attention(p, x, positions, cfg: ModelConfig, mesh):
             block_kv=cfg.block_kv,
             batch_axes=cfg.batch_axis,
             head_axes=cfg.head_axis,
+            window=cfg.window,
         )
     else:
         raise ValueError(

@@ -61,13 +61,35 @@ def full_spec(s_q: int, s_kv: int) -> MaskSpec:
     return MaskSpec(_i32(0), _i32(s_q), _i32(s_kv), _i32(0), _i32(0))
 
 
-def round_spec(q_part, kv_part, s_q: int, s_kv: int, causal: bool, layout: str) -> MaskSpec:
+def round_spec(q_part, kv_part, s_q: int, s_kv: int, causal: bool, layout: str,
+               window=None) -> MaskSpec:
     """Mask spec for one ring round.
 
     q_part / kv_part: global partition ids (traced int32 scalars) of the
     sequence chunks held by the query side and key/value side of this round.
     s_q / s_kv: static local sub-sequence lengths.  causal/layout: static.
+
+    `window` (static int, None = unlimited) adds a sliding-window lower
+    bound: each query attends to at most `window` keys ending at its causal
+    position.  Supported for the "contig" layout only — in natural token
+    order every ring round is the band `j <= i + delta` with `delta =
+    (q_part - kv_part) * s` (a traced offset), so one offset-form spec plus
+    the static window covers all rounds.  The zigzag/striped permutations
+    interleave two token ranges per shard, which breaks the single-band
+    structure a 5-scalar spec can express.
     """
+    if window is not None:
+        if layout != "contig":
+            raise ValueError(
+                f"window attention supports layout='contig' only, got "
+                f"{layout!r} (the zigzag/striped load-balancing permutations "
+                "break the band structure)")
+        if not causal:
+            raise ValueError("window attention requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        delta = (_i32(q_part) * s_q - _i32(kv_part) * s_kv).astype(jnp.int32)
+        return MaskSpec(_i32(0), _i32(s_q), _i32(s_kv), _i32(1), delta)
     if not causal:
         return full_spec(s_q, s_kv)
     if layout == "zigzag":
@@ -86,14 +108,18 @@ def round_spec(q_part, kv_part, s_q: int, s_kv: int, causal: bool, layout: str) 
         raise ValueError(f"unknown layout {layout!r}; expected one of {LAYOUTS}")
 
 
-def dense_mask(spec: MaskSpec, s_q: int, s_kv: int) -> jnp.ndarray:
+def dense_mask(spec: MaskSpec, s_q: int, s_kv: int, window=None) -> jnp.ndarray:
     """Materialize the [s_q, s_kv] boolean mask (True = attend).
 
     Used by the jnp tile (the numerics oracle) and by tests; the Pallas
     kernels compute the same predicate block-wise with dynamic loop bounds.
+    `window` (static) keeps only the last `window` visible columns of each
+    row's causal range: cols > rows + offset - window.
     """
     rows = jnp.arange(s_q, dtype=jnp.int32)[:, None]
     cols = jnp.arange(s_kv, dtype=jnp.int32)[None, :]
     m = (rows >= spec.q_lo) & (rows < spec.q_hi) & (cols < spec.kv_hi)
     causal_ok = jnp.where(spec.causal > 0, cols <= rows + spec.offset, True)
+    if window is not None:
+        causal_ok = causal_ok & (cols > rows + spec.offset - window)
     return m & causal_ok

@@ -133,24 +133,36 @@ def _spec_array(spec: MaskSpec):
     )
 
 
-def _block_mask(spec_ref, r0, c0, bq, bkv):
-    """[bq, bkv] bool mask for the tile at rows r0.., cols c0.. (True=attend)."""
+def _block_mask(spec_ref, r0, c0, bq, bkv, wnd=None):
+    """[bq, bkv] bool mask for the tile at rows r0.., cols c0.. (True=attend).
+
+    `wnd` is the STATIC sliding-window width (None = unlimited); when None
+    the generated code is identical to the pre-window kernels — windowed
+    runs are the only ones that pay for the extra band term."""
     rows = r0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
     cols = c0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
     q_lo, q_hi, kv_hi = spec_ref[0], spec_ref[1], spec_ref[2]
     causal, offset = spec_ref[3], spec_ref[4]
     m = (rows >= q_lo) & (rows < q_hi) & (cols < kv_hi)
-    return m & ((causal == 0) | (cols <= rows + offset))
+    m = m & ((causal == 0) | (cols <= rows + offset))
+    if wnd is not None:
+        m = m & (cols > rows + offset - wnd)
+    return m
 
 
-def _block_has_work(spec_ref, r0, c0, bq, bkv):
+def _block_has_work(spec_ref, r0, c0, bq, bkv, wnd=None):
     q_lo, q_hi, kv_hi = spec_ref[0], spec_ref[1], spec_ref[2]
     causal, offset = spec_ref[3], spec_ref[4]
     ok = (r0 < q_hi) & (r0 + bq > q_lo) & (c0 < kv_hi)
-    return ok & ((causal == 0) | (c0 <= r0 + bq - 1 + offset))
+    ok = ok & ((causal == 0) | (c0 <= r0 + bq - 1 + offset))
+    if wnd is not None:
+        # union of the rows' visible bands is [r0+offset-wnd+1, ...): a
+        # block wholly left of it is dead
+        ok = ok & (c0 + bkv - 1 > r0 + offset - wnd)
+    return ok
 
 
-def _block_full(spec_ref, r0, c0, bq, bkv):
+def _block_full(spec_ref, r0, c0, bq, bkv, wnd=None):
     """True iff every (row, col) of the tile is visible — the fast path can
     skip mask construction and the elementwise selects entirely.  On a causal
     64-block grid ~97% of live blocks are interior, and the kernels are
@@ -158,7 +170,11 @@ def _block_full(spec_ref, r0, c0, bq, bkv):
     q_lo, q_hi, kv_hi = spec_ref[0], spec_ref[1], spec_ref[2]
     causal, offset = spec_ref[3], spec_ref[4]
     ok = (r0 >= q_lo) & (r0 + bq <= q_hi) & (c0 + bkv <= kv_hi)
-    return ok & ((causal == 0) | (c0 + bkv - 1 <= r0 + offset))
+    ok = ok & ((causal == 0) | (c0 + bkv - 1 <= r0 + offset))
+    if wnd is not None:
+        # intersection of the rows' bands starts at r0+bq-1+offset-wnd+1
+        ok = ok & (c0 > r0 + bq - 1 + offset - wnd)
+    return ok
 
 
 def _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks):
@@ -173,6 +189,25 @@ def _q_imin(spec_ref, j, bq, bkv, n_q_blocks):
     q_lo, causal, offset = spec_ref[0], spec_ref[3], spec_ref[4]
     lo = jnp.where(causal > 0, jnp.maximum(q_lo, j * bkv - offset), q_lo)
     return jnp.clip(lo // bq, 0, n_q_blocks - 1)
+
+
+def _kv_jmin(spec_ref, i, bq, bkv, n_kv_blocks, wnd):
+    """First useful kv-block index for q-block i under a sliding window
+    (DMA clamping: left-of-band blocks are dead, and clamping their fetch
+    index to the first live block makes them free — same trick as _kv_jmax
+    on the causal side).  Row i*bq's band starts at r0 + offset - wnd + 1."""
+    offset = spec_ref[4]
+    lo = i * bq + offset - wnd + 1
+    return jnp.clip(lo // bkv, 0, n_kv_blocks - 1)
+
+
+def _q_imax(spec_ref, j, bq, bkv, n_q_blocks, wnd):
+    """Last useful q-block index for kv-block j under a sliding window:
+    rows beyond c0 + bkv - 1 + wnd - 1 - offset have their whole band left
+    of this kv block."""
+    offset = spec_ref[4]
+    hi = j * bkv + bkv - 1 + wnd - 1 - offset
+    return jnp.clip(hi // bq, 0, n_q_blocks - 1)
 
 
 # ---------------------------------------------------------------------------
@@ -208,16 +243,20 @@ def _gqa_group(n: int, n_kv: int) -> int:
     return n // n_kv
 
 
-def _make_index_maps(bq, bkv, nqb, nkb, group):
+def _make_index_maps(bq, bkv, nqb, nkb, group, wnd=None):
     """Shared fwd/bwd(dq) index maps over the (batch, head, q-block, kv-block)
-    grid; kv fetches are clamped to the last useful block so fully-masked
-    blocks are never DMA'd."""
+    grid; kv fetches are clamped to the [first, last] useful block so
+    fully-masked blocks are never DMA'd (the lower clamp only exists under a
+    sliding window — without one, block 0 is always live causally)."""
 
     def q_map(b_, h, i, j, sp):
         return (b_, h, i, 0)
 
     def kv_map(b_, h, i, j, sp):
-        return (b_, h // group, jnp.minimum(j, _kv_jmax(sp, i, bq, bkv, nkb)), 0)
+        j_eff = jnp.minimum(j, _kv_jmax(sp, i, bq, bkv, nkb))
+        if wnd is not None:
+            j_eff = jnp.maximum(j_eff, _kv_jmin(sp, i, bq, bkv, nkb, wnd))
+        return (b_, h // group, j_eff, 0)
 
     def state_map(b_, h, i, j, sp):
         return (b_, h, 0, 0)
@@ -261,7 +300,7 @@ def _fwd_kernel(
     q_ref, k_ref, v_ref, m_in_ref, lse_in_ref, acc_in_ref,
     m_out_ref, lse_out_ref, acc_out_ref,
     m_scr, l_scr, acc_scr,
-    *, scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p, tri,
+    *, scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p, tri, wnd=None,
 ):
     if tri:
         nqb = n_kv_blocks  # square: bq == bkv, s_q == s_kv
@@ -290,10 +329,10 @@ def _fwd_kernel(
         fast_cond = ~is_fin
         masked_cond = is_fin
     else:
-        live = _block_has_work(spec_ref, r0, c0, bq, bkv) & (
+        live = _block_has_work(spec_ref, r0, c0, bq, bkv, wnd) & (
             j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks)
         )
-        full = _block_full(spec_ref, r0, c0, bq, bkv)
+        full = _block_full(spec_ref, r0, c0, bq, bkv, wnd)
         fast_cond = live & full
         masked_cond = live & ~full
 
@@ -350,7 +389,8 @@ def _fwd_kernel(
         for u in range(n_sub):
             s_next = _score(u + 1) if u + 1 < n_sub else None
             mask = (
-                _block_mask(spec_ref, r0, c0 + u * bkv_compute, bq, bkv_compute)
+                _block_mask(spec_ref, r0, c0 + u * bkv_compute, bq,
+                            bkv_compute, wnd)
                 if masked else None
             )
             m, l, alpha, p = _softmax(s_cur, mask, m, l)
@@ -381,7 +421,7 @@ def _fwd_kernel(
 
 def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
               block_q=1024, block_kv=1024, block_kv_compute=None,
-              interpret=None, cast_p=True, triangular=False):
+              interpret=None, cast_p=True, triangular=False, window=None):
     """One online-softmax ring round on TPU.  Same contract as
     ops/tile.py:tile_fwd: returns updated (m, lse, acc).
 
@@ -418,7 +458,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
             _pad_seq(lse, sq_pad, float("-inf")), _pad_seq(acc, sq_pad),
             scale, spec, block_q=block_q, block_kv=block_kv,
             block_kv_compute=block_kv_compute, interpret=interpret,
-            cast_p=cast_p, triangular=False,
+            cast_p=cast_p, triangular=False, window=window,
         )
         return m2[:, :, :s_q], lse2[:, :, :s_q], acc2[:, :, :s_q]
     bq = _pick_block(s_q, block_q)
@@ -429,7 +469,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     lp = _pick_block(bq, 128)
     nqb = s_q // bq
     nkb = s_kv // bkv
-    tri = (bool(triangular) and not _tri_disabled()
+    tri = (bool(triangular) and window is None and not _tri_disabled()
            and bq == bkv and s_q == s_kv and nqb % 2 == 0 and nqb >= 2)
     if tri:
         def q_map(b_, h, p, jp, sp):
@@ -443,11 +483,12 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
 
         grid = (b, n, nqb // 2, nqb + 1)
     else:
-        q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group)
+        q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group,
+                                                    wnd=window)
         grid = (b, n, nqb, nkb)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, bq=bq, bkv=bkv, bkv_compute=bkc, lp=lp,
-        n_kv_blocks=nkb, cast_p=cast_p, tri=tri,
+        n_kv_blocks=nkb, cast_p=cast_p, tri=tri, wnd=window,
     )
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     out_shape = [
@@ -502,7 +543,7 @@ def _dq_kernel(
     do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
     dq_ref,
     dq_scr, lse_scr, delta_scr,
-    *, scale, bq, bkv, lp, n_kv_blocks,
+    *, scale, bq, bkv, lp, n_kv_blocks, wnd=None,
 ):
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -519,10 +560,10 @@ def _dq_kernel(
         lse_scr[:] = jnp.where(lse == NEG_INF, BIG_LSE, lse * LOG2E)
         delta_scr[:] = _read_rows(delta_ref, i, bq, lp)
 
-    live = _block_has_work(spec_ref, r0, c0, bq, bkv) & (
+    live = _block_has_work(spec_ref, r0, c0, bq, bkv, wnd) & (
         j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks)
     )
-    full = _block_full(spec_ref, r0, c0, bq, bkv)
+    full = _block_full(spec_ref, r0, c0, bq, bkv, wnd)
 
     def _accum(mask):
         q = q_ref[0, 0, :, :] * (scale * LOG2E)
@@ -552,7 +593,7 @@ def _dq_kernel(
 
     @pl.when(live & ~full)
     def _compute_masked():
-        _accum(_block_mask(spec_ref, r0, c0, bq, bkv))
+        _accum(_block_mask(spec_ref, r0, c0, bq, bkv, wnd))
 
     @pl.when(j == n_kv_blocks - 1)
     def _finish():
@@ -573,7 +614,7 @@ def _dkdv_kernel(
     do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
     dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale, bq, bkv, lp, n_q_blocks, group,
+    *, scale, bq, bkv, lp, n_q_blocks, group, wnd=None,
 ):
     j = pl.program_id(2)
     t = pl.program_id(3)
@@ -586,10 +627,10 @@ def _dkdv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live = _block_has_work(spec_ref, r0, c0, bq, bkv) & (
+    live = _block_has_work(spec_ref, r0, c0, bq, bkv, wnd) & (
         iq >= _q_imin(spec_ref, j, bq, bkv, n_q_blocks)
     )
-    full = _block_full(spec_ref, r0, c0, bq, bkv)
+    full = _block_full(spec_ref, r0, c0, bq, bkv, wnd)
 
     def _accum(mask):
         q = q_ref[0, 0, :, :]
@@ -630,7 +671,7 @@ def _dkdv_kernel(
 
     @pl.when(live & ~full)
     def _compute_masked():
-        _accum(_block_mask(spec_ref, r0, c0, bq, bkv))
+        _accum(_block_mask(spec_ref, r0, c0, bq, bkv, wnd))
 
     @pl.when(t == n_q_blocks * group - 1)
     def _finish():
@@ -1074,7 +1115,7 @@ def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv) -> bool:
 
 def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
               block_q=1024, block_kv=1024, interpret=None, fused=None,
-              triangular=False):
+              triangular=False, window=None):
     """One backward ring round on TPU.  Same contract as ops/tile.py:tile_bwd:
     returns (dq [B,N,S,D], dk [B,Nk,Skv,D], dv [B,Nk,Skv,D]) in float32.
 
@@ -1105,7 +1146,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
             _pad_seq(k, skv_pad), _pad_seq(v, skv_pad),
             _pad_seq(delta, sq_pad), _pad_seq(lse, sq_pad),
             scale, spec, block_q=block_q, block_kv=block_kv,
-            interpret=interpret, fused=fused, triangular=False,
+            interpret=interpret, fused=fused, triangular=False, window=window,
         )
         return dq[:, :, :s_q], dk[:, :, :s_kv], dv[:, :, :s_kv]
     bq = _pick_block(s_q, block_q)
@@ -1114,6 +1155,13 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     nqb = s_q // bq
     nkb = s_kv // bkv
     explicit_split = fused is False
+    if window is not None:
+        # windowed runs take the split kernels: the fused/tri schedules'
+        # dead-step and aliasing arguments assume full-window causality and
+        # have not been re-derived for a band (perf follow-up, not a
+        # correctness limit)
+        fused = False
+        triangular = False
     if fused is None:
         fused = not interpret and (s_q // bq) * group >= 4
     tri = (
@@ -1132,11 +1180,13 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
         )
 
     # ---- dq ----
-    q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group)
+    q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group,
+                                                wnd=window)
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp, n_kv_blocks=nkb
+            _dq_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp, n_kv_blocks=nkb,
+            wnd=window,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -1169,7 +1219,10 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
         return h * group + t // nqb
 
     def bq_map(b_, h, j, t, sp):
-        return (b_, qh_of(h, t), jnp.maximum(t % nqb, _q_imin(sp, j, bq, bkv, nqb)), 0)
+        iq = jnp.maximum(t % nqb, _q_imin(sp, j, bq, bkv, nqb))
+        if window is not None:
+            iq = jnp.minimum(iq, _q_imax(sp, j, bq, bkv, nqb, window))
+        return (b_, qh_of(h, t), iq, 0)
 
     def bstate_map(b_, h, j, t, sp):
         return (b_, qh_of(h, t), 0, 0)
@@ -1181,7 +1234,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkdv_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp,
-            n_q_blocks=nqb, group=group,
+            n_q_blocks=nqb, group=group, wnd=window,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -1222,9 +1275,10 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
 # test/test_burst.py:175-184)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def flash_attention(q, k, v, scale=None, causal=False, block_q=None, block_kv=None,
-                    block_q_bwd=None, block_kv_bwd=None, block_kv_compute=None):
+                    block_q_bwd=None, block_kv_bwd=None, block_kv_compute=None,
+                    window=None):
     """Fused single-device flash attention.  q,k,v [B,N,S,D] -> o [B,N,S,D].
 
     Block sizes default per TPU generation from ops/tuning.py (v5e measured
@@ -1232,44 +1286,54 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=None, block_kv=No
     1024x2048); the bwd blocks never default larger than the fwd blocks so a
     caller who shrinks the fwd blocks for VMEM keeps that budget in bwd.
     block_kv_compute splits the fwd kv memory block into compute sub-blocks
-    (see flash_fwd)."""
+    (see flash_fwd).
+
+    `window` (static int) enables sliding-window attention: each query
+    attends to its last `window` positions (inclusive of itself); requires
+    causal=True.  Off-diagonal blocks outside the band are skipped, so cost
+    scales with window, not sequence."""
     o, _ = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
-                                     block_kv_compute)
+                                     block_kv_compute, window)
     return o
 
 
 def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
-                              block_kv_compute=None):
+                              block_kv_compute=None, window=None):
     from .masks import round_spec
     from .tile import finalize as _finalize, init_state
 
     b, n, s, d = q.shape
     if scale is None:
         scale = d**-0.5
+    if window is not None and not causal:
+        raise ValueError("window attention requires causal=True")
     block_q, block_kv, _, _, block_kv_compute = resolve_blocks(
         block_q, block_kv, block_kv_compute=block_kv_compute)
+    # single-device: the windowed spec is the plain causal spec (delta = 0);
+    # the static `window` is what narrows the band
     spec = round_spec(jnp.int32(0), jnp.int32(0), s, k.shape[2], causal, "contig")
     m0, lse0, acc0 = init_state(b, n, s, d)
     m, lse, acc = flash_fwd(
         q, k, v, m0, lse0, acc0, scale, spec, block_q=block_q, block_kv=block_kv,
         block_kv_compute=block_kv_compute,
         # the spec here is statically known to be plain full-window causal,
-        # exactly the triangular grid's precondition
-        triangular=causal,
+        # exactly the triangular grid's precondition (tri declines windows)
+        triangular=causal, window=window,
     )
     o = _finalize(m, lse, acc, q.dtype)
     return o, lse
 
 
 def _flash_attention_vjp_fwd(q, k, v, scale, causal, block_q, block_kv,
-                             block_q_bwd, block_kv_bwd, block_kv_compute):
+                             block_q_bwd, block_kv_bwd, block_kv_compute,
+                             window):
     o, lse = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
-                                       block_kv_compute)
+                                       block_kv_compute, window)
     return o, (q, k, v, o, lse)
 
 
 def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
-                             block_kv_bwd, block_kv_compute, res, do):
+                             block_kv_bwd, block_kv_compute, window, res, do):
     from .masks import round_spec
 
     q, k, v, o, lse = res
@@ -1284,7 +1348,7 @@ def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
         do, q, k, v, delta, lse, scale, spec,
         block_q=block_q_bwd, block_kv=block_kv_bwd,
         # statically known plain full-window causal here (same as the fwd)
-        triangular=causal,
+        triangular=causal, window=window,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 

@@ -46,12 +46,13 @@ def _expand_kv(x, n_q_heads):
     return jnp.repeat(x, n_q_heads // n_kv, axis=1)
 
 
-def tile_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec):
-    """One online-softmax round; returns updated (m, lse, acc)."""
+def tile_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, window=None):
+    """One online-softmax round; returns updated (m, lse, acc).
+    `window` (static): sliding-window lower bound, see masks.dense_mask."""
     s_q, s_kv = q.shape[2], k.shape[2]
     k = _expand_kv(k, q.shape[1])
     v = _expand_kv(v, q.shape[1])
-    mask = dense_mask(spec, s_q, s_kv)
+    mask = dense_mask(spec, s_q, s_kv, window)
 
     s = jnp.einsum("bnid,bnjd->bnij", q, k, preferred_element_type=jnp.float32)
     s = s * scale
@@ -79,7 +80,7 @@ def finalize(m, lse, acc, dtype):
     return (acc * o_scale[..., None]).astype(dtype)
 
 
-def tile_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec):
+def tile_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, window=None):
     """One backward ring round; returns this round's (dq, dk, dv) in float32.
 
     delta = sum(o * do, axis=-1) [B, N, S] float32 (precomputed once — the
@@ -92,7 +93,7 @@ def tile_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec):
     s_q, s_kv = q.shape[2], k.shape[2]
     kx = _expand_kv(k, n_q)
     vx = _expand_kv(v, n_q)
-    mask = dense_mask(spec, s_q, s_kv)
+    mask = dense_mask(spec, s_q, s_kv, window)
 
     s = jnp.einsum("bnid,bnjd->bnij", q, kx, preferred_element_type=jnp.float32)
     s = s * scale
@@ -112,15 +113,17 @@ def tile_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec):
     return dq, dk, dv
 
 
-@partial(jax.jit, static_argnames=("causal",))
-def single_device_attention(q, k, v, scale=None, causal=False):
+@partial(jax.jit, static_argnames=("causal", "window"))
+def single_device_attention(q, k, v, scale=None, causal=False, window=None):
     """Full attention on one device via the tile (a one-round "ring")."""
     from .masks import round_spec
 
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window is not None and not causal:
+        raise ValueError("window attention requires causal=True")
     b, n, s, d = q.shape
     spec = round_spec(jnp.int32(0), jnp.int32(0), s, k.shape[2], causal, "contig")
     m, lse, acc = init_state(b, n, s, d)
-    m, lse, acc = tile_fwd(q, k, v, m, lse, acc, scale, spec)
+    m, lse, acc = tile_fwd(q, k, v, m, lse, acc, scale, spec, window=window)
     return finalize(m, lse, acc, q.dtype)

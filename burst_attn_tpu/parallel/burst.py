@@ -79,6 +79,11 @@ class BurstConfig:
     block_q_bwd: Optional[int] = None
     block_kv_bwd: Optional[int] = None
     deterministic: bool = True
+    # Sliding-window (band) causal attention: each query sees its last
+    # `window` positions.  Contig layout only (ops/masks.round_spec explains
+    # why the load-balancing permutations can't express a band); rounds
+    # wholly outside the band are dead and skipped block-wise.
+    window: Optional[int] = None
     # Structural causal scheduling (reference burst_attn_interface.py:221-235,
     # :303-367): zigzag rounds dispatch through a 3-way lax.cond whose
     # branches run statically-sliced dense tiles (full q x half kv / half q x
@@ -86,6 +91,22 @@ class BurstConfig:
     # masked tile whose rectangular grid is ~half dead steps.  Striped rounds
     # use the triangular grid directly (every round is full-window causal).
     case_split: bool = True
+
+    def __post_init__(self):
+        # validate here, not only in burst_attn(): direct BurstConfig users
+        # (burst_attn_shard inside their own shard_map, the pp trainer)
+        # must hit the same wall — a window on a zigzag/striped ring would
+        # silently band the PERMUTED local order
+        if self.window is not None:
+            if self.layout != "contig":
+                raise ValueError(
+                    "window attention requires layout='contig' (the "
+                    "zigzag/striped load-balancing permutations break the "
+                    f"band structure); got layout={self.layout!r}")
+            if not self.causal:
+                raise ValueError("window attention requires causal=True")
+            if self.window < 1:
+                raise ValueError(f"window must be >= 1, got {self.window}")
 
     def resolved_blocks(self):
         """ResolvedBlocks with None fields filled from the
@@ -110,8 +131,10 @@ def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec, triangular=False):
         return pallas_flash.flash_fwd(
             q, k, v, m, lse, acc, scale, spec,
             block_q=bq, block_kv=bkv, triangular=triangular,
+            window=cfg.window,
         )
-    return jnp_tile.tile_fwd(q, k, v, m, lse, acc, scale, spec)
+    return jnp_tile.tile_fwd(q, k, v, m, lse, acc, scale, spec,
+                             window=cfg.window)
 
 
 def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec, triangular=False):
@@ -122,9 +145,10 @@ def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec, triangular=False):
         bq, bkv = rb.block_q_bwd, rb.block_kv_bwd
         return pallas_flash.flash_bwd(
             do, q, k, v, delta, lse, scale, spec, block_q=bq, block_kv=bkv,
-            triangular=triangular,
+            triangular=triangular, window=cfg.window,
         )
-    return jnp_tile.tile_bwd(do, q, k, v, delta, lse, scale, spec)
+    return jnp_tile.tile_bwd(do, q, k, v, delta, lse, scale, spec,
+                             window=cfg.window)
 
 
 def _sizes(cfg):
@@ -196,7 +220,8 @@ def _fwd_impl(q, k, v, cfg: BurstConfig):
             # the triangular grid applies round-independently
             spec = round_spec(part_me, kv_part, s, s_kv, True, "striped")
             return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec, triangular=True)
-        spec = round_spec(part_me, kv_part, s, s_kv, cfg.causal, cfg.layout)
+        spec = round_spec(part_me, kv_part, s, s_kv, cfg.causal, cfg.layout,
+                          window=cfg.window)
         return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec)
 
     kv = (k, v)
@@ -302,7 +327,8 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
             spec = round_spec(q_part, part_me, s, s, True, "striped")
             return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec,
                              triangular=True)
-        spec = round_spec(q_part, part_me, s, s, cfg.causal, cfg.layout)
+        spec = round_spec(q_part, part_me, s, s, cfg.causal, cfg.layout,
+                          window=cfg.window)
         return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec)
 
     pay_base = payload
@@ -419,6 +445,7 @@ def burst_attn(
     batch_axes=None,
     head_axes=None,
     case_split: bool = True,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Burst attention on global arrays [B, N, S, D]; S must already be in
     layout order (parallel/layouts.to_layout) for causal runs.
@@ -439,6 +466,13 @@ def burst_attn(
         raise ValueError(f"seq_axes must have 1 or 2 names, got {seq_axes}")
     from ..ops.tuning import resolve_blocks
 
+    if window is not None and layout != "contig":
+        raise ValueError(
+            "window attention requires layout='contig' (the zigzag/striped "
+            "load-balancing permutations break the band structure); got "
+            f"layout={layout!r}")
+    if window is not None and not causal:
+        raise ValueError("window attention requires causal=True")
     block_q, block_kv, block_q_bwd, block_kv_bwd, _ = resolve_blocks(
         block_q, block_kv, block_q_bwd, block_kv_bwd)
     cfg = BurstConfig(
@@ -454,6 +488,7 @@ def burst_attn(
         block_q_bwd=block_q_bwd,
         block_kv_bwd=block_kv_bwd,
         case_split=case_split,
+        window=window,
     )
     seq_spec = seq_axes if len(seq_axes) > 1 else intra_axis
     spec = P(batch_axes, head_axes, seq_spec, None)

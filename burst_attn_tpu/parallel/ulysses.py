@@ -28,24 +28,28 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-def _local_attention(q, k, v, scale, causal, backend, block_q, block_kv):
+def _local_attention(q, k, v, scale, causal, backend, block_q, block_kv,
+                     window=None):
     if backend == "pallas":
         from ..ops.pallas_flash import flash_attention
 
-        return flash_attention(q, k, v, scale, causal, block_q, block_kv)
+        return flash_attention(q, k, v, scale, causal, block_q, block_kv,
+                               window=window)
     from ..ops.tile import single_device_attention
 
-    return single_device_attention(q, k, v, scale, causal)
+    return single_device_attention(q, k, v, scale, causal, window=window)
 
 
-def _ulysses_shard(q, k, v, *, axis, scale, causal, backend, block_q, block_kv):
+def _ulysses_shard(q, k, v, *, axis, scale, causal, backend, block_q, block_kv,
+                   window=None):
     """Per-shard [B, N, S/W, D] -> [B, N, S/W, D] with full-seq attention on
     N/W heads in between."""
     # scatter heads (axis 1), gather sequence (axis 2)
     qh = lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
     kh = lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
     vh = lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
-    o = _local_attention(qh, kh, vh, scale, causal, backend, block_q, block_kv)
+    o = _local_attention(qh, kh, vh, scale, causal, backend, block_q, block_kv,
+                         window)
     # scatter sequence back, gather heads
     return lax.all_to_all(o, axis, split_axis=2, concat_axis=1, tiled=True)
 
@@ -64,6 +68,7 @@ def ulysses_attn(
     block_kv: Optional[int] = None,
     batch_axes=None,
     head_axes=None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """All-to-all sequence-parallel attention on global [B, N, S, D] arrays.
 
@@ -87,6 +92,8 @@ def ulysses_attn(
         )
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window is not None and not causal:
+        raise ValueError("window attention requires causal=True")
     from ..ops.tuning import resolve_blocks
 
     block_q, block_kv = resolve_blocks(block_q, block_kv)[:2]
@@ -99,6 +106,7 @@ def ulysses_attn(
             backend=_resolve_backend(backend),
             block_q=block_q,
             block_kv=block_kv,
+            window=window,
         ),
         mesh=mesh,
         in_specs=(P(batch_axes, head_axes, seq_axis, None),) * 3,
