@@ -51,14 +51,6 @@ def dist_prefill(params, tokens, cfg: ModelConfig, mesh, *, gen_budget: int):
     Returns (last_logits [B, vocab] fp32, DistCache).  S must divide by the
     sp world; gen_budget sizes the replicated recent-KV buffers.
     """
-    if cfg.window is not None:
-        # the sharded-cache decode step LSE-merges ALL old-cache shards; a
-        # window would need per-shard global-position masking there —
-        # unimplemented, and silently decoding full-causal would be a
-        # train/inference mismatch
-        raise NotImplementedError(
-            "dist_decode does not support sliding-window models yet; use "
-            "models.generate (single-chip decode supports cfg.window)")
     b, s = tokens.shape
     world = 1
     for a in cfg.seq_axes:
@@ -85,6 +77,7 @@ def dist_prefill(params, tokens, cfg: ModelConfig, mesh, *, gen_budget: int):
             layout=cfg.layout, backend=cfg.attn_backend,
             block_q=cfg.block_q, block_kv=cfg.block_kv,
             batch_axes=cfg.batch_axis, head_axes=cfg.head_axis,
+            window=cfg.window,
         )
         x = x + _attn_out(p, o)
         # inference=True: drop-free MoE routing, matching decode.py's prefill
@@ -120,19 +113,23 @@ def _merge(parts):
     return acc_g / jnp.maximum(l_g, 1e-30)[..., None]
 
 
-def _partial_attn(q, k, v, scale, n_valid=None):
+def _partial_attn(q, k, v, scale, n_valid=None, col_lo=None):
     """Unnormalized online-softmax partial of q [B,N,1,D] against k/v
-    [B,Nk,T,D]; positions >= n_valid masked.  Returns (m, l, acc) with
-    leading [B, N, 1] shape.  GQA via a grouped query axis — the dominant
-    cache buffers are never repeated (decode.py's convention)."""
+    [B,Nk,T,D]; positions >= n_valid masked, positions < col_lo masked
+    (the sliding-window lower bound in this buffer's local coordinates).
+    Returns (m, l, acc) with leading [B, N, 1] shape.  GQA via a grouped
+    query axis — the dominant cache buffers are never repeated (decode.py's
+    convention)."""
     b, n, _, d = q.shape
     nk, t = k.shape[1], k.shape[2]
     qg = q.reshape(b, nk, n // nk, 1, d)
     s = jnp.einsum("bngid,bnjd->bngij", qg, k,
                    preferred_element_type=jnp.float32) * scale
+    cols = jnp.arange(t, dtype=jnp.int32)[None, None, None, None, :]
     if n_valid is not None:
-        cols = jnp.arange(t, dtype=jnp.int32)
-        s = jnp.where(cols[None, None, None, None, :] < n_valid, s, -jnp.inf)
+        s = jnp.where(cols < n_valid, s, -jnp.inf)
+    if col_lo is not None:
+        s = jnp.where(cols >= col_lo, s, -jnp.inf)
     m = jnp.max(s, axis=-1)
     # fully-masked partial (empty recent buffer): exp(-inf - -inf) guard
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
@@ -159,7 +156,19 @@ def dist_decode_step(params, token, position, cache: DistCache,
         q, k, v = _qkv_proj(p, x, pos, cfg)
 
         def shard_partial(q, kc, vc):
-            m, l, acc = _partial_attn(q, kc, vc, scale)
+            col_lo = None
+            if cfg.window is not None:
+                # contig layout (enforced for windowed models): this shard's
+                # first token is globally at part * s_local, so the band's
+                # global lower bound position - window + 1 lands at local
+                # column (position - window + 1) - part * s_local
+                from ..parallel.ring import my_partition
+
+                intra = sp_axes[-1]
+                inter = sp_axes[0] if len(sp_axes) > 1 else None
+                part = my_partition(intra, inter)
+                col_lo = position - cfg.window + 1 - part * kc.shape[2]
+            m, l, acc = _partial_attn(q, kc, vc, scale, col_lo=col_lo)
             # merge across the sequence shards in log space
             m_g = lax.pmax(m, sp_axes)
             w = jnp.exp(m - m_g)
@@ -186,8 +195,13 @@ def dist_decode_step(params, token, position, cache: DistCache,
             cache.v_new[li], v.astype(cfg.dtype), (0, 0, cache.n_new, 0))
         k_new.append(kr)
         v_new.append(vr)
+        # recent buffer slot j holds global position (position - n_new) + j,
+        # so the band's lower bound lands at slot n_new - window + 1
+        rec_lo = (cache.n_new - cfg.window + 1
+                  if cfg.window is not None else None)
         m_r, l_r, acc_r = _partial_attn(q, kr, vr, scale,
-                                        n_valid=cache.n_new + 1)
+                                        n_valid=cache.n_new + 1,
+                                        col_lo=rec_lo)
         o = _merge([(m_c, l_c, acc_c), (m_r, l_r, acc_r)]).astype(cfg.dtype)
         x = x + _attn_out(p, o)
         m_out, _ = _mlp(p, x, cfg, inference=True)

@@ -466,13 +466,7 @@ def burst_attn(
         raise ValueError(f"seq_axes must have 1 or 2 names, got {seq_axes}")
     from ..ops.tuning import resolve_blocks
 
-    if window is not None and layout != "contig":
-        raise ValueError(
-            "window attention requires layout='contig' (the zigzag/striped "
-            "load-balancing permutations break the band structure); got "
-            f"layout={layout!r}")
-    if window is not None and not causal:
-        raise ValueError("window attention requires causal=True")
+    # window validation lives in BurstConfig.__post_init__ (constructed below)
     block_q, block_kv, block_q_bwd, block_kv_bwd, _ = resolve_blocks(
         block_q, block_kv, block_q_bwd, block_kv_bwd)
     cfg = BurstConfig(

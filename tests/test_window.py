@@ -241,20 +241,45 @@ def test_decode_window_matches_forward():
                                rtol=2e-4, atol=2e-4)
 
 
-def test_dist_decode_window_unsupported():
-    from burst_attn_tpu.models import ModelConfig
-    from burst_attn_tpu.models.dist_decode import dist_prefill
+def test_dist_decode_window_matches_single_chip():
+    # sharded-cache decode applies the band per shard (global positions):
+    # logits must match the single-chip cached decode path step by step
+    from functools import partial
+
+    from burst_attn_tpu.models import (
+        ModelConfig, forward_cached, init_params, prefill,
+    )
+    from burst_attn_tpu.models.dist_decode import dist_decode_step, dist_prefill
     from burst_attn_tpu.models.train import make_mesh
 
     cfg = ModelConfig(
-        vocab=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2, d_head=16,
+        vocab=64, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2, d_head=16,
         d_ff=64, dtype=jnp.float32, attn_backend="jnp", remat=False,
         batch_axis=None, head_axis=None, layout="contig", window=8,
     )
     mesh = make_mesh({"sp": 2})
-    with pytest.raises(NotImplementedError, match="sliding-window"):
-        dist_prefill({}, jnp.zeros((1, 8), jnp.int32), cfg, mesh,
-                     gen_budget=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    s = 16
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, s), 0, 64)
+
+    last_d, dcache = jax.jit(partial(dist_prefill, cfg=cfg, mesh=mesh,
+                                     gen_budget=4))(params, tokens)
+    ref_logits, cache = prefill(params, tokens, cfg, max_seq=s + 4)
+    np.testing.assert_allclose(np.asarray(last_d),
+                               np.asarray(ref_logits[:, -1]),
+                               rtol=2e-4, atol=2e-4)
+
+    step = jax.jit(partial(dist_decode_step, cfg=cfg, mesh=mesh))
+    tok = jnp.argmax(last_d, axis=-1).astype(jnp.int32)
+    for i in range(3):
+        lg_d, dcache = step(params, tok, jnp.int32(s + i), dcache)
+        lg_ref, cache = forward_cached(
+            params, tok[:, None], jnp.full((1, 1), s + i, jnp.int32), cache,
+            cfg)
+        np.testing.assert_allclose(np.asarray(lg_d),
+                                   np.asarray(lg_ref[:, 0]),
+                                   rtol=2e-4, atol=2e-4, err_msg=f"step {i}")
+        tok = jnp.argmax(lg_d, axis=-1).astype(jnp.int32)
 
 
 def test_burst_config_validates_window():
