@@ -1,0 +1,85 @@
+"""Summarize the TPU watcher artifacts into README-ready tables.
+
+Reads (whichever exist): .bench_r2.json, sweep_r2.jsonl,
+results_scaling.jsonl, results_smoke.jsonl, cliff_probe.jsonl — and prints
+the measured numbers in the reference README's table format, plus the
+tuning-table row the sweep implies.  Run after scripts/tpu_watch{,2}.sh
+finish.
+"""
+
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rows(path):
+    p = os.path.join(ROOT, path)
+    if not os.path.exists(p):
+        return []
+    out = []
+    with open(p) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    return out
+
+
+def main():
+    bench = _rows(".bench_r2.json")
+    if bench:
+        b = bench[-1]
+        print(f"HEADLINE: {b.get('metric')}: {b.get('value')} "
+              f"{b.get('unit')}  (vs_baseline {b.get('vs_baseline')})")
+        if b.get("tri_fallback"):
+            print("  !! tri_fallback set — triangular kernels failed on-chip")
+
+    sweep = _rows("sweep_r2.jsonl")
+    if sweep:
+        print("\nSWEEP (per config):")
+        for r in sweep:
+            print("  ", json.dumps(r))
+
+    scaling = _rows("results_scaling.jsonl")
+    if scaling:
+        print("\nSCALING TABLE (reference README format, single-chip flash):")
+        print("| Seq | Batch | fwd ms | fwd+bwd ms | fwd TFLOPs/s | fwd+bwd TFLOPs/s |")
+        print("|---:|---:|---:|---:|---:|---:|")
+        for r in scaling:
+            print(f"| {r['seq']:,} | {r['batch']} | {r['fwd_ms']} | "
+                  f"{r['fwd_bwd_ms']} | {r['fwd_tflops_per_chip']} | "
+                  f"{r['fwd_bwd_tflops_per_chip']} |")
+
+    smoke = _rows("results_smoke.jsonl")
+    if smoke:
+        r = smoke[-1]
+        n_params = r.get("params")
+        params_s = f"{n_params:,}" if isinstance(n_params, int) else str(n_params)
+        print(f"\nTRAIN SMOKE: {params_s} params, seq {r.get('seq')}, "
+              f"step {r.get('step_ms')} ms, {r.get('tokens_per_s')} tok/s, "
+              f"MFU {r.get('mfu')} (peak {r.get('peak_bf16_tflops')} TF"
+              f"{', EXTRAPOLATED PEAK' if r.get('peak_extrapolated') else ''})"
+              f"; trace: {r.get('trace_dir')}")
+
+    cliff = _rows("cliff_probe.jsonl")
+    if cliff:
+        print("\nCLIFF PROBE (rect grids, BURST_NO_TRI):")
+        for r in cliff:
+            if "error" in r:
+                print(f"  bq{r['block_q']} bkv{r['block_kv']} "
+                      f"bkc{r['block_kv_compute']}: ERROR {r['error'][:80]}")
+            else:
+                print(f"  bq{r['block_q']} bkv{r['block_kv']} "
+                      f"bkc{r['block_kv_compute']}: {r['fwd_tflops']} TFLOPs/s "
+                      f"({r['fwd_ms']} ms)")
+
+    if not any((bench, sweep, scaling, smoke, cliff)):
+        print("no TPU artifacts found yet — watchers still waiting?")
+
+
+if __name__ == "__main__":
+    main()

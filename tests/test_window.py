@@ -262,16 +262,21 @@ def test_dist_decode_window_matches_single_chip():
     s = 16
     tokens = jax.random.randint(jax.random.PRNGKey(1), (1, s), 0, 64)
 
+    # gen_budget > window so later steps drive the recent-buffer band
+    # (rec_lo > 0) and the all-prompt-shards-masked regime
+    budget = 12
     last_d, dcache = jax.jit(partial(dist_prefill, cfg=cfg, mesh=mesh,
-                                     gen_budget=4))(params, tokens)
-    ref_logits, cache = prefill(params, tokens, cfg, max_seq=s + 4)
+                                     gen_budget=budget))(params, tokens)
+    ref_logits, cache = prefill(params, tokens, cfg, max_seq=s + budget)
     np.testing.assert_allclose(np.asarray(last_d),
                                np.asarray(ref_logits[:, -1]),
                                rtol=2e-4, atol=2e-4)
 
     step = jax.jit(partial(dist_decode_step, cfg=cfg, mesh=mesh))
     tok = jnp.argmax(last_d, axis=-1).astype(jnp.int32)
-    for i in range(3):
+    # 11 steps with window=8: from step 8 on, rec_lo = n_new - 7 > 0 and the
+    # whole band lives in the recent buffer (prompt shards fully masked)
+    for i in range(11):
         lg_d, dcache = step(params, tok, jnp.int32(s + i), dcache)
         lg_ref, cache = forward_cached(
             params, tok[:, None], jnp.full((1, 1), s + i, jnp.int32), cache,
