@@ -1,10 +1,10 @@
 """Summarize the TPU watcher artifacts into README-ready tables.
 
 Reads (whichever exist): .bench_r2.json, sweep_r2.jsonl,
-results_scaling.jsonl, results_smoke.jsonl, cliff_probe.jsonl — and prints
-the measured numbers in the reference README's table format, plus the
-tuning-table row the sweep implies.  Run after scripts/tpu_watch{,2}.sh
-finish.
+results_scaling.jsonl, results_smoke.jsonl, cliff_probe.jsonl,
+results_window.jsonl — and prints the measured numbers in the reference
+README's table format, plus the tuning-table row the sweep implies.  Run
+after scripts/tpu_watch{,2,3}.sh finish.
 """
 
 import json
@@ -77,7 +77,14 @@ def main():
                       f"bkc{r['block_kv_compute']}: {r['fwd_tflops']} TFLOPs/s "
                       f"({r['fwd_ms']} ms)")
 
-    if not any((bench, sweep, scaling, smoke, cliff)):
+    window = _rows("results_window.jsonl")
+    if window:
+        print("\nWINDOW SCALING (fwd, fixed seq):")
+        for r in window:
+            print(f"  window={r.get('window')}: {r.get('fwd_ms')} ms "
+                  f"({r.get('band_tflops')} band-TFLOPs/s)")
+
+    if not any((bench, sweep, scaling, smoke, cliff, window)):
         print("no TPU artifacts found yet — watchers still waiting?")
 
 

@@ -19,6 +19,18 @@ wait_for_tpu() {
   done
 }
 
+wait_for_phase() {
+  # Block until the predecessor watcher finishes: its process is gone, or
+  # its log contains the done token.  Falls through immediately when the
+  # predecessor never ran.  Usage: wait_for_phase <pgrep-pattern> <log> <token>
+  local pattern="$1" log="$2" token="$3"
+  echo "[$(date -u +%F' '%T)] waiting for $pattern ($token in $log)"
+  while pgrep -f "$pattern" >/dev/null; do
+    grep -q "$token" "$log" 2>/dev/null && break
+    sleep 120
+  done
+}
+
 run_stage() {
   local name="$1"; shift
   local tmo="$1"; shift

@@ -13,11 +13,7 @@ exec >>"$LOG" 2>&1
 
 # phase 1 owns the chip until its log says ALL DONE (never run two TPU
 # pythons at once); bail out to plain TPU-wait if phase 1 isn't running
-echo "[$(date -u +%F' '%T)] waiting for phase 1 (tpu_watch.sh) to finish"
-while pgrep -f "tpu_watc[h].sh" >/dev/null; do
-  grep -q "ALL DONE" /root/repo/.tpu_watch.log 2>/dev/null && break
-  sleep 120
-done
+wait_for_phase "tpu_watc[h].sh" /root/repo/.tpu_watch.log "ALL DONE"
 wait_for_tpu
 
 run_stage scaling-seq 7200 python -m benchmarks.benchmark \

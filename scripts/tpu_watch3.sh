@@ -1,0 +1,14 @@
+#!/bin/bash
+# Phase-3 TPU follow-on: windowed-attention scaling proof (cost ~ window).
+# Waits for phase 2 (tpu_watch2.sh) to finish, then runs window_bench.
+cd /root/repo || exit 1
+LOG=${TPU_WATCH3_LOG:-/root/repo/.tpu_watch3.log}
+exec >>"$LOG" 2>&1
+. /root/repo/scripts/tpu_lib.sh
+
+wait_for_phase "tpu_watch[2].sh" /root/repo/.tpu_watch2.log "PHASE2 ALL DONE"
+wait_for_tpu
+
+run_stage window-bench 10800 python -m benchmarks.window_bench \
+  --seq 65536 --windows none,16384,4096 --out /root/repo/results_window.jsonl
+echo "=== [$(date -u +%F' '%T)] PHASE3 ALL DONE ==="
