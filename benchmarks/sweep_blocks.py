@@ -28,6 +28,10 @@ def main():
                         "BQxBKVxtri (wrapped-diagonal causal grid); empty to skip")
     p.add_argument("--fwd-compute", default="",
                    help="comma list of BQxBKVxBKC (fwd with compute sub-block)")
+    p.add_argument("--ablate-fwd", default="",
+                   help="comma list of BQxBKV timed with the softmax chain "
+                        "stripped (wrong numerics; measures the MXU/pipeline "
+                        "ceiling to localize the fwd kernel's VPU cost)")
     args = p.parse_args()
 
     import jax
@@ -71,6 +75,25 @@ def main():
                     "tflops": round(flops(b, seq, n, d, "fwd", True) / t / 1e12, 1)})
         except Exception as e:  # noqa: BLE001 - record and continue the sweep
             record({"pass": "fwd", "bq": bq, "bkv": bkv, "bkc": bkc,
+                    "error": f"{type(e).__name__}: {e}"[:200]})
+
+    for bq, bkv in parse(args.ablate_fwd):
+        from burst_attn_tpu.ops.masks import round_spec
+        from burst_attn_tpu.ops.pallas_flash import flash_fwd
+        from burst_attn_tpu.ops.tile import init_state
+
+        spec = round_spec(jnp.int32(0), jnp.int32(0), seq, seq, True, "contig")
+        try:
+            f = jax.jit(lambda q, k, v, bq=bq, bkv=bkv, spec=spec: jnp.sum(
+                flash_fwd(q, k, v, *init_state(b, n, seq, d), d**-0.5, spec,
+                          block_q=bq, block_kv=bkv, triangular=True,
+                          _ablate="nosoftmax")[2]))
+            t = bench_fn(f, q, k, v)
+            record({"pass": "fwd-ablate-nosoftmax", "bq": bq, "bkv": bkv,
+                    "ms": round(t * 1e3, 2),
+                    "tflops": round(flops(b, seq, n, d, "fwd", True) / t / 1e12, 1)})
+        except Exception as e:  # noqa: BLE001
+            record({"pass": "fwd-ablate-nosoftmax", "bq": bq, "bkv": bkv,
                     "error": f"{type(e).__name__}: {e}"[:200]})
 
     bwd_cfgs = [c for c in args.bwd.split(",") if c]

@@ -2,6 +2,10 @@ from .transformer import ModelConfig, init_params, forward, forward_with_aux, pa
 from .train import TrainConfig, make_mesh, init_train_state, train_step, loss_fn
 from .decode import Cache, forward_cached, generate, init_cache, prefill, sample_logits
 from .dist_decode import DistCache, dist_generate, dist_prefill
+from .paged_decode import (
+    PagePool, PagedState, ensure_capacity, init_paged_state,
+    paged_decode_step, paged_prefill, retire_slot,
+)
 from .pipeline_lm import stack_layers, unstack_layers
 
 __all__ = [
@@ -26,4 +30,11 @@ __all__ = [
     "DistCache",
     "dist_generate",
     "dist_prefill",
+    "PagePool",
+    "PagedState",
+    "ensure_capacity",
+    "init_paged_state",
+    "paged_decode_step",
+    "paged_prefill",
+    "retire_slot",
 ]

@@ -1,0 +1,258 @@
+"""Paged KV-cache serving path: shared page pool + ragged continuous
+batching on top of ops/paged_attention.py.
+
+models/decode.py allocates a dense [B, Nkv, max_seq, D] cache per layer —
+worst-case memory per sequence, O(max_seq) decode compute, and batch slots
+are all-or-nothing.  This module is the serving-shaped alternative:
+
+  * `PagePool` (host-side, stateful): owns the free list of pool pages.
+    Sequences acquire pages as they grow and release them on retirement —
+    admission control falls out of `len(free)`.
+  * `PagedState` (device pytree): per-layer page pools, the page table,
+    per-sequence lengths, everything static-shaped — the host mutates the
+    TABLE (tiny int32 arrays), never reshapes device buffers, so the jitted
+    step functions never retrace as sequences come and go.
+  * `paged_prefill` absorbs a prompt into freshly-acquired pages (flash
+    attention over the contiguous prompt, then paged scatter of the rope'd
+    K/V); `paged_decode_step` appends one token per live sequence and
+    attends via the ragged paged kernel.  Sequences at different lengths
+    batch in the same call (ragged), empty slots cost one predicated grid
+    step per page slot.
+
+The batch dimension is a fixed number of SLOTS (max concurrent sequences);
+continuous batching = host assigns a finished slot's pages back to the free
+list and prefillls a new prompt into that slot, while other slots keep
+decoding.  Slot admission/retirement is host logic between steps — the
+device arrays never change shape.
+
+Reference parity: the reference has no serving layer at all (SURVEY.md §5
+"checkpoint/resume: none (op library)"); this extends the framework the
+same direction as models/decode.py but with pool semantics.  Kernel design
+notes in ops/paged_attention.py.
+"""
+
+from functools import partial
+from typing import List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .transformer import ModelConfig, _attn_out, _mlp, _qkv_proj, _rms_norm
+from .decode import _flash_prompt_attention, sample_logits
+from ..ops.paged_attention import paged_decode_attention
+
+
+class PagedState(NamedTuple):
+    """Device-side paged cache (one pool per layer, table shared)."""
+    k_pages: Tuple[jax.Array, ...]  # each [P, Nkv, page, D]
+    v_pages: Tuple[jax.Array, ...]
+    page_table: jax.Array           # [slots, max_pages_per_seq] int32
+    lengths: jax.Array              # [slots] int32 (0 = empty slot)
+
+
+class PagePool:
+    """Host-side page allocator for a PagedState.
+
+    Not a jax object: allocation decisions happen between jitted steps.
+    `acquire(n)` pops page ids from the free list (raises if exhausted —
+    callers use `available` for admission control); `release(ids)` returns
+    them.  The pool never touches device memory: pages are recycled by
+    table rewrite, stale contents are simply never addressed.
+    """
+
+    def __init__(self, n_pages: int):
+        # page 0 is RESERVED as the write sink for empty batch slots: the
+        # jitted decode step must scatter *something* per slot (static
+        # shapes), and routing dead slots' writes to a page no sequence can
+        # own keeps live pages clobber-free without per-slot predication.
+        self.n_pages = n_pages
+        self._free: List[int] = list(range(n_pages - 1, 0, -1))
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def acquire(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: want {n}, have {len(self._free)}")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def release(self, ids) -> None:
+        for i in ids:
+            if not 0 < i < self.n_pages:  # page 0 is the reserved sink
+                raise ValueError(f"bad page id {i}")
+            self._free.append(int(i))
+
+
+def init_paged_state(cfg: ModelConfig, *, slots: int, n_pages: int,
+                     page: int = 128, max_pages_per_seq: int = 64
+                     ) -> Tuple[PagedState, PagePool]:
+    """Fresh pool + allocator.  `page` must be a multiple of 128 (TPU lane
+    tile); total pool capacity is n_pages * page tokens shared by all
+    slots."""
+    if page % 128:
+        raise ValueError(f"page size {page} must be a multiple of 128")
+    shape = (n_pages, cfg.n_kv_heads, page, cfg.d_head)
+    k_pages = tuple(jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers))
+    v_pages = tuple(jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers))
+    table = jnp.zeros((slots, max_pages_per_seq), jnp.int32)
+    lengths = jnp.zeros((slots,), jnp.int32)
+    return PagedState(k_pages, v_pages, table, lengths), PagePool(n_pages)
+
+
+def _scatter_pages(pages, new, page_ids):
+    """Write [1, Nkv, T, D] rope'd K/V into pool pages `page_ids` (device
+    scatter; T padded to a whole number of pages by the caller)."""
+    page = pages.shape[2]
+    n = new.shape[2] // page
+    # [n, Nkv, page, D] chunks in page order
+    chunks = jnp.moveaxis(new[0], 1, 0).reshape(n, page, new.shape[1],
+                                                new.shape[3])
+    chunks = jnp.moveaxis(chunks, 2, 1)
+    return pages.at[page_ids].set(chunks.astype(pages.dtype))
+
+
+def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
+                  slot: int, cfg: ModelConfig):
+    """Absorb one prompt [T] into batch slot `slot`.
+
+    Host-side wrapper: acquires ceil(T/page) pages, runs the jitted prompt
+    pass (flash attention + paged K/V scatter), rewrites the slot's table
+    row.  Returns (last-token logits [vocab] fp32, new PagedState); the
+    acquired page ids are recorded in the returned state's table.
+    """
+    t = int(tokens.shape[0])
+    page = state.k_pages[0].shape[2]
+    max_pages = state.page_table.shape[1]
+    n_need = -(-t // page)
+    if n_need > max_pages:
+        raise ValueError(f"prompt needs {n_need} pages > table width {max_pages}")
+    if int(state.lengths[slot]) != 0:
+        raise RuntimeError(
+            f"slot {slot} is still live (len {int(state.lengths[slot])}); "
+            "retire_slot first or its pages leak")
+    ids = pool.acquire(n_need)
+    try:
+        logits, state = _paged_prefill_jit(
+            params, tokens[None, :], state, jnp.asarray(ids, jnp.int32),
+            jnp.int32(slot), cfg)
+    except Exception:
+        pool.release(ids)
+        raise
+    return logits[0], state
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
+                       slot, cfg: ModelConfig):
+    """slot is a TRACED int32 (one compile serves every slot); page_ids'
+    static LENGTH keys the compile — one cache entry per prompt page count."""
+    b, t = tokens.shape
+    page = state.k_pages[0].shape[2]
+    t_pad = -(-t // page) * page
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    k_pools, v_pools = [], []
+    for p, kp, vp in zip(params["layers"], state.k_pages, state.v_pages):
+        q, k, v = _qkv_proj(p, x, pos, cfg)
+        o = _flash_prompt_attention(q, k.astype(kp.dtype), v.astype(vp.dtype),
+                                    window=cfg.window)
+        pad = [(0, 0), (0, 0), (0, t_pad - t), (0, 0)]
+        k_pools.append(_scatter_pages(kp, jnp.pad(k, pad), page_ids))
+        v_pools.append(_scatter_pages(vp, jnp.pad(v, pad), page_ids))
+        x = x + _attn_out(p, o)
+        m, _ = _mlp(p, x, cfg, inference=True)
+        x = x + m
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,vd->bsv", x[:, -1:], params["lm_head"],
+                        preferred_element_type=jnp.float32)[:, 0]
+    table = lax.dynamic_update_slice(
+        state.page_table,
+        jnp.pad(page_ids, (0, state.page_table.shape[1] - page_ids.shape[0])
+                )[None, :],
+        (slot, jnp.int32(0)),
+    )
+    lengths = state.lengths.at[slot].set(t)
+    return logits, PagedState(tuple(k_pools), tuple(v_pools), table, lengths)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def paged_decode_step(params, tokens, state: PagedState, cfg: ModelConfig):
+    """One decode step for EVERY live slot (ragged batch).
+
+    tokens: [slots] int32 — next input token per slot (ignored for empty
+    slots).  Every live slot must have room for one more token in its last
+    page... or its NEXT page already in the table row (see
+    `ensure_capacity`).  Returns ([slots, vocab] fp32 logits, new state).
+    """
+    slots = tokens.shape[0]
+    page = state.k_pages[0].shape[2]
+    live = state.lengths > 0
+    pos = jnp.where(live, state.lengths, 0)  # next position = current length
+    x = params["embed"].astype(cfg.dtype)[tokens[:, None]]  # [slots, 1, d]
+    group = cfg.n_heads // cfg.n_kv_heads
+
+    # which (page, offset) receives the new token per slot
+    slot_page = state.lengths // page          # page slot index in table row
+    offset = state.lengths % page
+    page_id = jnp.take_along_axis(state.page_table, slot_page[:, None],
+                                  axis=1)[:, 0]
+    # dead slots write into the reserved sink page 0 (see PagePool) so their
+    # mandatory scatter never collides with a live page
+    page_id = jnp.where(live, page_id, 0)
+
+    k_pools, v_pools = [], []
+    for p, kp, vp in zip(params["layers"], state.k_pages, state.v_pages):
+        q, k, v = _qkv_proj(p, x, pos[:, None], cfg)
+        # append: scatter each slot's new K/V row into its page
+        kp = kp.at[page_id, :, offset].set(k[:, :, 0].astype(kp.dtype))
+        vp = vp.at[page_id, :, offset].set(v[:, :, 0].astype(vp.dtype))
+        qg = q.reshape(slots, cfg.n_kv_heads, group, cfg.d_head)
+        o = paged_decode_attention(qg, kp, vp, state.page_table,
+                                   state.lengths + live.astype(jnp.int32),
+                                   window=cfg.window)
+        o = o.reshape(slots, cfg.n_heads, 1, cfg.d_head)
+        x = x + _attn_out(p, o)
+        m, _ = _mlp(p, x, cfg, inference=True)
+        x = x + m
+        k_pools.append(kp)
+        v_pools.append(vp)
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)[:, 0]
+    lengths = state.lengths + live.astype(jnp.int32)
+    return logits, PagedState(tuple(k_pools), tuple(v_pools),
+                              state.page_table, lengths)
+
+
+def ensure_capacity(state: PagedState, pool: PagePool, slot: int) -> PagedState:
+    """Host-side: guarantee slot has a page for its next token, acquiring
+    one if its last page is full.  Call before paged_decode_step."""
+    length = int(state.lengths[slot])
+    page = state.k_pages[0].shape[2]
+    if length % page != 0 or length == 0:
+        return state  # room in the current page (or empty slot)
+    slot_page = length // page
+    if slot_page >= state.page_table.shape[1]:
+        raise RuntimeError(f"slot {slot} exceeded max_pages_per_seq")
+    if int(state.page_table[slot, slot_page]) != 0:
+        # idempotent: a prior (possibly aborted) pass already assigned the
+        # page — page 0 is the reserved sink, so 0 reliably means unassigned
+        return state
+    (new_id,) = pool.acquire(1)
+    table = state.page_table.at[slot, slot_page].set(new_id)
+    return state._replace(page_table=table)
+
+
+def retire_slot(state: PagedState, pool: PagePool, slot: int) -> PagedState:
+    """Host-side: release a finished sequence's pages and empty the slot."""
+    length = int(state.lengths[slot])
+    if length == 0:
+        return state
+    page = state.k_pages[0].shape[2]
+    n_used = -(-length // page)
+    pool.release([int(i) for i in state.page_table[slot, :n_used]])
+    return state._replace(lengths=state.lengths.at[slot].set(0))

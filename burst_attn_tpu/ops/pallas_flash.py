@@ -301,6 +301,7 @@ def _fwd_kernel(
     m_out_ref, lse_out_ref, acc_out_ref,
     m_scr, l_scr, acc_scr,
     *, scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p, tri, wnd=None,
+    ablate=None,
 ):
     if tri:
         nqb = n_kv_blocks  # square: bq == bkv, s_q == s_kv
@@ -393,11 +394,24 @@ def _fwd_kernel(
                             bkv_compute, wnd)
                 if masked else None
             )
-            m, l, alpha, p = _softmax(s_cur, mask, m, l)
+            if ablate == "nosoftmax":
+                # perf-debug ONLY (wrong numerics): p := s, softmax chain
+                # skipped — times the MXU/pipeline ceiling with zero VPU work
+                alpha, p = jnp.float32(1.0), (
+                    s_cur.astype(v_ref.dtype) if cast_p else s_cur)
+            else:
+                m, l, alpha, p = _softmax(s_cur, mask, m, l)
             if pend is not None:
                 acc = acc * pend[1] + _pv(pend[0], pend[2])
             pend = (u, alpha, p)
             s_cur = s_next
+        # NOTE on the step-tail drain: deferring this final pv across the
+        # grid step (the backward's _flush_dk trick) was measured on v5e and
+        # REGRESSES fwd 157.6 -> 123.7 TFLOPs/s — the [bq, bkc] p stash
+        # write/read costs more than the drained bubble.  The nosoftmax
+        # ablation (sweep_blocks --ablate-fwd) bounds the whole VPU chain's
+        # exposure at ~8% (206 ms vs 223 ms at seq=64K): the fwd ceiling is
+        # per-grid-step overhead, not softmax scheduling.
         acc = acc * pend[1] + _pv(pend[0], pend[2])
         m_scr[:], l_scr[:], acc_scr[:] = m, l, acc
 
@@ -421,7 +435,8 @@ def _fwd_kernel(
 
 def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
               block_q=1024, block_kv=1024, block_kv_compute=None,
-              interpret=None, cast_p=True, triangular=False, window=None):
+              interpret=None, cast_p=True, triangular=False, window=None,
+              _ablate=None):
     """One online-softmax ring round on TPU.  Same contract as
     ops/tile.py:tile_fwd: returns updated (m, lse, acc).
 
@@ -488,7 +503,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
         grid = (b, n, nqb, nkb)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, bq=bq, bkv=bkv, bkv_compute=bkc, lp=lp,
-        n_kv_blocks=nkb, cast_p=cast_p, tri=tri, wnd=window,
+        n_kv_blocks=nkb, cast_p=cast_p, tri=tri, wnd=window, ablate=_ablate,
     )
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     out_shape = [
