@@ -26,6 +26,9 @@ import os
 import sys
 
 os.environ["BURST_NO_TRI"] = "1"
+# the probe's entire point is to measure past-the-cliff configs: disable
+# the tuning-table clamp derived from its own findings
+os.environ["BURST_ALLOW_CLIFF"] = "1"
 
 
 CONFIGS = [

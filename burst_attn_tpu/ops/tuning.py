@@ -136,22 +136,58 @@ def block_defaults(device=None) -> BlockTable:
     return row
 
 
+# Measured VMEM-cliff law (benchmarks/cliff_probe.py on v5e, traces under
+# cliff_traces/): a fwd grid step whose q-block x kv-block AREA exceeds
+# 2048*2048 elements collapses ~3x (57 TFLOPs/s at 2048x4096 — at EVERY
+# compute-sub-block size, so it is not score materialization or pipeline
+# overlap; halving bq to 1024 recovers 142).  The backward's per-step
+# residency is larger (5 matmul operands + dk/dv scratch), so its cliff sits
+# one power of two lower.  It's a cliff, not a slope — exceeding the budget
+# is never a trade-off worth making, hence a clamp rather than a warning.
+_FWD_CLIFF_AREA = 2048 * 2048
+_BWD_CLIFF_AREA = 1024 * 2048
+
+
+def _cliff_ok():
+    """BURST_ALLOW_CLIFF=1 disables the clamp (sweeps/probes must be able
+    to measure the cliff configs themselves)."""
+    import os
+
+    return os.environ.get("BURST_ALLOW_CLIFF", "") not in ("", "0")
+
+
+def _clamp_cliff(bq: int, bkv: int, area: int, which: str):
+    if bq * bkv <= area or _cliff_ok():
+        return bq, bkv
+    new_bkv = max(area // bq, 128)
+    logger.warning(
+        "%s blocks %dx%d exceed the measured VMEM-cliff area (%d); clamping "
+        "kv block to %d (see cliff_probe.jsonl; BURST_ALLOW_CLIFF=1 to "
+        "measure cliff configs anyway)", which, bq, bkv, area, new_bkv)
+    return bq, new_bkv
+
+
 def resolve_blocks(block_q=None, block_kv=None, block_q_bwd=None,
                    block_kv_bwd=None, block_kv_compute=None) -> ResolvedBlocks:
     """Fill unspecified kernel block sizes from the per-generation table.
 
     The bwd defaults never exceed the (resolved) fwd blocks, so a caller who
     shrinks the fwd blocks for VMEM keeps that budget in bwd; likewise the
-    compute sub-block never exceeds the kv memory block.  Always returns a
-    5-field ResolvedBlocks; callers without a compute sub-block ignore the
-    last field.
+    compute sub-block never exceeds the kv memory block.  Explicit configs
+    past the measured VMEM cliff are clamped (see _clamp_cliff).  Always
+    returns a 5-field ResolvedBlocks; callers without a compute sub-block
+    ignore the last field.
     """
     t = block_defaults()
     bq = t.fwd_block_q if block_q is None else block_q
     bkv = t.fwd_block_kv if block_kv is None else block_kv
     bqb = min(t.bwd_block_q, bq) if block_q_bwd is None else block_q_bwd
     bkvb = min(t.bwd_block_kv, bkv) if block_kv_bwd is None else block_kv_bwd
+    bq, bkv = _clamp_cliff(bq, bkv, _FWD_CLIFF_AREA, "fwd")
+    bqb, bkvb = _clamp_cliff(bqb, bkvb, _BWD_CLIFF_AREA, "bwd")
     if block_kv_compute is None:
         block_kv_compute = (bkv if t.fwd_block_kv_compute is None
                             else min(t.fwd_block_kv_compute, bkv))
+    else:
+        block_kv_compute = min(block_kv_compute, bkv)
     return ResolvedBlocks(bq, bkv, bqb, bkvb, block_kv_compute)

@@ -206,6 +206,31 @@ def test_block_tuning_table():
     assert resolve_blocks(block_kv_compute=512).block_kv_compute == 512
 
 
+def test_cliff_clamp(monkeypatch):
+    """Configs past the measured VMEM-cliff area are clamped (kv block
+    shrunk at fixed bq); BURST_ALLOW_CLIFF=1 lets sweeps measure them."""
+    from burst_attn_tpu.ops.pallas_flash import resolve_blocks
+    from burst_attn_tpu.ops.tuning import block_defaults
+
+    monkeypatch.delenv("BURST_ALLOW_CLIFF", raising=False)
+    rb = resolve_blocks(2048, 4096)  # the measured fwd cliff config
+    assert (rb.block_q, rb.block_kv) == (2048, 2048)
+    assert rb.block_kv_compute <= rb.block_kv
+    # bwd cliff sits one power of two lower
+    rb = resolve_blocks(1024, 2048, 2048, 2048)
+    assert (rb.block_q_bwd, rb.block_kv_bwd) == (2048, 1024)
+    # defaults are exactly at the budget — never clamped (compare against
+    # the raw table row, which bypasses the clamp)
+    t = block_defaults()
+    assert resolve_blocks()[:2] == (t.fwd_block_q, t.fwd_block_kv)
+    rb = resolve_blocks()
+    assert (rb.block_q_bwd, rb.block_kv_bwd) == (
+        min(t.bwd_block_q, t.fwd_block_q), min(t.bwd_block_kv, t.fwd_block_kv))
+    monkeypatch.setenv("BURST_ALLOW_CLIFF", "1")
+    rb = resolve_blocks(2048, 4096)
+    assert (rb.block_q, rb.block_kv) == (2048, 4096)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_single_device_flash_attention(qkv, causal):
     q, k, v, do = qkv
