@@ -254,5 +254,12 @@ def retire_slot(state: PagedState, pool: PagePool, slot: int) -> PagedState:
         return state
     page = state.k_pages[0].shape[2]
     n_used = -(-length // page)
-    pool.release([int(i) for i in state.page_table[slot, :n_used]])
+    ids = [int(i) for i in state.page_table[slot, :n_used]]
+    # a page pre-acquired by ensure_capacity at an exact page boundary (the
+    # slot retired before its next decode step) sits at column n_used —
+    # page 0 is the unassigned sentinel, so non-zero there means acquired
+    if (n_used < state.page_table.shape[1]
+            and int(state.page_table[slot, n_used]) != 0):
+        ids.append(int(state.page_table[slot, n_used]))
+    pool.release(ids)
     return state._replace(lengths=state.lengths.at[slot].set(0))

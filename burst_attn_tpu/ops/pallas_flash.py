@@ -62,8 +62,11 @@ BIG_LSE = 1e30
 LOG2E = 1.4426950408889634
 LN2 = 0.6931471805599453
 # Mosaic's default scoped-VMEM budget is 16 MiB; v5e has far more physical
-# VMEM and the larger budget admits 2048-wide kv blocks
-VMEM_LIMIT = 100 * 1024 * 1024
+# VMEM and the larger budget admits 2048-wide kv blocks.  BURST_VMEM_LIMIT
+# (bytes, read at import) exists for cliff experiments: the limit bounds how
+# aggressively Mosaic double-buffers, so it interacts with the block-area
+# cliff law in ops/tuning.py.
+VMEM_LIMIT = int(os.environ.get("BURST_VMEM_LIMIT", 100 * 1024 * 1024))
 
 
 def _interpret_default():
@@ -121,6 +124,21 @@ def _pad_seq(x, s_pad: int, fill=0.0):
     return jnp.pad(x, pad, constant_values=fill)
 
 
+def _pad_seg(seg, s_pad: int, fill):
+    """Pad dim 1 (sequence) of a [B, S] segment-id array with `fill`.
+
+    The sentinels (-1 q-side, -2 kv-side) keep the two pads from matching
+    each other; real-vs-pad pairs are already dead structurally — the spec's
+    q_hi/kv_hi bounds stay in TRUE coordinates, so any block touching pad
+    rows/cols takes the masked path and the bounds test kills those pairs
+    regardless of ids.  Callers should still use non-negative segment ids
+    (negatives are reserved for padding; see flash_attention docstring)."""
+    s = seg.shape[1]
+    if s == s_pad:
+        return seg
+    return jnp.pad(seg, [(0, 0), (0, s_pad - s)], constant_values=fill)
+
+
 def _spec_array(spec: MaskSpec):
     return jnp.stack(
         [
@@ -133,12 +151,15 @@ def _spec_array(spec: MaskSpec):
     )
 
 
-def _block_mask(spec_ref, r0, c0, bq, bkv, wnd=None):
+def _block_mask(spec_ref, r0, c0, bq, bkv, wnd=None, seg=None):
     """[bq, bkv] bool mask for the tile at rows r0.., cols c0.. (True=attend).
 
     `wnd` is the STATIC sliding-window width (None = unlimited); when None
     the generated code is identical to the pre-window kernels — windowed
-    runs are the only ones that pay for the extra band term."""
+    runs are the only ones that pay for the extra band term.  `seg` =
+    (q_seg [bq, 1], kv_seg [1, bkv]) packed-sequence id tiles: attention
+    never crosses a segment boundary (the broadcast compare is the only
+    cost, and only on blocks that take the masked path)."""
     rows = r0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
     cols = c0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
     q_lo, q_hi, kv_hi = spec_ref[0], spec_ref[1], spec_ref[2]
@@ -147,7 +168,17 @@ def _block_mask(spec_ref, r0, c0, bq, bkv, wnd=None):
     m = m & ((causal == 0) | (cols <= rows + offset))
     if wnd is not None:
         m = m & (cols > rows + offset - wnd)
+    if seg is not None:
+        m = m & (seg[0] == seg[1])
     return m
+
+
+def _seg_uniform_eq(qs, ks):
+    """Scalar: True iff both segment tiles are single-segment AND equal —
+    the condition under which a structurally-full block needs no segment
+    masking (the fast path stays fast on the unpacked interior)."""
+    return ((jnp.max(qs) == jnp.min(qs)) & (jnp.max(ks) == jnp.min(ks))
+            & (jnp.max(qs) == jnp.max(ks)))
 
 
 def _block_has_work(spec_ref, r0, c0, bq, bkv, wnd=None):
@@ -298,11 +329,14 @@ def _tri_coords(nqb):
 def _fwd_kernel(
     spec_ref,
     q_ref, k_ref, v_ref, m_in_ref, lse_in_ref, acc_in_ref,
-    m_out_ref, lse_out_ref, acc_out_ref,
-    m_scr, l_scr, acc_scr,
-    *, scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p, tri, wnd=None,
-    ablate=None,
+    *rest,
+    scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p, tri, wnd=None,
+    seg=False, ablate=None,
 ):
+    if seg:
+        qseg_ref, kvseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+    m_out_ref, lse_out_ref, acc_out_ref, m_scr, l_scr, acc_scr = rest
     if tri:
         nqb = n_kv_blocks  # square: bq == bkv, s_q == s_kv
         i, j, is_init, is_fin = _tri_coords(nqb)
@@ -336,6 +370,15 @@ def _fwd_kernel(
         full = _block_full(spec_ref, r0, c0, bq, bkv, wnd)
         fast_cond = live & full
         masked_cond = live & ~full
+    if seg:
+        # packed sequences: only blocks wholly inside ONE shared segment may
+        # skip masking; mixed blocks join the masked path (cheap scalar test)
+        qs_tile = qseg_ref[0, :, :]   # [bq, 1]
+        ks_tile = kvseg_ref[0, :, :]  # [1, bkv]
+        seg_ok = _seg_uniform_eq(qs_tile, ks_tile)
+        was_live = fast_cond | masked_cond
+        fast_cond = fast_cond & seg_ok
+        masked_cond = was_live & ~fast_cond
 
     # scale (and the base-2 conversion) folded into the [bq, d] q block
     # (one small mul, hoisted out of the sub-block loop) instead of the
@@ -391,7 +434,11 @@ def _fwd_kernel(
             s_next = _score(u + 1) if u + 1 < n_sub else None
             mask = (
                 _block_mask(spec_ref, r0, c0 + u * bkv_compute, bq,
-                            bkv_compute, wnd)
+                            bkv_compute, wnd,
+                            seg=(qs_tile,
+                                 ks_tile[:, u * bkv_compute:
+                                         (u + 1) * bkv_compute]) if seg
+                            else None)
                 if masked else None
             )
             if ablate == "nosoftmax":
@@ -436,7 +483,7 @@ def _fwd_kernel(
 def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
               block_q=1024, block_kv=1024, block_kv_compute=None,
               interpret=None, cast_p=True, triangular=False, window=None,
-              _ablate=None):
+              segments=None, _ablate=None):
     """One online-softmax ring round on TPU.  Same contract as
     ops/tile.py:tile_fwd: returns updated (m, lse, acc).
 
@@ -467,13 +514,17 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
         # ragged lengths: pad, run, slice back (spec bounds stay in true
         # coordinates so the pad region is masked; tri grids assume exact
         # full-window tiling, so the padded call is rectangular)
+        if segments is not None:
+            # pad ids never match each other or any real segment
+            segments = (_pad_seg(segments[0], sq_pad, -1),
+                        _pad_seg(segments[1], skv_pad, -2))
         m2, lse2, acc2 = flash_fwd(
             _pad_seq(q, sq_pad), _pad_seq(k, skv_pad), _pad_seq(v, skv_pad),
             _pad_seq(m, sq_pad, float("-inf")),
             _pad_seq(lse, sq_pad, float("-inf")), _pad_seq(acc, sq_pad),
             scale, spec, block_q=block_q, block_kv=block_kv,
             block_kv_compute=block_kv_compute, interpret=interpret,
-            cast_p=cast_p, triangular=False, window=window,
+            cast_p=cast_p, triangular=False, window=window, segments=segments,
         )
         return m2[:, :, :s_q], lse2[:, :, :s_q], acc2[:, :, :s_q]
     bq = _pick_block(s_q, block_q)
@@ -503,9 +554,29 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
         grid = (b, n, nqb, nkb)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, bq=bq, bkv=bkv, bkv_compute=bkc, lp=lp,
-        n_kv_blocks=nkb, cast_p=cast_p, tri=tri, wnd=window, ablate=_ablate,
+        n_kv_blocks=nkb, cast_p=cast_p, tri=tri, wnd=window,
+        seg=segments is not None, ablate=_ablate,
     )
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bkv, d), kv_map),
+        pl.BlockSpec((1, 1, bkv, d), kv_map),
+        state_block,
+        state_block,
+        pl.BlockSpec((1, 1, bq, d), q_map),
+    ]
+    inputs = [_spec_array(spec), q, k, v, _pack(m, lp), _pack(lse, lp), acc]
+    if segments is not None:
+        q_seg, kv_seg = segments
+        # ids as [B, S, 1] (q rows along sublanes) / [B, 1, S] (kv along
+        # lanes) so the in-kernel compare broadcasts without relayout
+        in_specs.append(pl.BlockSpec(
+            (1, bq, 1), lambda b_, h, i, j, sp: (b_, q_map(b_, h, i, j, sp)[2], 0)))
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bkv), lambda b_, h, i, j, sp: (b_, 0, kv_map(b_, h, i, j, sp)[2])))
+        inputs.append(jnp.asarray(q_seg, jnp.int32)[:, :, None])
+        inputs.append(jnp.asarray(kv_seg, jnp.int32)[:, None, :])
     out_shape = [
         jax.ShapeDtypeStruct((b, n, s_q // lp, lp), jnp.float32),
         jax.ShapeDtypeStruct((b, n, s_q // lp, lp), jnp.float32),
@@ -514,14 +585,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), q_map),
-            pl.BlockSpec((1, 1, bkv, d), kv_map),
-            pl.BlockSpec((1, 1, bkv, d), kv_map),
-            state_block,
-            state_block,
-            pl.BlockSpec((1, 1, bq, d), q_map),
-        ],
+        in_specs=in_specs,
         out_specs=[
             state_block,
             state_block,
@@ -545,7 +609,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(_spec_array(spec), q, k, v, _pack(m, lp), _pack(lse, lp), acc)
+    )(*inputs)
     return _unpack(m_new), _unpack(lse_new), acc_new
 
 
@@ -556,10 +620,13 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
 def _dq_kernel(
     spec_ref,
     do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
-    dq_ref,
-    dq_scr, lse_scr, delta_scr,
-    *, scale, bq, bkv, lp, n_kv_blocks, wnd=None,
+    *rest,
+    scale, bq, bkv, lp, n_kv_blocks, wnd=None, seg=False,
 ):
+    if seg:
+        qseg_ref, kvseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+    dq_ref, dq_scr, lse_scr, delta_scr = rest
     i = pl.program_id(2)
     j = pl.program_id(3)
     r0 = i * bq
@@ -579,6 +646,12 @@ def _dq_kernel(
         j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks)
     )
     full = _block_full(spec_ref, r0, c0, bq, bkv, wnd)
+    seg_tiles = None
+    if seg:
+        # mixed-segment blocks lose only the fast path; dead-block pruning
+        # (live) keys on the causal structure and stays valid
+        seg_tiles = (qseg_ref[0, :, :], kvseg_ref[0, :, :])
+        full = full & _seg_uniform_eq(*seg_tiles)
 
     def _accum(mask):
         q = q_ref[0, 0, :, :] * (scale * LOG2E)
@@ -608,7 +681,7 @@ def _dq_kernel(
 
     @pl.when(live & ~full)
     def _compute_masked():
-        _accum(_block_mask(spec_ref, r0, c0, bq, bkv, wnd))
+        _accum(_block_mask(spec_ref, r0, c0, bq, bkv, wnd, seg=seg_tiles))
 
     @pl.when(j == n_kv_blocks - 1)
     def _finish():
@@ -627,10 +700,13 @@ def _dq_kernel(
 def _dkdv_kernel(
     spec_ref,
     do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
-    dk_ref, dv_ref,
-    dk_scr, dv_scr,
-    *, scale, bq, bkv, lp, n_q_blocks, group, wnd=None,
+    *rest,
+    scale, bq, bkv, lp, n_q_blocks, group, wnd=None, seg=False,
 ):
+    if seg:
+        qseg_ref, kvseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+    dk_ref, dv_ref, dk_scr, dv_scr = rest
     j = pl.program_id(2)
     t = pl.program_id(3)
     iq = t % n_q_blocks
@@ -646,6 +722,10 @@ def _dkdv_kernel(
         iq >= _q_imin(spec_ref, j, bq, bkv, n_q_blocks)
     )
     full = _block_full(spec_ref, r0, c0, bq, bkv, wnd)
+    seg_tiles = None
+    if seg:
+        seg_tiles = (qseg_ref[0, :, :], kvseg_ref[0, :, :])
+        full = full & _seg_uniform_eq(*seg_tiles)
 
     def _accum(mask):
         q = q_ref[0, 0, :, :]
@@ -686,7 +766,7 @@ def _dkdv_kernel(
 
     @pl.when(live & ~full)
     def _compute_masked():
-        _accum(_block_mask(spec_ref, r0, c0, bq, bkv, wnd))
+        _accum(_block_mask(spec_ref, r0, c0, bq, bkv, wnd, seg=seg_tiles))
 
     @pl.when(t == n_q_blocks * group - 1)
     def _finish():
@@ -1130,7 +1210,7 @@ def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv) -> bool:
 
 def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
               block_q=1024, block_kv=1024, interpret=None, fused=None,
-              triangular=False, window=None):
+              triangular=False, window=None, segments=None):
     """One backward ring round on TPU.  Same contract as ops/tile.py:tile_bwd:
     returns (dq [B,N,S,D], dk [B,Nk,Skv,D], dv [B,Nk,Skv,D]) in float32.
 
@@ -1156,12 +1236,16 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
         # ragged lengths: pad, run, slice back (see flash_fwd).  lse pads
         # with 0 (not -inf) so the kernels' exp(s - lse) stays finite before
         # the mask select zeroes the padded rows' contributions.
+        if segments is not None:
+            segments = (_pad_seg(segments[0], sq_pad, -1),
+                        _pad_seg(segments[1], skv_pad, -2))
         dq, dk, dv = flash_bwd(
             _pad_seq(do, sq_pad), _pad_seq(q, sq_pad),
             _pad_seq(k, skv_pad), _pad_seq(v, skv_pad),
             _pad_seq(delta, sq_pad), _pad_seq(lse, sq_pad),
             scale, spec, block_q=block_q, block_kv=block_kv,
             interpret=interpret, fused=fused, triangular=False, window=window,
+            segments=segments,
         )
         return dq[:, :, :s_q], dk[:, :, :s_kv], dv[:, :, :s_kv]
     bq = _pick_block(s_q, block_q)
@@ -1170,11 +1254,11 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     nqb = s_q // bq
     nkb = s_kv // bkv
     explicit_split = fused is False
-    if window is not None:
-        # windowed runs take the split kernels: the fused/tri schedules'
-        # dead-step and aliasing arguments assume full-window causality and
-        # have not been re-derived for a band (perf follow-up, not a
-        # correctness limit)
+    if window is not None or segments is not None:
+        # windowed and packed-sequence runs take the split kernels: the
+        # fused/tri schedules' dead-step and aliasing arguments assume
+        # full-window causality and have not been re-derived for a band /
+        # segment structure (perf follow-up, not a correctness limit)
         fused = False
         triangular = False
     if fused is None:
@@ -1198,22 +1282,33 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group,
                                                 wnd=window)
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bkv, d), kv_map),
+        pl.BlockSpec((1, 1, bkv, d), kv_map),
+        state_block,
+        state_block,
+    ]
+    dq_inputs = [_spec_array(spec), do, q, k, v, _pack(delta, lp),
+                 _pack(lse, lp)]
+    if segments is not None:
+        q_seg3 = jnp.asarray(segments[0], jnp.int32)[:, :, None]
+        kv_seg3 = jnp.asarray(segments[1], jnp.int32)[:, None, :]
+        dq_in_specs.append(pl.BlockSpec(
+            (1, bq, 1), lambda b_, h, i, j, sp: (b_, q_map(b_, h, i, j, sp)[2], 0)))
+        dq_in_specs.append(pl.BlockSpec(
+            (1, 1, bkv), lambda b_, h, i, j, sp: (b_, 0, kv_map(b_, h, i, j, sp)[2])))
+        dq_inputs += [q_seg3, kv_seg3]
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp, n_kv_blocks=nkb,
-            wnd=window,
+            wnd=window, seg=segments is not None,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, n, nqb, nkb),
-            in_specs=[
-                pl.BlockSpec((1, 1, bq, d), q_map),
-                pl.BlockSpec((1, 1, bq, d), q_map),
-                pl.BlockSpec((1, 1, bkv, d), kv_map),
-                pl.BlockSpec((1, 1, bkv, d), kv_map),
-                state_block,
-                state_block,
-            ],
+            in_specs=dq_in_specs,
             out_specs=pl.BlockSpec((1, 1, bq, d), q_map),
             scratch_shapes=[
                 pltpu.VMEM((bq, d), jnp.float32),
@@ -1227,7 +1322,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(_spec_array(spec), do, q, k, v, _pack(delta, lp), _pack(lse, lp))
+    )(*dq_inputs)
 
     # ---- dk/dv ----
     def qh_of(h, t):
@@ -1246,22 +1341,33 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
         return (b_, h, j, 0)
 
     bstate_block = pl.BlockSpec((1, 1, s_q // lp, lp), bstate_map)
+    dkdv_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), bq_map),
+        pl.BlockSpec((1, 1, bq, d), bq_map),
+        pl.BlockSpec((1, 1, bkv, d), bkv_map),
+        pl.BlockSpec((1, 1, bkv, d), bkv_map),
+        bstate_block,
+        bstate_block,
+    ]
+    dkdv_inputs = [_spec_array(spec), do, q, k, v, _pack(delta, lp),
+                   _pack(lse, lp)]
+    if segments is not None:
+        dkdv_in_specs.append(pl.BlockSpec(
+            (1, bq, 1),
+            lambda b_, h, j, t, sp: (b_, bq_map(b_, h, j, t, sp)[2], 0)))
+        dkdv_in_specs.append(pl.BlockSpec(
+            (1, 1, bkv), lambda b_, h, j, t, sp: (b_, 0, j)))
+        dkdv_inputs += [q_seg3, kv_seg3]
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkdv_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp,
             n_q_blocks=nqb, group=group, wnd=window,
+            seg=segments is not None,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, n_kv, nkb, nqb * group),
-            in_specs=[
-                pl.BlockSpec((1, 1, bq, d), bq_map),
-                pl.BlockSpec((1, 1, bq, d), bq_map),
-                pl.BlockSpec((1, 1, bkv, d), bkv_map),
-                pl.BlockSpec((1, 1, bkv, d), bkv_map),
-                bstate_block,
-                bstate_block,
-            ],
+            in_specs=dkdv_in_specs,
             out_specs=[
                 pl.BlockSpec((1, 1, bkv, d), bkv_map),
                 pl.BlockSpec((1, 1, bkv, d), bkv_map),
@@ -1280,7 +1386,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(_spec_array(spec), do, q, k, v, _pack(delta, lp), _pack(lse, lp))
+    )(*dkdv_inputs)
     return dq, dk, dv
 
 
@@ -1290,10 +1396,9 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
 # test/test_burst.py:175-184)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def flash_attention(q, k, v, scale=None, causal=False, block_q=None, block_kv=None,
                     block_q_bwd=None, block_kv_bwd=None, block_kv_compute=None,
-                    window=None):
+                    window=None, segment_ids=None):
     """Fused single-device flash attention.  q,k,v [B,N,S,D] -> o [B,N,S,D].
 
     Block sizes default per TPU generation from ops/tuning.py (v5e measured
@@ -1306,14 +1411,34 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=None, block_kv=No
     `window` (static int) enables sliding-window attention: each query
     attends to its last `window` positions (inclusive of itself); requires
     causal=True.  Off-diagonal blocks outside the band are skipped, so cost
-    scales with window, not sequence."""
+    scales with window, not sequence.
+
+    `segment_ids` [B, S] int32 (non-negative; negatives are reserved for
+    internal padding) packs multiple documents into one row — attention
+    never crosses a segment boundary.  Blocks wholly inside one segment
+    keep the fast path; only boundary-straddling blocks pay for the id
+    compare.  The backward takes the split (non-fused) kernels."""
+    if segment_ids is None:
+        return _flash_attention_plain(q, k, v, scale, causal, block_q,
+                                      block_kv, block_q_bwd, block_kv_bwd,
+                                      block_kv_compute, window)
+    return _flash_attention_seg(q, k, v, segment_ids, scale, causal, block_q,
+                                block_kv, block_q_bwd, block_kv_bwd,
+                                block_kv_compute, window)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash_attention_plain(q, k, v, scale=None, causal=False, block_q=None,
+                           block_kv=None, block_q_bwd=None, block_kv_bwd=None,
+                           block_kv_compute=None, window=None):
     o, _ = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
                                      block_kv_compute, window)
     return o
 
 
 def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
-                              block_kv_compute=None, window=None):
+                              block_kv_compute=None, window=None,
+                              segment_ids=None):
     from .masks import round_spec
     from .tile import finalize as _finalize, init_state
 
@@ -1328,12 +1453,15 @@ def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
     # the static `window` is what narrows the band
     spec = round_spec(jnp.int32(0), jnp.int32(0), s, k.shape[2], causal, "contig")
     m0, lse0, acc0 = init_state(b, n, s, d)
+    segs = None if segment_ids is None else (segment_ids, segment_ids)
     m, lse, acc = flash_fwd(
         q, k, v, m0, lse0, acc0, scale, spec, block_q=block_q, block_kv=block_kv,
         block_kv_compute=block_kv_compute,
         # the spec here is statically known to be plain full-window causal,
-        # exactly the triangular grid's precondition (tri declines windows)
-        triangular=causal, window=window,
+        # exactly the triangular grid's precondition (tri declines windows;
+        # segment masking composes with the tri grid — the in-kernel seg_ok
+        # test just widens which blocks take the masked path)
+        triangular=causal, window=window, segments=segs,
     )
     o = _finalize(m, lse, acc, q.dtype)
     return o, lse
@@ -1368,4 +1496,53 @@ def _flash_attention_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-flash_attention.defvjp(_flash_attention_vjp_fwd, _flash_attention_vjp_bwd)
+_flash_attention_plain.defvjp(_flash_attention_vjp_fwd, _flash_attention_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _flash_attention_seg(q, k, v, segment_ids, scale=None, causal=False,
+                         block_q=None, block_kv=None, block_q_bwd=None,
+                         block_kv_bwd=None, block_kv_compute=None, window=None):
+    o, _ = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
+                                     block_kv_compute, window, segment_ids)
+    return o
+
+
+def _flash_attention_seg_vjp_fwd(q, k, v, segment_ids, scale, causal, block_q,
+                                 block_kv, block_q_bwd, block_kv_bwd,
+                                 block_kv_compute, window):
+    o, lse = _flash_attention_fwd_impl(q, k, v, scale, causal, block_q,
+                                       block_kv, block_kv_compute, window,
+                                       segment_ids)
+    return o, (q, k, v, segment_ids, o, lse)
+
+
+def _flash_attention_seg_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
+                                 block_kv_bwd, block_kv_compute, window, res,
+                                 do):
+    import numpy as np
+
+    from .masks import round_spec
+
+    q, k, v, segment_ids, o, lse = res
+    d = q.shape[-1]
+    if scale is None:
+        scale = d**-0.5
+    _, _, block_q_bwd, block_kv_bwd, _ = resolve_blocks(
+        block_q, block_kv, block_q_bwd, block_kv_bwd)
+    spec = round_spec(jnp.int32(0), jnp.int32(0), q.shape[2], k.shape[2],
+                      causal, "contig")
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    dq, dk, dv = flash_bwd(
+        do, q, k, v, delta, lse, scale, spec,
+        block_q=block_q_bwd, block_kv=block_kv_bwd,
+        triangular=False, window=window,
+        segments=(segment_ids, segment_ids),
+    )
+    # integer inputs carry symbolic-zero (float0) cotangents
+    dseg = np.zeros(segment_ids.shape, dtype=jax.dtypes.float0)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dseg)
+
+
+_flash_attention_seg.defvjp(_flash_attention_seg_vjp_fwd,
+                            _flash_attention_seg_vjp_bwd)

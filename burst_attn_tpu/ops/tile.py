@@ -46,13 +46,27 @@ def _expand_kv(x, n_q_heads):
     return jnp.repeat(x, n_q_heads // n_kv, axis=1)
 
 
-def tile_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, window=None):
+def _with_segments(mask, segments):
+    """Intersect a [s_q, s_kv] structural mask with the packed-sequence
+    (segment-ids) equality mask.  segments = (q_seg [B, s_q], kv_seg
+    [B, s_kv]) int32; tokens attend only within their own segment.  Returns
+    a [B, 1, s_q, s_kv] mask (batch-dependent)."""
+    if segments is None:
+        return mask
+    q_seg, kv_seg = segments
+    return (mask[None, None] &
+            (q_seg[:, None, :, None] == kv_seg[:, None, None, :]))
+
+
+def tile_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, window=None,
+             segments=None):
     """One online-softmax round; returns updated (m, lse, acc).
-    `window` (static): sliding-window lower bound, see masks.dense_mask."""
+    `window` (static): sliding-window lower bound, see masks.dense_mask.
+    `segments`: packed-sequence ids, see _with_segments."""
     s_q, s_kv = q.shape[2], k.shape[2]
     k = _expand_kv(k, q.shape[1])
     v = _expand_kv(v, q.shape[1])
-    mask = dense_mask(spec, s_q, s_kv, window)
+    mask = _with_segments(dense_mask(spec, s_q, s_kv, window), segments)
 
     s = jnp.einsum("bnid,bnjd->bnij", q, k, preferred_element_type=jnp.float32)
     s = s * scale
@@ -80,7 +94,8 @@ def finalize(m, lse, acc, dtype):
     return (acc * o_scale[..., None]).astype(dtype)
 
 
-def tile_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, window=None):
+def tile_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, window=None,
+             segments=None):
     """One backward ring round; returns this round's (dq, dk, dv) in float32.
 
     delta = sum(o * do, axis=-1) [B, N, S] float32 (precomputed once — the
@@ -93,7 +108,7 @@ def tile_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, window=None):
     s_q, s_kv = q.shape[2], k.shape[2]
     kx = _expand_kv(k, n_q)
     vx = _expand_kv(v, n_q)
-    mask = dense_mask(spec, s_q, s_kv, window)
+    mask = _with_segments(dense_mask(spec, s_q, s_kv, window), segments)
 
     s = jnp.einsum("bnid,bnjd->bnij", q, kx, preferred_element_type=jnp.float32)
     s = s * scale
@@ -114,8 +129,11 @@ def tile_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, window=None):
 
 
 @partial(jax.jit, static_argnames=("causal", "window"))
-def single_device_attention(q, k, v, scale=None, causal=False, window=None):
-    """Full attention on one device via the tile (a one-round "ring")."""
+def single_device_attention(q, k, v, scale=None, causal=False, window=None,
+                            segment_ids=None):
+    """Full attention on one device via the tile (a one-round "ring").
+    `segment_ids` [B, S] int32 packs multiple sequences into one row:
+    attention never crosses a segment boundary."""
     from .masks import round_spec
 
     if scale is None:
@@ -125,5 +143,7 @@ def single_device_attention(q, k, v, scale=None, causal=False, window=None):
     b, n, s, d = q.shape
     spec = round_spec(jnp.int32(0), jnp.int32(0), s, k.shape[2], causal, "contig")
     m, lse, acc = init_state(b, n, s, d)
-    m, lse, acc = tile_fwd(q, k, v, m, lse, acc, scale, spec, window=window)
+    segs = None if segment_ids is None else (segment_ids, segment_ids)
+    m, lse, acc = tile_fwd(q, k, v, m, lse, acc, scale, spec, window=window,
+                           segments=segs)
     return finalize(m, lse, acc, q.dtype)

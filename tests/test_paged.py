@@ -179,3 +179,22 @@ def test_continuous_batching_slots_are_independent(model):
     state = retire_slot(state, pool, 1)
     assert pool.available == avail_mid + 1
     assert int(state.lengths[1]) == 0
+
+
+def test_retire_returns_boundary_preacquired_page(model):
+    """A page acquired by ensure_capacity at an exact page boundary is
+    released when the slot retires before its next decode step."""
+    cfg, params = model
+    state, pool = init_paged_state(cfg, slots=1, n_pages=8, page=128,
+                                   max_pages_per_seq=3)
+    # force an exact-boundary length without running 128 steps
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (128,), 0, cfg.vocab)
+    _, state = paged_prefill(params, prompt, state, pool, 0, cfg)
+    assert int(state.lengths[0]) == 128
+    before = pool.available
+    state = ensure_capacity(state, pool, 0)   # acquires the next page
+    assert pool.available == before - 1
+    state = ensure_capacity(state, pool, 0)   # idempotent: no second acquire
+    assert pool.available == before - 1
+    state = retire_slot(state, pool, 0)
+    assert pool.available == before + 1       # prompt page AND pre-acquired
