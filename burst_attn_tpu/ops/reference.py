@@ -13,8 +13,9 @@ import jax.numpy as jnp
 
 
 @partial(jax.jit, static_argnames=("causal",))
-def dense_attention(q, k, v, scale=None, causal=False):
-    """q, k, v: [B, N, S, D] (kv heads may be fewer — GQA). Returns [B, N, S, D]."""
+def dense_attention(q, k, v, scale=None, causal=False, segment_ids=None):
+    """q, k, v: [B, N, S, D] (kv heads may be fewer — GQA). Returns [B, N, S, D].
+    segment_ids [B, S]: packed-sequence mask (attention stays in-segment)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     from .tile import _expand_kv
@@ -22,10 +23,17 @@ def dense_attention(q, k, v, scale=None, causal=False):
     k = _expand_kv(k, q.shape[1])
     v = _expand_kv(v, q.shape[1])
     s = jnp.einsum("bnid,bnjd->bnij", q, k, preferred_element_type=jnp.float32) * scale
+    s_q, s_kv = q.shape[2], k.shape[2]
+    mask = jnp.ones((1, 1, s_q, s_kv), bool)
     if causal:
-        s_q, s_kv = q.shape[2], k.shape[2]
         rows = jnp.arange(s_q)[:, None]
         cols = jnp.arange(s_kv)[None, :]
-        s = jnp.where(cols <= rows, s, float("-inf"))
+        mask = mask & (cols <= rows)
+    if segment_ids is not None:
+        mask = mask & (segment_ids[:, None, :, None]
+                       == segment_ids[:, None, None, :])
+    s = jnp.where(mask, s, float("-inf"))
     p = jax.nn.softmax(s, axis=-1)
+    # every row keeps at least its diagonal (j == i passes both the causal
+    # and the segment test), so no all-masked-row NaN guard is needed
     return jnp.einsum("bnij,bnjd->bnid", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -122,7 +122,8 @@ class BurstConfig:
 # tile dispatch
 
 
-def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec, triangular=False):
+def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec, triangular=False,
+              segments=None):
     if cfg.backend == "pallas":
         from ..ops import pallas_flash
 
@@ -131,13 +132,14 @@ def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec, triangular=False):
         return pallas_flash.flash_fwd(
             q, k, v, m, lse, acc, scale, spec,
             block_q=bq, block_kv=bkv, triangular=triangular,
-            window=cfg.window,
+            window=cfg.window, segments=segments,
         )
     return jnp_tile.tile_fwd(q, k, v, m, lse, acc, scale, spec,
-                             window=cfg.window)
+                             window=cfg.window, segments=segments)
 
 
-def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec, triangular=False):
+def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec, triangular=False,
+              segments=None):
     if cfg.backend == "pallas":
         from ..ops import pallas_flash
 
@@ -145,10 +147,10 @@ def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec, triangular=False):
         bq, bkv = rb.block_q_bwd, rb.block_kv_bwd
         return pallas_flash.flash_bwd(
             do, q, k, v, delta, lse, scale, spec, block_q=bq, block_kv=bkv,
-            triangular=triangular, window=cfg.window,
+            triangular=triangular, window=cfg.window, segments=segments,
         )
     return jnp_tile.tile_bwd(do, q, k, v, delta, lse, scale, spec,
-                             window=cfg.window)
+                             window=cfg.window, segments=segments)
 
 
 def _sizes(cfg):
@@ -161,11 +163,16 @@ def _sizes(cfg):
 # forward
 
 
-def _fwd_impl(q, k, v, cfg: BurstConfig):
+def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
     """Ring forward. Per-shard shapes q [B,N,S,D], k/v [B,Nk,S,D].
 
     Reference call stack SURVEY.md §3.1 / burst_attn_interface.py:170-253.
     Returns (o, lse) with o [B,N,S,D] in q.dtype, lse [B,N,S] f32.
+
+    `seg` [B, S] int32 (optional): packed-sequence ids for the LOCAL shard,
+    in the same layout order as q/k/v.  The kv-side ids ride the KV ring
+    (one extra tiny int32 array in the rotating payload); the q-side ids
+    stay resident.  Attention never crosses a segment boundary.
     """
     b, n, s, d = q.shape
     scale = cfg.scale if cfg.scale is not None else d**-0.5
@@ -176,7 +183,12 @@ def _fwd_impl(q, k, v, cfg: BurstConfig):
 
     def compute(st, kv_c, r):
         kv_part = partition_at_round(r, cfg.intra_axis, cfg.inter_axis)
-        k_c, v_c = kv_c
+        if seg is not None:
+            k_c, v_c, kvseg_c = kv_c
+        else:
+            k_c, v_c = kv_c
+            kvseg_c = None
+        segs = None if seg is None else (seg, kvseg_c)
         s_kv = k_c.shape[2]
         if cfg.causal and cfg.case_split and cfg.layout == "zigzag" and s_kv == s:
             # 3-way structural split (reference burst_attn_interface.py:221-235)
@@ -186,13 +198,14 @@ def _fwd_impl(q, k, v, cfg: BurstConfig):
                 # own partition: plain causal on the local layout
                 spec = round_spec(part_me, part_me, s, s_kv, True, "zigzag")
                 return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec,
-                                 triangular=True)
+                                 triangular=True, segments=segs)
 
             def past_case(st):
                 # kv's first half entirely in the local past: dense half-kv
                 return _tile_fwd(
                     cfg, q, k_c[:, :, :half], v_c[:, :, :half], *st, scale,
                     full_spec(s, half),
+                    segments=None if seg is None else (seg, kvseg_c[:, :half]),
                 )
 
             def future_case(st):
@@ -202,6 +215,7 @@ def _fwd_impl(q, k, v, cfg: BurstConfig):
                     cfg, q[:, :, half:], k_c, v_c,
                     m[:, :, half:], lse[:, :, half:], acc[:, :, half:],
                     scale, full_spec(s - half, s_kv),
+                    segments=None if seg is None else (seg[:, half:], kvseg_c),
                 )
                 # write the updated half back in place rather than
                 # rebuilding the full [B,N,S,D] f32 state via concatenate —
@@ -219,12 +233,13 @@ def _fwd_impl(q, k, v, cfg: BurstConfig):
             # every striped round is full-window causal (offset 0 or -1):
             # the triangular grid applies round-independently
             spec = round_spec(part_me, kv_part, s, s_kv, True, "striped")
-            return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec, triangular=True)
+            return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec,
+                             triangular=True, segments=segs)
         spec = round_spec(part_me, kv_part, s, s_kv, cfg.causal, cfg.layout,
                           window=cfg.window)
-        return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec)
+        return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec, segments=segs)
 
-    kv = (k, v)
+    kv = (k, v) if seg is None else (k, v, seg)
     kv_base = kv
     for c in range(n_inter):
         if c < n_inter - 1:
@@ -253,12 +268,14 @@ def _fwd_impl(q, k, v, cfg: BurstConfig):
 # backward
 
 
-def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
+def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
     """Communication-optimized ring backward (SURVEY.md §3.2).
 
     K, V stay resident; the query-side payload (delta|o, do, q, lse) rotates
     like KV did in forward; dq rides a concurrent accumulating ring and is
     returned home by one extra hop (burst_attn_interface.py:255-398).
+    With packed sequences (`seg`), the q-side ids rotate with the payload
+    while the resident kv side keeps the local ids.
     """
     b, n, s, d = q.shape
     scale = cfg.scale if cfg.scale is not None else d**-0.5
@@ -272,6 +289,8 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
         payload = (delta, do, q, lse)
     else:
         payload = (o, do, q, lse)
+    if seg is not None:
+        payload = payload + (seg,)
 
     dk = jnp.zeros(k.shape, jnp.float32)
     dv = jnp.zeros(v.shape, jnp.float32)
@@ -282,7 +301,12 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
         q_part = partition_at_round(r, cfg.intra_axis, cfg.inter_axis)
         # roles flip vs forward: the rotating payload is the query side,
         # local k/v are resident.
-        first, do_r, q_r, lse_r = pay
+        if seg is not None:
+            first, do_r, q_r, lse_r, qseg_r = pay
+        else:
+            first, do_r, q_r, lse_r = pay
+            qseg_r = None
+        segs = None if seg is None else (qseg_r, seg)
         if cfg.optimize_bwd_comm:
             delta_r = first
         else:
@@ -294,7 +318,7 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
             def eq_case(_):
                 spec = round_spec(part_me, part_me, s, s, True, "zigzag")
                 return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale,
-                                 spec, triangular=True)
+                                 spec, triangular=True, segments=segs)
 
             def kv_past_case(_):
                 # resident kv precedes the rotated q side: only kv's first
@@ -302,6 +326,7 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
                 dq_c, dk_h, dv_h = _tile_bwd(
                     cfg, do_r, q_r, k[:, :, :half], v[:, :, :half],
                     delta_r, lse_r, scale, full_spec(s, half),
+                    segments=None if seg is None else (qseg_r, seg[:, :half]),
                 )
                 pad = lambda g: jnp.concatenate(
                     [g, jnp.zeros((b,) + g.shape[1:2] + (s - half, d), g.dtype)], axis=2)
@@ -313,6 +338,7 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
                     cfg, do_r[:, :, half:], q_r[:, :, half:], k, v,
                     delta_r[:, :, half:], lse_r[:, :, half:],
                     scale, full_spec(s - half, s),
+                    segments=None if seg is None else (qseg_r[:, half:], seg),
                 )
                 dq_c = jnp.concatenate(
                     [jnp.zeros((b, n, half, d), dq_h.dtype), dq_h], axis=2)
@@ -326,10 +352,11 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
         if cfg.causal and cfg.case_split and cfg.layout == "striped":
             spec = round_spec(q_part, part_me, s, s, True, "striped")
             return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec,
-                             triangular=True)
+                             triangular=True, segments=segs)
         spec = round_spec(q_part, part_me, s, s, cfg.causal, cfg.layout,
                           window=cfg.window)
-        return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec)
+        return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec,
+                         segments=segs)
 
     pay_base = payload
     for c in range(n_inter):
@@ -384,13 +411,22 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do):
 # custom_vjp
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
-def burst_attn_shard(q, k, v, cfg: BurstConfig):
+def burst_attn_shard(q, k, v, cfg: BurstConfig, segment_ids=None):
     """Burst attention on per-shard arrays — call inside shard_map.
 
     q: [B, N, S_local, D]; k, v: [B, Nk, S_local, D] (GQA when Nk < N).
+    segment_ids: optional [B, S_local] int32 packed-sequence ids for the
+    LOCAL shard, in the same layout order as q/k/v (use
+    layouts.to_layout(ids, layout, world, axis=1) for zigzag/striped).
     Returns o: [B, N, S_local, D] in q.dtype.
     """
+    if segment_ids is None:
+        return _burst_attn_shard_plain(q, k, v, cfg)
+    return _burst_attn_shard_seg(q, k, v, segment_ids, cfg)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _burst_attn_shard_plain(q, k, v, cfg: BurstConfig):
     o, _ = _fwd_impl(q, k, v, cfg)
     return o
 
@@ -406,7 +442,30 @@ def _vjp_bwd(cfg, residuals, do):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-burst_attn_shard.defvjp(_vjp_fwd, _vjp_bwd)
+_burst_attn_shard_plain.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _burst_attn_shard_seg(q, k, v, seg, cfg: BurstConfig):
+    o, _ = _fwd_impl(q, k, v, cfg, seg=seg)
+    return o
+
+
+def _seg_vjp_fwd(q, k, v, seg, cfg):
+    o, lse = _fwd_impl(q, k, v, cfg, seg=seg)
+    return o, (q, k, v, seg, o, lse)
+
+
+def _seg_vjp_bwd(cfg, residuals, do):
+    import numpy as np
+
+    q, k, v, seg, o, lse = residuals
+    dq, dk, dv = _bwd_impl(cfg, q, k, v, o, lse, do, seg=seg)
+    dseg = np.zeros(seg.shape, dtype=jax.dtypes.float0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dseg
+
+
+_burst_attn_shard_seg.defvjp(_seg_vjp_fwd, _seg_vjp_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -446,6 +505,7 @@ def burst_attn(
     head_axes=None,
     case_split: bool = True,
     window: Optional[int] = None,
+    segment_ids=None,
 ) -> jax.Array:
     """Burst attention on global arrays [B, N, S, D]; S must already be in
     layout order (parallel/layouts.to_layout) for causal runs.
@@ -455,6 +515,9 @@ def burst_attn(
     batch_axes / head_axes: mesh axis name(s) batch / heads are sharded over
     (data / tensor parallelism riding alongside the sequence ring — the
     reference's process_group mechanism, burst_attn_interface.py:144-145).
+    segment_ids: optional [B, S] int32 packed-sequence ids (non-negative),
+    permuted into the SAME layout order as the sequence; attention never
+    crosses a segment boundary — the kv-side ids ride the KV ring.
     """
     if isinstance(seq_axes, str):
         seq_axes = (seq_axes,)
@@ -486,6 +549,16 @@ def burst_attn(
     )
     seq_spec = seq_axes if len(seq_axes) > 1 else intra_axis
     spec = P(batch_axes, head_axes, seq_spec, None)
+    if segment_ids is not None:
+        seg_spec = P(batch_axes, seq_spec)
+        fn = jax.shard_map(
+            lambda q, k, v, seg: burst_attn_shard(q, k, v, cfg, seg),
+            mesh=mesh,
+            in_specs=(spec, spec, spec, seg_spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v, jnp.asarray(segment_ids, jnp.int32))
     fn = jax.shard_map(
         partial(burst_attn_shard, cfg=cfg),
         mesh=mesh,

@@ -25,33 +25,46 @@ def make_mesh(shape):
 
 
 def run_case(mesh_shape, layout, causal, kv_heads=4, optimize_bwd_comm=True,
-             seq_per_dev=16, backend="jnp", n=4, d=16, **burst_kw):
+             seq_per_dev=16, backend="jnp", n=4, d=16, n_segments=None,
+             **burst_kw):
     W = int(np.prod(mesh_shape))
     b = 1
     S = seq_per_dev * W
     mesh, names = make_mesh(mesh_shape)
     q, k, v, do = random_qkv(KEY, b, n, S, d, kv_heads=kv_heads, dtype=jnp.float32)
 
+    seg = None
+    if n_segments:
+        # monotone packed-document ids with boundaries off any shard edge
+        cuts = jnp.sort(jax.random.randint(
+            jax.random.PRNGKey(11), (b, n_segments - 1), 1, S))
+        seg = jnp.sum(jnp.arange(S)[None, :, None] >= cuts[:, None, :],
+                      axis=-1).astype(jnp.int32)
+
     # oracle on natural token order
     def ref_loss(q, k, v):
-        return jnp.sum(dense_attention(q, k, v, causal=causal).astype(jnp.float32) * do)
+        return jnp.sum(dense_attention(q, k, v, causal=causal,
+                                       segment_ids=seg).astype(jnp.float32) * do)
 
-    o_ref = dense_attention(q, k, v, causal=causal)
+    o_ref = dense_attention(q, k, v, causal=causal, segment_ids=seg)
     dq_ref, dk_ref, dv_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
 
     # burst on layout order
     ql, kl, vl, dol = (layouts.to_layout(t, layout, W, 2) for t in (q, k, v, do))
+    segl = None if seg is None else layouts.to_layout(seg, layout, W, 1)
 
     def burst_loss(ql, kl, vl):
         o = burst_attn(
             ql, kl, vl, mesh=mesh, seq_axes=names, causal=causal, layout=layout,
-            backend=backend, optimize_bwd_comm=optimize_bwd_comm, **burst_kw,
+            backend=backend, optimize_bwd_comm=optimize_bwd_comm,
+            segment_ids=segl, **burst_kw,
         )
         return jnp.sum(o.astype(jnp.float32) * dol)
 
     o_l = burst_attn(
         ql, kl, vl, mesh=mesh, seq_axes=names, causal=causal, layout=layout,
-        backend=backend, optimize_bwd_comm=optimize_bwd_comm, **burst_kw,
+        backend=backend, optimize_bwd_comm=optimize_bwd_comm,
+        segment_ids=segl, **burst_kw,
     )
     dq_l, dk_l, dv_l = jax.grad(burst_loss, argnums=(0, 1, 2))(ql, kl, vl)
 
@@ -120,6 +133,26 @@ def test_uniform_spec_path_no_case_split(layout):
     """case_split=False keeps the single uniform masked tile per round
     (the original scheduling) — both schedulings must match the oracle."""
     run_case((2, 4), layout, causal=True, case_split=False)
+
+
+@pytest.mark.parametrize("layout", ["contig", "zigzag", "striped"])
+def test_segments_single_ring(layout):
+    """Packed sequences in the distributed ring: kv-side ids ride the KV
+    rotation, q-side ids rotate with the backward payload; boundaries land
+    mid-shard on an 8-way ring."""
+    run_case((8,), layout, causal=True, n_segments=3)
+
+
+def test_segments_double_ring_gqa():
+    run_case((2, 4), "zigzag", causal=True, kv_heads=2, n_segments=4)
+
+
+def test_segments_noncausal():
+    run_case((8,), "contig", causal=False, n_segments=3)
+
+
+def test_segments_no_case_split():
+    run_case((2, 4), "zigzag", causal=True, n_segments=3, case_split=False)
 
 
 def test_bf16_reference_tolerance():

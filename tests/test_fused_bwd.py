@@ -97,3 +97,43 @@ def test_triangular_matches_rect_on_tpu(block_q, block_kv):
     for name, a, b_ in zip(("dq", "dk", "dv"), rect_b, tri_b):
         err = float(jnp.max(jnp.abs(a - b_)))
         assert err < 1e-3, f"bwd {name} max abs err {err}"
+
+
+def test_segments_on_tpu():
+    """Packed-sequence masking at production tile sizes, on-chip: fp32
+    oracle comparison of flash_attention(segment_ids=...) fwd + grads.  The
+    seg-id block specs ((1, bq, 1) / (1, 1, bkv)) only satisfy Mosaic's
+    lane tiling at real block sizes, which interpret-mode tests don't
+    exercise (tests/test_segments.py covers the numerics at small shapes)."""
+    b, n, s, d = 1, 4, 4096, 128
+    ks = jax.random.split(jax.random.PRNGKey(13), 4)
+    dt = jnp.bfloat16
+    q = jax.random.normal(ks[0], (b, n, s, d), dt)
+    k = jax.random.normal(ks[1], (b, n, s, d), dt)
+    v = jax.random.normal(ks[2], (b, n, s, d), dt)
+    do = jax.random.normal(ks[3], (b, n, s, d), dt)
+    # three documents, boundaries off the block grid
+    seg = jnp.concatenate([
+        jnp.zeros((b, 1000), jnp.int32),
+        jnp.ones((b, 1500), jnp.int32),
+        jnp.full((b, s - 2500), 2, jnp.int32),
+    ], axis=1)
+
+    def loss(fn):
+        return jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)
+                                    * do.astype(jnp.float32)),
+            argnums=(0, 1, 2))
+
+    o = pf.flash_attention(q, k, v, None, True, 512, 512, segment_ids=seg)
+    o_ref = T.single_device_attention(q, k, v, causal=True, segment_ids=seg)
+    assert float(jnp.max(jnp.abs(o.astype(jnp.float32)
+                                 - o_ref.astype(jnp.float32)))) < 4e-2
+    g = loss(lambda q, k, v: pf.flash_attention(
+        q, k, v, None, True, 512, 512, segment_ids=seg))(q, k, v)
+    g_ref = loss(lambda q, k, v: T.single_device_attention(
+        q, k, v, causal=True, segment_ids=seg))(q, k, v)
+    for name, a, b_ in zip(("dq", "dk", "dv"), g, g_ref):
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                    - b_.astype(jnp.float32))))
+        assert err < 5e-2, f"{name} max abs err {err}"
