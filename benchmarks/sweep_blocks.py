@@ -135,20 +135,24 @@ def main():
                 continue
             fused = len(parts) <= 2 or parts[2] == "tri"
             tri = len(parts) > 2 and parts[2] == "tri"
+            # optional 4th token: tri compute sub-block, e.g. 2048x2048xtrix1024
+            bkc = int(parts[3]) if len(parts) > 3 else None
             # record which kernel actually runs: flash_bwd silently falls
             # back to the rectangular fused kernel when the tri gate fails
             tri_eff = tri and tri_bwd_supported(
-                seq, seq, n, nkv, d, block_q=bqb, block_kv=bkvb)
+                seq, seq, n, nkv, d, block_q=bqb, block_kv=bkvb,
+                block_kv_compute=bkc)
             row = {"pass": "bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
-                   "fused": fused, "tri": tri_eff}
+                   "fused": fused, "tri": tri_eff, "bkc_bwd": bkc}
             if tri and not tri_eff:
                 row["tri_requested_fell_back"] = True
             try:
                 f = jax.jit(lambda q, k, v, do, delta, lse, bqb=bqb, bkvb=bkvb,
-                            fused=fused, tri=tri: sum(
+                            fused=fused, tri=tri, bkc=bkc: sum(
                     jnp.sum(g.astype(jnp.float32)) for g in flash_bwd(
                         do, q, k, v, delta, lse, scale, spec,
-                        block_q=bqb, block_kv=bkvb, fused=fused, triangular=tri)))
+                        block_q=bqb, block_kv=bkvb, fused=fused, triangular=tri,
+                        block_kv_compute=bkc)))
                 t = bench_fn(f, q, k, v, do, delta, lse)
                 row.update(ms=round(t * 1e3, 2),
                            tflops=round(flops(b, seq, n, d, "bwd", True) / t / 1e12, 1))

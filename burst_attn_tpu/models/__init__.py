@@ -1,5 +1,6 @@
 from .transformer import ModelConfig, init_params, forward, forward_with_aux, param_specs
-from .train import TrainConfig, make_mesh, init_train_state, train_step, loss_fn
+from .train import (TrainConfig, make_mesh, init_train_state, train_step,
+                    loss_fn, packed_fields)
 from .decode import Cache, forward_cached, generate, init_cache, prefill, sample_logits
 from .dist_decode import DistCache, dist_generate, dist_prefill
 from .paged_decode import (
@@ -21,6 +22,7 @@ __all__ = [
     "make_mesh",
     "init_train_state",
     "train_step",
+    "packed_fields",
     "loss_fn",
     "Cache",
     "forward_cached",

@@ -22,6 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .transformer import ModelConfig, forward, forward_with_aux, init_params, param_specs
@@ -111,9 +112,11 @@ def init_train_state(key, cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     return jax.jit(init_fn, out_shardings=out_shardings)(key)
 
 
-def _loss_parts(params, tokens, positions, labels, cfg: ModelConfig, mesh):
+def _loss_parts(params, tokens, positions, labels, cfg: ModelConfig, mesh,
+                segment_ids=None):
     """(sum of masked nll, MoE aux) — the linear pieces of the objective."""
-    logits, aux = forward_with_aux(params, tokens, positions, cfg, mesh)
+    logits, aux = forward_with_aux(params, tokens, positions, cfg, mesh,
+                                   segment_ids=segment_ids)
     valid = labels >= 0
     labels_safe = jnp.where(valid, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -122,12 +125,47 @@ def _loss_parts(params, tokens, positions, labels, cfg: ModelConfig, mesh):
 
 
 def loss_fn(params, tokens, positions, labels, cfg: ModelConfig, mesh,
-            moe_aux_weight: float = 0.0):
+            moe_aux_weight: float = 0.0, segment_ids=None):
     """Mean next-token cross entropy (fp32) + weighted MoE aux loss.
     labels < 0 are masked out."""
-    nll_sum, aux = _loss_parts(params, tokens, positions, labels, cfg, mesh)
+    nll_sum, aux = _loss_parts(params, tokens, positions, labels, cfg, mesh,
+                               segment_ids=segment_ids)
     ce = nll_sum / jnp.maximum(jnp.sum(labels >= 0), 1)
     return ce + moe_aux_weight * aux
+
+
+def packed_fields(tokens, eos_id: int):
+    """Derive packed-training fields from a [B, S] token stream in NATURAL
+    order, where documents are delimited by `eos_id` (the EOS token belongs
+    to the document it ends — the usual packing convention):
+
+      segment_ids [B, S]  document index per token (monotone from 0)
+      positions   [B, S]  rotary positions restarting at each document
+      labels      [B, S]  next-token targets, -1 at document ends (the EOS
+                          token never predicts the next document's first
+                          token) and at the final position
+
+    Feed tokens/labels/segment_ids through layouts.to_layout(axis=1) before
+    a zigzag/striped ring; positions are already true positions and ride
+    the same permutation."""
+    b, s = tokens.shape
+    is_eos = tokens == eos_id
+    # token t's segment = number of EOS strictly before t
+    seg = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos.astype(jnp.int32)
+    idx = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    is_start = jnp.concatenate(
+        [jnp.ones((b, 1), bool), seg[:, 1:] != seg[:, :-1]], axis=1)
+    seg_start = lax.associative_scan(jnp.maximum,
+                                     jnp.where(is_start, idx, 0), axis=1)
+    positions = idx - seg_start
+    nxt_same = jnp.concatenate(
+        [seg[:, 1:] == seg[:, :-1], jnp.zeros((b, 1), bool)], axis=1)
+    labels = jnp.where(
+        nxt_same,
+        jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1),
+        -1,
+    )
+    return seg, positions, labels
 
 
 def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
@@ -144,6 +182,7 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
         return jax.value_and_grad(loss_fn)(
             params, batch["tokens"], batch["positions"], batch["labels"], cfg,
             mesh, moe_aux_weight=aux_w,
+            segment_ids=batch.get("segment_ids"),
         )
 
     def step(state, batch):
@@ -178,7 +217,8 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
             def micro_scalar(params, micro):
                 nll_sum, aux = _loss_parts(
                     params, micro["tokens"], micro["positions"],
-                    micro["labels"], cfg, mesh)
+                    micro["labels"], cfg, mesh,
+                    segment_ids=micro.get("segment_ids"))
                 return nll_sum + aux_w * aux * (v_total / accum)
 
             def body(carry, micro):
@@ -258,6 +298,29 @@ def prefetch_batches(dl, cfg: ModelConfig, mesh: Mesh, depth: int = 2):
             yield q.popleft()
     while q:  # finite iterator: drain what is already in flight
         yield q.popleft()
+
+
+def make_packed_batch(key, cfg: ModelConfig, mesh: Mesh, batch: int, seq: int,
+                      eos_id: int = 0):
+    """Synthetic PACKED LM batch: random tokens with EOS delimiters sprinkled
+    in, fields derived by packed_fields, everything permuted into layout
+    order and placed with (dp, sp) sharding."""
+    world = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))
+    k1, k2 = jax.random.split(key)
+    tokens = jax.random.randint(k1, (batch, seq), 0, cfg.vocab, dtype=jnp.int32)
+    # ~4 documents per row on average
+    eos_mask = jax.random.bernoulli(k2, 4.0 / seq, (batch, seq))
+    tokens = jnp.where(eos_mask, eos_id, jnp.maximum(tokens, 1))
+    seg, positions, labels = packed_fields(tokens, eos_id)
+    to_l = lambda a: layouts.to_layout(a, cfg.layout, world, axis=1)
+    seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
+    sharding = NamedSharding(mesh, P(cfg.batch_axis, seq_spec))
+    return {
+        "tokens": jax.device_put(to_l(tokens), sharding),
+        "positions": jax.device_put(to_l(positions), sharding),
+        "labels": jax.device_put(to_l(labels), sharding),
+        "segment_ids": jax.device_put(to_l(seg), sharding),
+    }
 
 
 def make_batch(key, cfg: ModelConfig, mesh: Mesh, batch: int, seq: int):

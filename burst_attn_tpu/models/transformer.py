@@ -217,7 +217,7 @@ def _attn_out(p, o):
     return jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
 
 
-def _attention(p, x, positions, cfg: ModelConfig, mesh):
+def _attention(p, x, positions, cfg: ModelConfig, mesh, segment_ids=None):
     q, k, v = _qkv_proj(p, x, positions, cfg)
     if cfg.attn_strategy == "ulysses":
         if len(cfg.seq_axes) != 1:
@@ -236,6 +236,7 @@ def _attention(p, x, positions, cfg: ModelConfig, mesh):
             backend=cfg.attn_backend, block_q=cfg.block_q,
             block_kv=cfg.block_kv, batch_axes=cfg.batch_axis,
             head_axes=cfg.head_axis, window=cfg.window,
+            segment_ids=segment_ids,
         )
     elif cfg.attn_strategy == "burst":
         o = burst_attn(
@@ -252,6 +253,7 @@ def _attention(p, x, positions, cfg: ModelConfig, mesh):
             batch_axes=cfg.batch_axis,
             head_axes=cfg.head_axis,
             window=cfg.window,
+            segment_ids=segment_ids,
         )
     else:
         raise ValueError(
@@ -352,17 +354,25 @@ def _mlp(p, x, cfg: Optional[ModelConfig] = None, mesh=None, inference=False):
     return out, jnp.float32(0.0)
 
 
-def forward(params: Params, tokens, positions, cfg: ModelConfig, mesh) -> jax.Array:
+def forward(params: Params, tokens, positions, cfg: ModelConfig, mesh,
+            segment_ids=None) -> jax.Array:
     """tokens, positions: [B, S] int32 (layout order). Returns fp32 logits
-    [B, S, vocab]."""
-    logits, _ = forward_with_aux(params, tokens, positions, cfg, mesh)
+    [B, S, vocab].  segment_ids [B, S]: packed-sequence ids in layout order
+    (attention never crosses document boundaries)."""
+    logits, _ = forward_with_aux(params, tokens, positions, cfg, mesh,
+                                 segment_ids=segment_ids)
     return logits
 
 
-def forward_with_aux(params: Params, tokens, positions, cfg: ModelConfig, mesh):
+def forward_with_aux(params: Params, tokens, positions, cfg: ModelConfig, mesh,
+                     segment_ids=None):
     """forward + the summed MoE auxiliary load-balancing loss (0 for dense
     models); the trainer adds `moe_aux_weight * aux` to the objective."""
     if cfg.pp_axis is not None:
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "packed sequences are not threaded through the pipeline-"
+                "parallel forward yet; use pp_axis=None with segment_ids")
         from .pipeline_lm import pp_forward_with_aux
 
         return pp_forward_with_aux(params, tokens, positions, cfg, mesh)
@@ -377,7 +387,8 @@ def forward_with_aux(params: Params, tokens, positions, cfg: ModelConfig, mesh):
 
     def block(carry, p):
         x, aux = carry
-        x = x + _attention(p, x, positions, cfg, mesh)
+        x = x + _attention(p, x, positions, cfg, mesh,
+                           segment_ids=segment_ids)
         m, aux_l = _mlp(p, x, cfg, mesh)
         x = jax.lax.with_sharding_constraint(x + m, act_spec)
         return x, aux + aux_l

@@ -331,7 +331,7 @@ def _fwd_kernel(
     q_ref, k_ref, v_ref, m_in_ref, lse_in_ref, acc_in_ref,
     *rest,
     scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p, tri, wnd=None,
-    seg=False, ablate=None,
+    seg=False, emit_o=False, ablate=None,
 ):
     if seg:
         qseg_ref, kvseg_ref = rest[0], rest[1]
@@ -477,13 +477,20 @@ def _fwd_kernel(
         _write_rows(m_out_ref, i, m, bq, lp)
         lse = jnp.where(l > 0, m + jnp.log(l), NEG_INF)
         _write_rows(lse_out_ref, i, lse, bq, lp)
-        acc_out_ref[0, 0, :, :] = acc_scr[:]
+        if emit_o:
+            # fused finalize: o = acc * exp(m - lse) = acc / l — emit the
+            # normalized output in the caller's dtype and skip the separate
+            # [B,N,S,D]-f32 finalize pass (and its HBM round trip) entirely
+            acc_out_ref[0, 0, :, :] = jnp.where(
+                l > 0, acc_scr[:] / l, 0.0).astype(acc_out_ref.dtype)
+        else:
+            acc_out_ref[0, 0, :, :] = acc_scr[:]
 
 
 def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
               block_q=1024, block_kv=1024, block_kv_compute=None,
               interpret=None, cast_p=True, triangular=False, window=None,
-              segments=None, _ablate=None):
+              segments=None, emit_o=False, _ablate=None):
     """One online-softmax ring round on TPU.  Same contract as
     ops/tile.py:tile_fwd: returns updated (m, lse, acc).
 
@@ -525,6 +532,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
             scale, spec, block_q=block_q, block_kv=block_kv,
             block_kv_compute=block_kv_compute, interpret=interpret,
             cast_p=cast_p, triangular=False, window=window, segments=segments,
+            emit_o=emit_o,
         )
         return m2[:, :, :s_q], lse2[:, :, :s_q], acc2[:, :, :s_q]
     bq = _pick_block(s_q, block_q)
@@ -555,7 +563,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, bq=bq, bkv=bkv, bkv_compute=bkc, lp=lp,
         n_kv_blocks=nkb, cast_p=cast_p, tri=tri, wnd=window,
-        seg=segments is not None, ablate=_ablate,
+        seg=segments is not None, emit_o=emit_o, ablate=_ablate,
     )
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     in_specs = [
@@ -580,7 +588,10 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     out_shape = [
         jax.ShapeDtypeStruct((b, n, s_q // lp, lp), jnp.float32),
         jax.ShapeDtypeStruct((b, n, s_q // lp, lp), jnp.float32),
-        jax.ShapeDtypeStruct((b, n, s_q, d), jnp.float32),
+        # emit_o: the third output is the NORMALIZED o in q's dtype (fused
+        # finalize, see _finish) instead of the raw f32 accumulator
+        jax.ShapeDtypeStruct((b, n, s_q, d),
+                             q.dtype if emit_o else jnp.float32),
     ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -930,12 +941,95 @@ def _bwd_fused_kernel(
         dv_ref[0, 0, :, :] = dv_scr[:]
 
 
+def _flush_dk_sub(dk_scr, ds_pend, q_pend, pend_flag, bkvc):
+    """Sub-block-width deferred dk flush (tri kernel): pend_flag holds
+    (flag, sub-block index); the dk rows are a dynamic slice of the kv-block
+    scratch.  Same scheduling argument as _flush_dk — the matmul issues at
+    the NEXT step's start, ahead of that step's VPU dependencies."""
+    rows = pl.ds(pend_flag[1] * bkvc, bkvc)
+    dk_scr[rows, :] = dk_scr[rows, :] + jax.lax.dot_general(
+        ds_pend[:], q_pend[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    pend_flag[0] = 0
+
+
+def _bwd_accum_tile_sub(
+    do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
+    dv_scr, dk_scr, ds_pend, q_pend, pend_flag,
+    iq, masked, mask_of, *, scale, bq, bkvc, n_sub, lp, dq_update,
+):
+    """Fused-backward block pair with the kv block split into compute
+    sub-blocks — the backward analogue of _fwd_kernel._sweep.
+
+    Why: the un-sub-blocked tile keeps four [bq, bkv] f32 intermediates
+    (s, dp, p, ds) live at once, which is what pins the backward's VMEM
+    cliff one power of two below the forward's (see ops/tuning.py).
+    Splitting the kv dimension into n_sub pieces shrinks the live
+    intermediates to [bq, bkvc], buying the same grid-step count at double
+    the kv block — fewer steps, same math.
+
+    Scheduling per sub-block u: s(u) and dp(u) issue back to back on the
+    MXU; the VPU p/ds chain for u overlaps dv(u)'s matmul (its operand p is
+    ready one slot earlier) and dk(u-1)'s — dk is deferred ONE SUB-BLOCK
+    in-step (plain values, the q operand is the same all step) and one
+    GRID STEP for the final sub-block (scratch stash, _flush_dk_sub).  dq
+    accumulates across sub-blocks in a [bq, d] f32 value and folds into the
+    resident output buffer once per step."""
+    q = q_ref[0, 0, :, :]
+    do = do_ref[0, 0, :, :]
+    lse_row = _read_rows(lse_ref, iq, bq, lp)
+    lse_row = jnp.where(lse_row == NEG_INF, BIG_LSE, lse_row * LOG2E)
+    delta_row = _read_rows(delta_ref, iq, bq, lp)
+    qs = q * (scale * LOG2E)
+    dq_acc = None
+    prev = None  # (u, ds cast) awaiting its dk matmul
+    for u in range(n_sub):
+        rows = slice(u * bkvc, (u + 1) * bkvc)
+        k_u = k_ref[0, 0, rows, :]
+        v_u = v_ref[0, 0, rows, :]
+        s = jax.lax.dot_general(
+            qs, k_u, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_u, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        p = jnp.exp2(s - lse_row)
+        if masked:
+            p = jnp.where(mask_of(u), p, 0.0)
+        dv_scr[rows, :] = dv_scr[rows, :] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_row)
+        dq_u = jax.lax.dot_general(
+            ds.astype(k_u.dtype), k_u, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dq_acc = dq_u if dq_acc is None else dq_acc + dq_u
+        if prev is not None:
+            pu, pds = prev
+            prows = slice(pu * bkvc, (pu + 1) * bkvc)
+            dk_scr[prows, :] = dk_scr[prows, :] + jax.lax.dot_general(
+                pds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        prev = (u, ds.astype(q.dtype))
+    dq_update(dq_acc)
+    ds_pend[:] = prev[1]
+    q_pend[:] = q
+    pend_flag[0] = 1
+    pend_flag[1] = prev[0]
+
+
 def _bwd_fused_tri_kernel(
     spec_ref,
     do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
     dq_ref, dk_ref, dv_ref,
     dk_scr, dv_scr, ds_pend, q_pend, pend_flag,
-    *, scale, bq, bkv, lp, nqb, nkb, ratio,
+    *, scale, bq, bkv, bkvc, lp, nqb, nkb, ratio,
 ):
     """Wrapped-diagonal causal backward (static full-window causal with
     offset 0 or -1 — see the flash_fwd docstring's triangular contract —
@@ -975,7 +1069,7 @@ def _bwd_fused_tri_kernel(
     # kv block when c == len_a)
     @pl.when(pend_flag[0] == 1)
     def _flush_prev():
-        _flush_dk(dk_scr, ds_pend, q_pend, pend_flag)
+        _flush_dk_sub(dk_scr, ds_pend, q_pend, pend_flag, bkvc)
 
     # segment writeout: at c == len_a write segment A's dk/dv (out index map
     # lags one step, so the block still points at kv j_hi); at c == ncols
@@ -993,36 +1087,40 @@ def _bwd_fused_tri_kernel(
     # the diagonal blocks are the trailing `ratio` steps of each segment
     full = jnp.where(seg_b, c < ncols - ratio, c < len_a - ratio)
 
-    def _dq_update(ds, k):
+    def _dq_update(dq_acc):
         # dq accumulates straight into the resident whole-head out buffer
         rows = pl.ds(iq * bq, bq)
-        dq_ref[0, 0, rows, :] = dq_ref[0, 0, rows, :] + scale * jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        dq_ref[0, 0, rows, :] = dq_ref[0, 0, rows, :] + scale * dq_acc
 
-    def _accum(mask):
-        _bwd_accum_tile(
+    def _accum(masked):
+        _bwd_accum_tile_sub(
             do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
-            dv_scr, ds_pend, q_pend, pend_flag,
-            iq, mask, scale=scale, bq=bq, lp=lp, dq_update=_dq_update,
+            dv_scr, dk_scr, ds_pend, q_pend, pend_flag,
+            iq, masked,
+            lambda u: _block_mask(spec_ref, r0, c0 + u * bkvc, bq, bkvc),
+            scale=scale, bq=bq, bkvc=bkvc, n_sub=bkv // bkvc, lp=lp,
+            dq_update=_dq_update,
         )
 
     @pl.when(compute & full)
     def _compute_fast():
-        _accum(None)
+        _accum(False)
 
     @pl.when(compute & ~full)
     def _compute_masked():
-        _accum(_block_mask(spec_ref, r0, c0, bq, bkv))
+        _accum(True)
 
 
 def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
-                         block_q, block_kv, interpret):
+                         block_q, block_kv, interpret, block_kv_compute=None):
     b, n, s_q, d = q.shape
     s_kv = k.shape[2]
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
+    if block_kv_compute is None:
+        bkvc = bkv
+    else:
+        bkvc = _pick_block(bkv, block_kv_compute)
     lp = _pick_block(bq, 128)
     nqb = s_q // bq
     nkb = s_kv // bkv
@@ -1056,8 +1154,8 @@ def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     dq, dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_fused_tri_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp,
-            nqb=nqb, nkb=nkb, ratio=ratio,
+            _bwd_fused_tri_kernel, scale=scale, bq=bq, bkv=bkv, bkvc=bkvc,
+            lp=lp, nqb=nqb, nkb=nkb, ratio=ratio,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -1078,9 +1176,9 @@ def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
             scratch_shapes=[
                 pltpu.VMEM((bkv, d), jnp.float32),
                 pltpu.VMEM((bkv, d), jnp.float32),
-                pltpu.VMEM((bq, bkv), q.dtype),
+                pltpu.VMEM((bq, bkvc), q.dtype),
                 pltpu.VMEM((bq, d), q.dtype),
-                pltpu.SMEM((1,), jnp.int32),
+                pltpu.SMEM((2,), jnp.int32),
             ],
         ),
         out_shape=[
@@ -1174,19 +1272,23 @@ def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
     return dq, dk, dv
 
 
-def _tri_bwd_other_residents(bq, bkv, d, itemsize=2):
+def _tri_bwd_other_residents(bq, bkv, d, itemsize=2, bkvc=None):
     """Estimated VMEM held by everything EXCEPT the whole-head dq output in
     the triangular fused bwd kernel: double-buffered input blocks (do, q,
-    k, v) and dk/dv f32 output blocks, plus the ds/q deferral stashes.
-    Packed delta/lse blocks are negligible next to these."""
+    k, v) and dk/dv f32 output blocks, plus the ds/q deferral stashes
+    (sub-block width when block_kv_compute is set).  Packed delta/lse
+    blocks are negligible next to these."""
+    if bkvc is None:
+        bkvc = bkv
     blocks = 2 * (2 * bq * d * itemsize      # do, q
                   + 2 * bkv * d * itemsize   # k, v
                   + 2 * bkv * d * 4)         # dk, dv out (f32)
-    scratch = bq * bkv * itemsize + bq * d * itemsize  # ds stash, q stash
+    scratch = bq * bkvc * itemsize + bq * d * itemsize  # ds stash, q stash
     return blocks + scratch
 
 
-def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv) -> bool:
+def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv,
+                      block_kv_compute=None) -> bool:
     """Whether flash_bwd(triangular=True) will actually use the
     wrapped-diagonal kernel (vs silently falling back to the rectangular
     fused kernel): group=1 only, square even block tiling, and the
@@ -1200,7 +1302,11 @@ def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv) -> bool:
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
     nkb = s_kv // bkv
-    dq_budget = VMEM_LIMIT // 2 - _tri_bwd_other_residents(bq, bkv, d)
+    # clamp the sub-block exactly as _flash_bwd_fused_tri will, so the
+    # estimate charges the scratch the kernel actually allocates
+    bkvc = None if block_kv_compute is None else _pick_block(bkv, block_kv_compute)
+    dq_budget = VMEM_LIMIT // 2 - _tri_bwd_other_residents(
+        bq, bkv, d, bkvc=bkvc)
     return (
         n == n_kv and s_q == s_kv and bkv % bq == 0
         and nkb % 2 == 0 and nkb >= 2
@@ -1210,7 +1316,8 @@ def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv) -> bool:
 
 def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
               block_q=1024, block_kv=1024, interpret=None, fused=None,
-              triangular=False, window=None, segments=None):
+              triangular=False, window=None, segments=None,
+              block_kv_compute=None):
     """One backward ring round on TPU.  Same contract as ops/tile.py:tile_bwd:
     returns (dq [B,N,S,D], dk [B,Nk,Skv,D], dv [B,Nk,Skv,D]) in float32.
 
@@ -1224,7 +1331,8 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     grid (same caller contract as flash_fwd's triangular: full-window
     causal, offset 0 or -1) when tri_bwd_supported() holds; an explicit
     fused=False takes precedence so the split kernels can always be
-    A/B-compared.
+    A/B-compared.  `block_kv_compute` (tri path only) splits the kv block
+    into compute sub-blocks — see _bwd_accum_tile_sub.
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -1245,7 +1353,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
             _pad_seq(delta, sq_pad), _pad_seq(lse, sq_pad),
             scale, spec, block_q=block_q, block_kv=block_kv,
             interpret=interpret, fused=fused, triangular=False, window=window,
-            segments=segments,
+            segments=segments, block_kv_compute=block_kv_compute,
         )
         return dq[:, :, :s_q], dk[:, :, :s_kv], dv[:, :, :s_kv]
     bq = _pick_block(s_q, block_q)
@@ -1265,12 +1373,14 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
         fused = not interpret and (s_q // bq) * group >= 4
     tri = (
         bool(triangular) and not explicit_split and not _tri_disabled()
-        and tri_bwd_supported(s_q, s_kv, n, n_kv, d, block_q=bq, block_kv=bkv)
+        and tri_bwd_supported(s_q, s_kv, n, n_kv, d, block_q=bq, block_kv=bkv,
+                              block_kv_compute=block_kv_compute)
     )
     if tri:
         return _flash_bwd_fused_tri(
             do, q, k, v, delta, lse, scale, spec,
             block_q=block_q, block_kv=block_kv, interpret=interpret,
+            block_kv_compute=block_kv_compute,
         )
     if fused:
         return _flash_bwd_fused(
@@ -1440,7 +1550,7 @@ def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
                               block_kv_compute=None, window=None,
                               segment_ids=None):
     from .masks import round_spec
-    from .tile import finalize as _finalize, init_state
+    from .tile import init_state
 
     b, n, s, d = q.shape
     if scale is None:
@@ -1454,16 +1564,18 @@ def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
     spec = round_spec(jnp.int32(0), jnp.int32(0), s, k.shape[2], causal, "contig")
     m0, lse0, acc0 = init_state(b, n, s, d)
     segs = None if segment_ids is None else (segment_ids, segment_ids)
-    m, lse, acc = flash_fwd(
+    _, lse, o = flash_fwd(
         q, k, v, m0, lse0, acc0, scale, spec, block_q=block_q, block_kv=block_kv,
         block_kv_compute=block_kv_compute,
         # the spec here is statically known to be plain full-window causal,
         # exactly the triangular grid's precondition (tri declines windows;
         # segment masking composes with the tri grid — the in-kernel seg_ok
-        # test just widens which blocks take the masked path)
-        triangular=causal, window=window, segments=segs,
+        # test just widens which blocks take the masked path).  emit_o fuses
+        # the finalize into the kernel's last visit of each q block: no
+        # one-round ring carry is needed here, so the raw f32 accumulator
+        # never has to reach HBM
+        triangular=causal, window=window, segments=segs, emit_o=True,
     )
-    o = _finalize(m, lse, acc, q.dtype)
     return o, lse
 
 

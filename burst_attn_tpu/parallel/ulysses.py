@@ -29,27 +29,30 @@ from jax.sharding import PartitionSpec as P
 
 
 def _local_attention(q, k, v, scale, causal, backend, block_q, block_kv,
-                     window=None):
+                     window=None, segment_ids=None):
     if backend == "pallas":
         from ..ops.pallas_flash import flash_attention
 
         return flash_attention(q, k, v, scale, causal, block_q, block_kv,
-                               window=window)
+                               window=window, segment_ids=segment_ids)
     from ..ops.tile import single_device_attention
 
-    return single_device_attention(q, k, v, scale, causal, window=window)
+    return single_device_attention(q, k, v, scale, causal, window=window,
+                                   segment_ids=segment_ids)
 
 
-def _ulysses_shard(q, k, v, *, axis, scale, causal, backend, block_q, block_kv,
-                   window=None):
+def _ulysses_shard(q, k, v, seg=None, *, axis, scale, causal, backend,
+                   block_q, block_kv, window=None):
     """Per-shard [B, N, S/W, D] -> [B, N, S/W, D] with full-seq attention on
-    N/W heads in between."""
+    N/W heads in between.  `seg` [B, S] (the FULL sequence's packed ids,
+    replicated — after the all-to-all every device holds the whole
+    sequence, so the ids need no exchange)."""
     # scatter heads (axis 1), gather sequence (axis 2)
     qh = lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
     kh = lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
     vh = lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
     o = _local_attention(qh, kh, vh, scale, causal, backend, block_q, block_kv,
-                         window)
+                         window, segment_ids=seg)
     # scatter sequence back, gather heads
     return lax.all_to_all(o, axis, split_axis=2, concat_axis=1, tiled=True)
 
@@ -69,6 +72,7 @@ def ulysses_attn(
     batch_axes=None,
     head_axes=None,
     window: Optional[int] = None,
+    segment_ids=None,
 ) -> jax.Array:
     """All-to-all sequence-parallel attention on global [B, N, S, D] arrays.
 
@@ -76,7 +80,9 @@ def ulysses_attn(
     `head_axes` optionally shards heads over a tensor-parallel axis riding
     alongside (the all-to-all then exchanges the LOCAL heads of each tp
     group).  Requires per-tp-group head counts divisible by the seq axis
-    size W for both q and kv heads.
+    size W for both q and kv heads.  `segment_ids` [B, S] int32 packs
+    multiple documents (attention stays in-segment); the ids enter the
+    shard replicated over the sequence axis.
     """
     from .burst import _resolve_backend
 
@@ -97,20 +103,31 @@ def ulysses_attn(
     from ..ops.tuning import resolve_blocks
 
     block_q, block_kv = resolve_blocks(block_q, block_kv)[:2]
+    shard = partial(
+        _ulysses_shard,
+        axis=seq_axis,
+        scale=scale,
+        causal=causal,
+        backend=_resolve_backend(backend),
+        block_q=block_q,
+        block_kv=block_kv,
+        window=window,
+    )
+    qkv_spec = P(batch_axes, head_axes, seq_axis, None)
+    if segment_ids is not None:
+        fn = jax.shard_map(
+            shard,
+            mesh=mesh,
+            in_specs=(qkv_spec,) * 3 + (P(batch_axes, None),),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        return fn(q, k, v, jnp.asarray(segment_ids, jnp.int32))
     fn = jax.shard_map(
-        partial(
-            _ulysses_shard,
-            axis=seq_axis,
-            scale=scale,
-            causal=causal,
-            backend=_resolve_backend(backend),
-            block_q=block_q,
-            block_kv=block_kv,
-            window=window,
-        ),
+        shard,
         mesh=mesh,
-        in_specs=(P(batch_axes, head_axes, seq_axis, None),) * 3,
-        out_specs=P(batch_axes, head_axes, seq_axis, None),
+        in_specs=(qkv_spec,) * 3,
+        out_specs=qkv_spec,
         check_vma=False,
     )
     return fn(q, k, v)
