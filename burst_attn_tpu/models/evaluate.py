@@ -16,7 +16,8 @@ def make_eval_step(cfg: ModelConfig, mesh):
 
     def step(params, batch):
         return loss_fn(params, batch["tokens"], batch["positions"],
-                       batch["labels"], cfg, mesh)
+                       batch["labels"], cfg, mesh,
+                       segment_ids=batch.get("segment_ids"))
 
     return jax.jit(step)
 
@@ -29,9 +30,13 @@ class Evaluator:
     every eval sees the same batches."""
 
     def __init__(self, cfg: ModelConfig, mesh, data_path, *, batch: int,
-                 seq_len: int, max_batches: int = 32):
+                 seq_len: int, max_batches: int = 32, packed_eos_id=None):
         self._step = make_eval_step(cfg, mesh)
         self._cfg, self._mesh = cfg, mesh
+        # packed training must be EVALUATED packed too, or eval_loss
+        # measures a different objective (cross-document attention,
+        # unmasked boundaries) than the train loss
+        self._packed_eos_id = packed_eos_id
         self._loader = DataLoader(
             data_path, batch, seq_len,
             shard_id=jax.process_index(), num_shards=jax.process_count(),
@@ -46,7 +51,9 @@ class Evaluator:
         for _ in range(self._n):
             x, y = self._loader.next()
             losses.append(
-                self._step(params, batch_from_host(x, y, self._cfg, self._mesh)))
+                self._step(params, batch_from_host(
+                    x, y, self._cfg, self._mesh,
+                    packed_eos_id=self._packed_eos_id)))
         loss = float(np.mean([float(l) for l in losses]))
         return {"eval_loss": loss, "ppl": math.exp(min(loss, 50.0))}
 

@@ -50,6 +50,9 @@ class RunConfig:
     eval_data_path: Optional[str] = None
     eval_every: int = 500
     eval_batches: int = 16
+    # packed-document training: EOS token id delimiting documents in the
+    # token stream (None = plain contiguous LM crops)
+    packed_eos_id: Optional[int] = None
 
 
 def fit(cfg: ModelConfig, tcfg: TrainConfig, run: RunConfig, mesh):
@@ -85,6 +88,7 @@ def fit(cfg: ModelConfig, tcfg: TrainConfig, run: RunConfig, mesh):
         evaluator = Evaluator(
             cfg, mesh, run.eval_data_path, batch=run.batch,
             seq_len=run.seq_len, max_batches=run.eval_batches,
+            packed_eos_id=run.packed_eos_id,
         )
 
     def maybe_eval(step):
@@ -105,7 +109,8 @@ def fit(cfg: ModelConfig, tcfg: TrainConfig, run: RunConfig, mesh):
         ) as dl:
             if start_step:
                 dl.seek(start_step)
-            batches = prefetch_batches(dl, cfg, mesh)
+            batches = prefetch_batches(dl, cfg, mesh,
+                                       packed_eos_id=run.packed_eos_id)
             for step in range(start_step, run.steps):
                 batch = next(batches)
                 with timer as t:
@@ -177,6 +182,10 @@ def main(argv=None):
     p.add_argument("--microbatches", type=int, default=None,
                    help="GPipe microbatches for a pp= mesh (default: pp size)")
     p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--packed-eos", type=int, default=None,
+                   help="EOS token id delimiting packed documents: positions "
+                        "restart per document, loss masks boundaries, and "
+                        "attention never crosses them (segment_ids)")
     p.add_argument("--multihost", action="store_true",
                    help="call multihost.initialize() before touching jax")
     args = p.parse_args(argv)
@@ -208,6 +217,10 @@ def main(argv=None):
     pp_axis = "pp" if "pp" in mesh_axes else None
     if args.microbatches and not pp_axis:
         raise SystemExit("--microbatches requires a pp= axis in --mesh")
+    if args.packed_eos is not None and pp_axis:
+        raise SystemExit("--packed-eos is not supported with a pp= mesh yet "
+                         "(segment ids are not threaded through the "
+                         "pipeline-parallel forward)")
     cfg = ModelConfig(
         seq_axes=seq_axes,
         batch_axis="dp" if "dp" in mesh_axes else None,
@@ -233,7 +246,7 @@ def main(argv=None):
         seq_len=args.seq_len, ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every, log_every=args.log_every, seed=args.seed,
         eval_data_path=args.eval_data, eval_every=args.eval_every,
-        eval_batches=args.eval_batches,
+        eval_batches=args.eval_batches, packed_eos_id=args.packed_eos,
     )
     fit(cfg, tcfg, run, mesh)
 

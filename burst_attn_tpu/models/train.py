@@ -168,6 +168,30 @@ def packed_fields(tokens, eos_id: int):
     return seg, positions, labels
 
 
+def packed_fields_np(tokens, eos_id: int):
+    """numpy twin of packed_fields for the HOST prefetch path: the loader
+    thread derives packed fields without touching the device (an eager jax
+    derivation would block on a device round-trip per batch, serializing
+    against the in-flight train step)."""
+    tokens = np.asarray(tokens)
+    b, s = tokens.shape
+    is_eos = tokens == eos_id
+    seg = (np.cumsum(is_eos, axis=1) - is_eos).astype(np.int32)
+    idx = np.broadcast_to(np.arange(s, dtype=np.int32)[None], (b, s))
+    is_start = np.concatenate(
+        [np.ones((b, 1), bool), seg[:, 1:] != seg[:, :-1]], axis=1)
+    seg_start = np.maximum.accumulate(np.where(is_start, idx, 0), axis=1)
+    positions = (idx - seg_start).astype(np.int32)
+    nxt_same = np.concatenate(
+        [seg[:, 1:] == seg[:, :-1], np.zeros((b, 1), bool)], axis=1)
+    labels = np.where(
+        nxt_same,
+        np.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1),
+        -1,
+    ).astype(np.int32)
+    return seg, positions, labels
+
+
 def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     """Returns jitted step((params, opt_state), batch) -> (state, metrics).
 
@@ -243,13 +267,20 @@ def train_step(state, batch, cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     return make_train_step(cfg, tcfg, mesh)(state, batch)
 
 
-def batch_from_host(tokens, labels, cfg: ModelConfig, mesh: Mesh):
+def batch_from_host(tokens, labels, cfg: ModelConfig, mesh: Mesh,
+                    packed_eos_id=None):
     """Turn a host batch (e.g. from data.DataLoader: inputs/targets
     [B, S] int32 numpy, natural order) into the sharded, layout-permuted
     batch dict `make_train_step` consumes.
 
     Labels are shifted by the LOADER (targets = window[1:]), so here they
     only get the same layout permutation as tokens.
+
+    `packed_eos_id`: treat the stream as EOS-delimited packed documents —
+    positions restart per document, labels are re-derived with boundary
+    masking, and segment_ids join the batch (attention isolation via
+    forward(..., segment_ids)).  The loader's shifted labels are superseded
+    in this mode (packed_fields recomputes them from tokens alone).
 
     Multi-process: `tokens`/`labels` are each process's LOCAL batch (e.g.
     its shard of the DataLoader stream); the global batch is assembled
@@ -262,14 +293,22 @@ def batch_from_host(tokens, labels, cfg: ModelConfig, mesh: Mesh):
     b, s = tokens.shape
     world = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))
     perm = layouts.seq_permutation(cfg.layout, s, world)
-    pos = np.ascontiguousarray(
-        np.broadcast_to(np.asarray(perm, np.int32)[None, :], (b, s)))
     seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
     sharding = NamedSharding(mesh, P(cfg.batch_axis, seq_spec))
     if jax.process_count() > 1:
         put = partial(jax.make_array_from_process_local_data, sharding)
     else:
         put = partial(jax.device_put, device=sharding)
+    if packed_eos_id is not None:
+        seg, pos_packed, labels_packed = packed_fields_np(tokens, packed_eos_id)
+        return {
+            "tokens": put(np.ascontiguousarray(tokens[:, perm])),
+            "positions": put(np.ascontiguousarray(pos_packed[:, perm])),
+            "labels": put(np.ascontiguousarray(labels_packed[:, perm])),
+            "segment_ids": put(np.ascontiguousarray(seg[:, perm])),
+        }
+    pos = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(perm, np.int32)[None, :], (b, s)))
     return {
         "tokens": put(np.ascontiguousarray(tokens[:, perm])),
         "positions": put(pos),
@@ -277,24 +316,28 @@ def batch_from_host(tokens, labels, cfg: ModelConfig, mesh: Mesh):
     }
 
 
-def prefetch_batches(dl, cfg: ModelConfig, mesh: Mesh, depth: int = 2):
+def prefetch_batches(dl, cfg: ModelConfig, mesh: Mesh, depth: int = 2,
+                     packed_eos_id=None):
     """Generator keeping `depth` device batches in flight: host->device
     transfer of batch N+1..N+depth overlaps the step running on batch N
     (device_put is async; the loader's worker threads fill the windows).
-    `dl` is a data.DataLoader (or any (inputs, targets) iterator)."""
+    `dl` is a data.DataLoader (or any (inputs, targets) iterator).
+    `packed_eos_id`: see batch_from_host — packed-document training."""
     from collections import deque
 
     q = deque()
     it = iter(dl)
+    mk = partial(batch_from_host, cfg=cfg, mesh=mesh,
+                 packed_eos_id=packed_eos_id)
     try:
         for _ in range(depth):
             x, y = next(it)
-            q.append(batch_from_host(x, y, cfg, mesh))
+            q.append(mk(x, y))
     except StopIteration:
         pass  # source shorter than depth
     else:
         for x, y in it:
-            q.append(batch_from_host(x, y, cfg, mesh))
+            q.append(mk(x, y))
             yield q.popleft()
     while q:  # finite iterator: drain what is already in flight
         yield q.popleft()

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Round-2 follow-on watcher: when the tunnel returns, re-verify the committed
+# tree on-chip (kernel suite + headline bench) and leave artifacts for the
+# driver/judge.  Idempotent; safe to re-run.
+cd /root/repo || exit 1
+LOG=${TPU_WATCH4_LOG:-/root/repo/.tpu_watch4.log}
+exec >>"$LOG" 2>&1
+. /root/repo/scripts/tpu_lib.sh
+wait_for_tpu
+run_stage tpu-suite 5400 env BURST_TESTS_TPU=1 python -m pytest tests/test_fused_bwd.py -q
+sleep 15
+run_stage bench 3600 bash -c 'python bench.py | tee /root/repo/.bench_r2_final.json'
+echo "=== [$(date -u +%F' '%T)] WATCH4 ALL DONE ==="

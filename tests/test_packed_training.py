@@ -64,6 +64,38 @@ def test_packed_doc_isolated_from_prefix(model):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_packed_fields_np_matches_jax():
+    from burst_attn_tpu.models.train import packed_fields_np
+
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (3, 97), 0, 7)
+    got = packed_fields_np(np.asarray(tokens), eos_id=0)
+    want = packed_fields(tokens, eos_id=0)
+    for g, w, name in zip(got, want, ("seg", "pos", "labels")):
+        np.testing.assert_array_equal(g, np.asarray(w), err_msg=name)
+
+
+def test_batch_from_host_packed():
+    """Loader glue in packed mode: fields re-derived from the EOS stream,
+    all four arrays layout-permuted consistently."""
+    from burst_attn_tpu.models.train import batch_from_host
+
+    cfg = ModelConfig(vocab=64, layout="zigzag", batch_axis=None,
+                      head_axis=None)
+    mesh = make_mesh({"sp": 4})
+    tokens = np.asarray([[5, 6, 0, 7, 0, 8, 9, 10]], np.int32)
+    shifted = np.concatenate([tokens[:, 1:], np.full((1, 1), -1, np.int32)], 1)
+    b = batch_from_host(tokens, shifted, cfg, mesh, packed_eos_id=0)
+    assert set(b) == {"tokens", "positions", "labels", "segment_ids"}
+    from burst_attn_tpu.parallel import layouts
+    inv = lambda a: layouts.from_layout(a, "zigzag", 4, 1)
+    np.testing.assert_array_equal(np.asarray(inv(b["segment_ids"])),
+                                  [[0, 0, 0, 1, 1, 2, 2, 2]])
+    np.testing.assert_array_equal(np.asarray(inv(b["positions"])),
+                                  [[0, 1, 2, 0, 1, 0, 1, 2]])
+    np.testing.assert_array_equal(np.asarray(inv(b["labels"])),
+                                  [[6, 0, -1, 0, -1, 9, 10, -1]])
+
+
 @pytest.mark.parametrize("strategy,layout", [("burst", "zigzag"),
                                              ("ulysses", "contig")])
 def test_packed_train_step_runs(strategy, layout):
