@@ -1,0 +1,115 @@
+"""Paged-serving throughput on real TPU: prefill latency + steady-state
+decode tokens/s with every batch slot live (models/paged_decode.py).
+
+The reference has no serving story at all; this is the framework-level
+number for the paged KV path — decode cost ∝ live context, memory ∝ tokens
+in use.  Run:
+
+    python -m benchmarks.serve_bench --slots 8 --context 2048
+
+Prints one jsonl row per phase (prefill, decode) to --out and stdout.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--context", type=int, default=2048,
+                    help="prompt tokens per slot")
+    ap.add_argument("--decode-steps", type=int, default=64)
+    ap.add_argument("--d-model", type=int, default=2048)
+    ap.add_argument("--n-layers", type=int, default=8)
+    ap.add_argument("--n-heads", type=int, default=16)
+    ap.add_argument("--kv-heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--page", type=int, default=128)
+    ap.add_argument("--out", default="results_serve.jsonl")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print("serve_bench: not on TPU; refusing to record numbers",
+              file=sys.stderr)
+        sys.exit(1)
+
+    from burst_attn_tpu.models import ModelConfig, init_params
+    from burst_attn_tpu.models.paged_decode import (
+        ensure_capacity, init_paged_state, paged_decode_step, paged_prefill,
+    )
+
+    cfg = ModelConfig(
+        vocab=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
+        n_heads=args.n_heads, n_kv_heads=args.kv_heads,
+        d_head=args.d_model // args.n_heads, d_ff=4 * args.d_model,
+        batch_axis=None, head_axis=None,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    # +1: the warm-up/compile decode step appends a token per slot too
+    pages_per_seq = -(-(args.context + args.decode_steps + 1) // args.page)
+    n_pages = args.slots * pages_per_seq + 2
+    state, pool = init_paged_state(
+        cfg, slots=args.slots, n_pages=n_pages, page=args.page,
+        max_pages_per_seq=pages_per_seq)
+
+    def record(row):
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        print(json.dumps(row), flush=True)
+
+    key = jax.random.PRNGKey(1)
+    prompts = jax.random.randint(key, (args.slots, args.context), 1, cfg.vocab)
+
+    # admit every slot; time the LAST prefill (compile amortized by the
+    # first).  With --slots 1 the single slot is retired and re-prefilled
+    # so the timed number never embeds the compile.
+    from burst_attn_tpu.models.paged_decode import retire_slot
+
+    t0 = time.perf_counter()
+    logits, state = paged_prefill(params, prompts[0], state, pool, 0, cfg)
+    jax.block_until_ready(logits)
+    compile_s = time.perf_counter() - t0
+    if args.slots == 1:
+        state = retire_slot(state, pool, 0)
+        t0 = time.perf_counter()
+        logits, state = paged_prefill(params, prompts[0], state, pool, 0, cfg)
+        jax.block_until_ready(logits)
+        prefill_s = time.perf_counter() - t0
+    for slot in range(1, args.slots):
+        t0 = time.perf_counter()
+        logits, state = paged_prefill(params, prompts[slot], state, pool,
+                                      slot, cfg)
+        jax.block_until_ready(logits)
+        prefill_s = time.perf_counter() - t0
+    record({"phase": "prefill", "context": args.context, "slots": args.slots,
+            "ms_per_prompt": round(prefill_s * 1e3, 2),
+            "first_compile_s": round(compile_s, 1),
+            "prefill_tokens_per_s": round(args.context / prefill_s, 1)})
+
+    # steady-state decode: all slots advance per step
+    tokens = jnp.ones((args.slots,), jnp.int32)
+    for s in range(args.slots):
+        state = ensure_capacity(state, pool, s)
+    lg, state = paged_decode_step(params, tokens, state, cfg)  # compile
+    jax.block_until_ready(lg)
+    n_timed = args.decode_steps
+    t0 = time.perf_counter()
+    for _ in range(n_timed):
+        for s in range(args.slots):
+            state = ensure_capacity(state, pool, s)
+        lg, state = paged_decode_step(params, tokens, state, cfg)
+    jax.block_until_ready(lg)
+    dt = (time.perf_counter() - t0) / n_timed
+    record({"phase": "decode", "context": args.context, "slots": args.slots,
+            "step_ms": round(dt * 1e3, 2),
+            "tokens_per_s": round(args.slots / dt, 1)})
+
+
+if __name__ == "__main__":
+    main()

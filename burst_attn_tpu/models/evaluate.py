@@ -4,20 +4,26 @@ token file, using the same sharded forward as training (no optimizer)."""
 import math
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
-from .train import batch_from_host, loss_fn
+from .train import batch_from_host
 from .transformer import ModelConfig
 from ..data import DataLoader
 
 
 def make_eval_step(cfg: ModelConfig, mesh):
-    """Jitted (params, batch) -> mean cross entropy (no MoE aux term)."""
+    """Jitted (params, batch) -> (nll sum, valid-token count): the caller
+    aggregates sum/count across batches so the reported eval_loss is the
+    same token-weighted objective as the train loss (a per-batch mean of
+    means would overweight sparse batches — packed crops mask unevenly)."""
+    from .train import _loss_parts
 
     def step(params, batch):
-        return loss_fn(params, batch["tokens"], batch["positions"],
-                       batch["labels"], cfg, mesh,
-                       segment_ids=batch.get("segment_ids"))
+        nll_sum, _ = _loss_parts(params, batch["tokens"], batch["positions"],
+                                 batch["labels"], cfg, mesh,
+                                 segment_ids=batch.get("segment_ids"))
+        return nll_sum, jnp.sum(batch["labels"] >= 0)
 
     return jax.jit(step)
 
@@ -47,14 +53,15 @@ class Evaluator:
 
     def __call__(self, params) -> dict:
         self._loader.seek(0)
-        losses = []
+        nll_total, n_total = 0.0, 0
         for _ in range(self._n):
             x, y = self._loader.next()
-            losses.append(
-                self._step(params, batch_from_host(
-                    x, y, self._cfg, self._mesh,
-                    packed_eos_id=self._packed_eos_id)))
-        loss = float(np.mean([float(l) for l in losses]))
+            nll, n = self._step(params, batch_from_host(
+                x, y, self._cfg, self._mesh,
+                packed_eos_id=self._packed_eos_id))
+            nll_total += float(nll)
+            n_total += int(n)
+        loss = nll_total / max(n_total, 1)
         return {"eval_loss": loss, "ppl": math.exp(min(loss, 50.0))}
 
     def close(self):

@@ -9,5 +9,5 @@ exec >>"$LOG" 2>&1
 wait_for_tpu
 run_stage tpu-suite 5400 env BURST_TESTS_TPU=1 python -m pytest tests/test_fused_bwd.py -q
 sleep 15
-run_stage bench 3600 bash -c 'python bench.py | tee /root/repo/.bench_r2_final.json'
+run_stage bench 3600 bash -c 'set -o pipefail; python bench.py | tee /root/repo/.bench_r2_final.json'
 echo "=== [$(date -u +%F' '%T)] WATCH4 ALL DONE ==="

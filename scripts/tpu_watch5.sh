@@ -1,0 +1,12 @@
+#!/bin/bash
+# Follow-on to tpu_watch4: serving throughput benchmark for the paged
+# decode path once the chip is free again.
+cd /root/repo || exit 1
+LOG=${TPU_WATCH5_LOG:-/root/repo/.tpu_watch5.log}
+exec >>"$LOG" 2>&1
+. /root/repo/scripts/tpu_lib.sh
+wait_for_phase "tpu_watch[4].sh" /root/repo/.tpu_watch4.log "ALL DONE"
+wait_for_tpu
+run_stage serve 5400 python -m benchmarks.serve_bench --slots 8 --context 2048 \
+  --out /root/repo/results_serve.jsonl
+echo "=== [$(date -u +%F' '%T)] WATCH5 ALL DONE ==="
