@@ -89,7 +89,7 @@ def _moe_block(p, x, cfg):
     return y.reshape(bb, ss, dd), aux
 
 
-def _layer_fwd(p, x, positions, cfg, bcfg: BurstConfig):
+def _layer_fwd(p, x, positions, cfg, bcfg: BurstConfig, seg=None):
     """One transformer block, per-shard (x [mb, s_local, d]) ->
     (x, aux_loss).
 
@@ -103,7 +103,7 @@ def _layer_fwd(p, x, positions, cfg, bcfg: BurstConfig):
     the MoE output needs no tp psum."""
     tp = cfg.head_axis
     q, k, v = _qkv_proj(p, x, positions, cfg)
-    o = burst_attn_shard(q, k, v, bcfg)
+    o = burst_attn_shard(q, k, v, bcfg, seg)
     attn = _attn_out(p, o)
     if tp is not None:
         attn = lax.psum(attn, tp)
@@ -118,7 +118,7 @@ def _layer_fwd(p, x, positions, cfg, bcfg: BurstConfig):
 
 
 def _pp_forward_shard(layers_p, embed, final_norm, lm_head, tokens, positions,
-                      *, cfg, bcfg: BurstConfig, m: int):
+                      segments=None, *, cfg, bcfg: BurstConfig, m: int):
     """Per-shard body: embed -> GPipe ticks over `pp` -> head.
 
     layers_p: this stage's layers, leaves [L/P, ...]; tokens/positions
@@ -132,11 +132,13 @@ def _pp_forward_shard(layers_p, embed, final_norm, lm_head, tokens, positions,
     mb = b_l // m
     x_mb = x.reshape(m, mb, s_l, d)
     pos_mb = positions.reshape(m, mb, s_l)
+    seg_mb = (None if segments is None
+              else segments.reshape(m, mb, s_l))
 
-    def stage_fn(x, pos):
+    def stage_fn(x, pos, seg):
         def body(carry, p):
             x, aux = carry
-            x, aux_l = _layer_fwd(p, x, pos, cfg, bcfg)
+            x, aux_l = _layer_fwd(p, x, pos, cfg, bcfg, seg)
             return (x, aux + aux_l), None
 
         if cfg.remat:
@@ -159,7 +161,9 @@ def _pp_forward_shard(layers_p, embed, final_norm, lm_head, tokens, positions,
         mb_id = t - stage
         pos = lax.dynamic_index_in_dim(
             pos_mb, jnp.clip(mb_id, 0, m - 1), axis=0, keepdims=False)
-        y, aux_t = stage_fn(cur, pos)
+        seg = (None if seg_mb is None else lax.dynamic_index_in_dim(
+            seg_mb, jnp.clip(mb_id, 0, m - 1), axis=0, keepdims=False))
+        y, aux_t = stage_fn(cur, pos, seg)
         # MoE aux from bubble ticks (garbage activations) must not count
         live = (mb_id >= 0) & (mb_id < m)
         aux_acc = aux_acc + jnp.where(live, aux_t, 0.0)
@@ -187,7 +191,8 @@ def _pp_forward_shard(layers_p, embed, final_norm, lm_head, tokens, positions,
     return logits, aux
 
 
-def pp_forward_with_aux(params, tokens, positions, cfg, mesh):
+def pp_forward_with_aux(params, tokens, positions, cfg, mesh,
+                        segment_ids=None):
     """Pipeline-parallel forward_with_aux: fp32 logits [B, S, vocab] + the
     MoE aux loss (0 for dense models).
 
@@ -265,13 +270,18 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh):
     # spec would hand every tp shard the full weights (double-counted after
     # the body's psums)
     layer_specs = param_specs(cfg)["layers"]
+    in_specs = [layer_specs, P(), P(), P(), tok_spec, tok_spec]
+    args = [params["layers"], params["embed"], params["final_norm"],
+            params["lm_head"], tokens, positions]
+    if segment_ids is not None:
+        in_specs.append(tok_spec)
+        args.append(jnp.asarray(segment_ids, jnp.int32))
     fn = jax.shard_map(
         partial(_pp_forward_shard, cfg=cfg, bcfg=bcfg, m=m),
         mesh=mesh,
-        in_specs=(layer_specs, P(), P(), P(), tok_spec, tok_spec),
+        in_specs=tuple(in_specs),
         out_specs=(P(cfg.batch_axis, seq_spec, None), P()),
         check_vma=False,
     )
-    logits, aux = fn(params["layers"], params["embed"], params["final_norm"],
-                     params["lm_head"], tokens, positions)
+    logits, aux = fn(*args)
     return logits, aux

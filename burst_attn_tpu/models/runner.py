@@ -217,10 +217,6 @@ def main(argv=None):
     pp_axis = "pp" if "pp" in mesh_axes else None
     if args.microbatches and not pp_axis:
         raise SystemExit("--microbatches requires a pp= axis in --mesh")
-    if args.packed_eos is not None and pp_axis:
-        raise SystemExit("--packed-eos is not supported with a pp= mesh yet "
-                         "(segment ids are not threaded through the "
-                         "pipeline-parallel forward)")
     cfg = ModelConfig(
         seq_axes=seq_axes,
         batch_axis="dp" if "dp" in mesh_axes else None,

@@ -369,13 +369,10 @@ def forward_with_aux(params: Params, tokens, positions, cfg: ModelConfig, mesh,
     """forward + the summed MoE auxiliary load-balancing loss (0 for dense
     models); the trainer adds `moe_aux_weight * aux` to the objective."""
     if cfg.pp_axis is not None:
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "packed sequences are not threaded through the pipeline-"
-                "parallel forward yet; use pp_axis=None with segment_ids")
         from .pipeline_lm import pp_forward_with_aux
 
-        return pp_forward_with_aux(params, tokens, positions, cfg, mesh)
+        return pp_forward_with_aux(params, tokens, positions, cfg, mesh,
+                                   segment_ids=segment_ids)
     from jax.sharding import NamedSharding
 
     seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]

@@ -96,6 +96,34 @@ def test_batch_from_host_packed():
                                   [[6, 0, -1, 0, -1, 9, 10, -1]])
 
 
+def test_packed_pp_matches_no_pp():
+    """Packed segments through the pipeline-parallel forward: pp=2 loss on
+    a packed batch equals the plain GSPMD forward's loss."""
+    import dataclasses
+
+    from burst_attn_tpu.models.train import loss_fn
+    from burst_attn_tpu.models.pipeline_lm import stack_layers
+
+    base = ModelConfig(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4, d_head=16,
+        d_ff=128, block_q=8, block_kv=8, attn_backend="jnp", remat=False,
+        layout="zigzag", batch_axis=None, head_axis=None, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), base)
+    mesh = make_mesh({"pp": 2, "sp": 4})
+    cfg_pp = dataclasses.replace(base, pp_axis="pp", pp_microbatches=2)
+    params_pp = dict(params, layers=stack_layers(params["layers"]))
+
+    batch = make_packed_batch(jax.random.PRNGKey(3), base, mesh, batch=2,
+                              seq=64)
+    args = (batch["tokens"], batch["positions"], batch["labels"])
+    l0 = loss_fn(params, *args, base, mesh,
+                 segment_ids=batch["segment_ids"])
+    l1 = loss_fn(params_pp, *args, cfg_pp, mesh,
+                 segment_ids=batch["segment_ids"])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.parametrize("strategy,layout", [("burst", "zigzag"),
                                              ("ulysses", "contig")])
 def test_packed_train_step_runs(strategy, layout):
