@@ -38,9 +38,47 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from jax.sharding import PartitionSpec as P
+
 from .transformer import ModelConfig, _attn_out, _mlp, _qkv_proj, _rms_norm
 from .decode import _flash_prompt_attention, sample_logits
 from ..ops.paged_attention import paged_decode_attention
+
+
+def _paged_attention_dispatch(qg, kp, vp, table, lengths, cfg: ModelConfig,
+                              mesh):
+    """Route the paged kernel through a head-sharded shard_map when serving
+    tensor-parallel (mesh given and cfg.head_axis present): the pool's kv
+    heads split over tp, each shard walks its own pages — a Pallas call
+    cannot be partitioned by GSPMD, so the split must be explicit.  The
+    table/lengths ride in replicated.  Everything else in the step (qkv
+    projections, MLP, logits) stays GSPMD-sharded by the params' specs."""
+    if mesh is None or cfg.head_axis is None:
+        return paged_decode_attention(qg, kp, vp, table, lengths,
+                                      window=cfg.window)
+    if cfg.head_axis not in mesh.shape:
+        # loud, like pp_forward_with_aux: a silently-unsharded decode would
+        # replicate the full pools on every device
+        raise ValueError(
+            f"head_axis {cfg.head_axis!r} is not an axis of the mesh "
+            f"{dict(mesh.shape)}; pass mesh=None for single-device serving "
+            "or set cfg.head_axis to a mesh axis")
+    if mesh.shape[cfg.head_axis] == 1:
+        return paged_decode_attention(qg, kp, vp, table, lengths,
+                                      window=cfg.window)
+    if cfg.n_kv_heads % mesh.shape[cfg.head_axis]:
+        raise ValueError(
+            f"n_kv_heads {cfg.n_kv_heads} not divisible by "
+            f"{cfg.head_axis!r} mesh size {mesh.shape[cfg.head_axis]}")
+    spec4 = P(None, cfg.head_axis, None, None)
+    fn = jax.shard_map(
+        partial(paged_decode_attention, window=cfg.window),
+        mesh=mesh,
+        in_specs=(spec4, spec4, spec4, P(None, None), P(None)),
+        out_specs=spec4,
+        check_vma=False,
+    )
+    return fn(qg, kp, vp, table, lengths)
 
 
 class PagedState(NamedTuple):
@@ -179,14 +217,17 @@ def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
     return logits, PagedState(tuple(k_pools), tuple(v_pools), table, lengths)
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
-def paged_decode_step(params, tokens, state: PagedState, cfg: ModelConfig):
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
+def paged_decode_step(params, tokens, state: PagedState, cfg: ModelConfig,
+                      mesh=None):
     """One decode step for EVERY live slot (ragged batch).
 
     tokens: [slots] int32 — next input token per slot (ignored for empty
     slots).  Every live slot must have room for one more token in its last
     page... or its NEXT page already in the table row (see
     `ensure_capacity`).  Returns ([slots, vocab] fp32 logits, new state).
+    `mesh` + cfg.head_axis: tensor-parallel serving — the page pools split
+    over the head axis (see _paged_attention_dispatch).
     """
     slots = tokens.shape[0]
     page = state.k_pages[0].shape[2]
@@ -211,9 +252,9 @@ def paged_decode_step(params, tokens, state: PagedState, cfg: ModelConfig):
         kp = kp.at[page_id, :, offset].set(k[:, :, 0].astype(kp.dtype))
         vp = vp.at[page_id, :, offset].set(v[:, :, 0].astype(vp.dtype))
         qg = q.reshape(slots, cfg.n_kv_heads, group, cfg.d_head)
-        o = paged_decode_attention(qg, kp, vp, state.page_table,
-                                   state.lengths + live.astype(jnp.int32),
-                                   window=cfg.window)
+        o = _paged_attention_dispatch(
+            qg, kp, vp, state.page_table,
+            state.lengths + live.astype(jnp.int32), cfg, mesh)
         o = o.reshape(slots, cfg.n_heads, 1, cfg.d_head)
         x = x + _attn_out(p, o)
         m, _ = _mlp(p, x, cfg, inference=True)

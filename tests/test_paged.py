@@ -181,6 +181,35 @@ def test_continuous_batching_slots_are_independent(model):
     assert int(state.lengths[1]) == 0
 
 
+def test_paged_decode_tp_matches_single(model):
+    """Tensor-parallel serving: the head-sharded paged kernel (pools split
+    over tp inside a shard_map) reproduces the unsharded decode exactly."""
+    import dataclasses
+
+    from burst_attn_tpu.models.train import make_mesh
+
+    cfg, params = model
+    cfgt = dataclasses.replace(cfg, head_axis="tp")
+    mesh = make_mesh({"tp": 2})
+    t = 9
+    prompt = jax.random.randint(jax.random.PRNGKey(12), (t,), 0, cfg.vocab)
+
+    def run(mesh_arg, c):
+        state, pool = init_paged_state(c, slots=2, n_pages=8, page=128,
+                                       max_pages_per_seq=3)
+        lg, state = paged_prefill(params, prompt, state, pool, 0, c)
+        toks = [int(jnp.argmax(lg))]
+        blank = jnp.zeros((2,), jnp.int32)
+        for _ in range(3):
+            state = ensure_capacity(state, pool, 0)
+            lg, state = paged_decode_step(params, blank.at[0].set(toks[-1]),
+                                          state, c, mesh=mesh_arg)
+            toks.append(int(jnp.argmax(lg[0])))
+        return toks
+
+    assert run(None, cfg) == run(mesh, cfgt)
+
+
 def test_retire_returns_boundary_preacquired_page(model):
     """A page acquired by ensure_capacity at an exact page boundary is
     released when the slot retires before its next decode step."""
