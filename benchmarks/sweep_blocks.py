@@ -32,6 +32,11 @@ def main():
                    help="comma list of BQxBKV timed with the softmax chain "
                         "stripped (wrong numerics; measures the MXU/pipeline "
                         "ceiling to localize the fwd kernel's VPU cost)")
+    p.add_argument("--fwd-loop", default="",
+                   help="comma list of BQxBKVxBKC timed with the fori_loop "
+                        "sub-block sweep (loop_sweep=True): buffers reuse "
+                        "per iteration, probing whether the VMEM area cliff "
+                        "is unrolled-stage liveness")
     args = p.parse_args()
 
     import os
@@ -83,24 +88,37 @@ def main():
             record({"pass": "fwd", "bq": bq, "bkv": bkv, "bkc": bkc,
                     "error": f"{type(e).__name__}: {e}"[:200]})
 
-    for bq, bkv in parse(args.ablate_fwd):
+    def bench_flash_fwd(pass_name, cfgs, **fwd_kw):
+        """Shared scaffold for the raw-flash_fwd timing modes (loop /
+        ablation variants): one jit per BQxBKV[xBKC] config, rows appended
+        with the common shape fields."""
         from burst_attn_tpu.ops.masks import round_spec
         from burst_attn_tpu.ops.pallas_flash import flash_fwd
         from burst_attn_tpu.ops.tile import init_state
 
         spec = round_spec(jnp.int32(0), jnp.int32(0), seq, seq, True, "contig")
-        try:
-            f = jax.jit(lambda q, k, v, bq=bq, bkv=bkv, spec=spec: jnp.sum(
-                flash_fwd(q, k, v, *init_state(b, n, seq, d), d**-0.5, spec,
-                          block_q=bq, block_kv=bkv, triangular=True,
-                          _ablate="nosoftmax")[2]))
-            t = bench_fn(f, q, k, v)
-            record({"pass": "fwd-ablate-nosoftmax", "bq": bq, "bkv": bkv,
-                    "ms": round(t * 1e3, 2),
-                    "tflops": round(flops(b, seq, n, d, "fwd", True) / t / 1e12, 1)})
-        except Exception as e:  # noqa: BLE001
-            record({"pass": "fwd-ablate-nosoftmax", "bq": bq, "bkv": bkv,
-                    "error": f"{type(e).__name__}: {e}"[:200]})
+        for cfg in cfgs:
+            bq, bkv = cfg[0], cfg[1]
+            bkc = cfg[2] if len(cfg) > 2 else None
+            row = {"pass": pass_name, "bq": bq, "bkv": bkv, "bkc": bkc}
+            try:
+                f = jax.jit(lambda q, k, v, bq=bq, bkv=bkv, bkc=bkc:
+                            jnp.sum(flash_fwd(
+                                q, k, v, *init_state(b, n, seq, d), d**-0.5,
+                                spec, block_q=bq, block_kv=bkv,
+                                block_kv_compute=bkc, triangular=True,
+                                **fwd_kw)[2]))
+                t = bench_fn(f, q, k, v)
+                row.update(ms=round(t * 1e3, 2),
+                           tflops=round(flops(b, seq, n, d, "fwd", True)
+                                        / t / 1e12, 1))
+            except Exception as e:  # noqa: BLE001
+                row.update(error=f"{type(e).__name__}: {e}"[:200])
+            record(row)
+
+    bench_flash_fwd("fwd-loop", parse(args.fwd_loop), loop_sweep=True)
+    bench_flash_fwd("fwd-ablate-nosoftmax", parse(args.ablate_fwd),
+                    _ablate="nosoftmax")
 
     bwd_cfgs = [c for c in args.bwd.split(",") if c]
     if bwd_cfgs:

@@ -161,6 +161,12 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
     pass (flash attention + paged K/V scatter), rewrites the slot's table
     row.  Returns (last-token logits [vocab] fp32, new PagedState); the
     acquired page ids are recorded in the returned state's table.
+
+    Tensor-parallel note: only the DECODE step is head-sharded
+    (paged_decode_step(mesh=)); prefill runs single-device — its Pallas
+    flash call has no shard_map wrapper yet, so under a tp mesh the
+    prompt pass computes replicated.  Serving-side follow-up, not a
+    correctness limit.
     """
     t = int(tokens.shape[0])
     page = state.k_pages[0].shape[2]

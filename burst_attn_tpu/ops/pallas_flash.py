@@ -331,7 +331,7 @@ def _fwd_kernel(
     q_ref, k_ref, v_ref, m_in_ref, lse_in_ref, acc_in_ref,
     *rest,
     scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p, tri, wnd=None,
-    seg=False, emit_o=False, ablate=None,
+    seg=False, emit_o=False, loop=False, ablate=None,
 ):
     if seg:
         qseg_ref, kvseg_ref = rest[0], rest[1]
@@ -412,6 +412,70 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         )
 
+    def _sweep_loop(masked):
+        """lax.fori_loop variant of _sweep: the pend (alpha, p) rides the
+        loop CARRY instead of Python-unrolled values.
+
+        Why this exists: Mosaic allocates the unrolled pipeline's
+        intermediates SSA-style — every stage's [bq, bkc] f32 tiles stay
+        live for the whole body, so scoped-VMEM demand grows with
+        n_sub·bq·bkc = bq·bkv (the measured block-area cliff,
+        docs/design.md §3).  A fori_loop body reuses its buffers per
+        iteration, capping demand at ~2 stages independent of bkv — the
+        experiment that could admit bkv=4096 and halve the grid's step
+        count.  Selected by flash_fwd's loop_sweep flag."""
+        m0 = m_scr[:]
+        l0 = l_scr[:]
+        acc0 = acc_scr[:]
+        n_sub = bkv // bkv_compute
+
+        def mask_of(u):
+            if not masked:
+                return None
+            cols = (c0 + u * bkv_compute
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv_compute), 1))
+            rows = r0 + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bkv_compute), 0)
+            q_lo, q_hi, kv_hi = spec_ref[0], spec_ref[1], spec_ref[2]
+            causal, offset = spec_ref[3], spec_ref[4]
+            mk = (rows >= q_lo) & (rows < q_hi) & (cols < kv_hi)
+            mk = mk & ((causal == 0) | (cols <= rows + offset))
+            if wnd is not None:
+                mk = mk & (cols > rows + offset - wnd)
+            if seg:
+                ks_u = jax.lax.dynamic_slice(
+                    ks_tile, (0, u * bkv_compute), (1, bkv_compute))
+                mk = mk & (qs_tile == ks_u)
+            return mk
+
+        def step_body(u, carry):
+            m_c, l_c, acc_c, alpha_p, p_p = carry
+            cs = pl.ds(u * bkv_compute, bkv_compute)
+            s_u = jax.lax.dot_general(
+                q, k_ref[0, 0, cs, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # fold the carried pend FIRST: its pv matmul is independent of
+            # this iteration's VPU chain and queues right behind s_u
+            cs_prev = pl.ds((u - 1) * bkv_compute, bkv_compute)
+            acc_c = acc_c * alpha_p + jax.lax.dot_general(
+                p_p, v_ref[0, 0, cs_prev, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_c, l_c, alpha, p = _softmax(s_u, mask_of(u), m_c, l_c)
+            return m_c, l_c, acc_c, alpha, p
+
+        # iteration 0 outside the loop (no pend to fold yet)
+        m1, l1, alpha1, p1 = _softmax(_score(0), mask_of(0), m0, l0)
+        if n_sub > 1:
+            m1, l1, acc1, alpha_last, p_last = jax.lax.fori_loop(
+                1, n_sub, step_body, (m1, l1, acc0, alpha1, p1))
+            u_last = n_sub - 1
+        else:
+            acc1, alpha_last, p_last, u_last = acc0, alpha1, p1, 0
+        acc1 = acc1 * alpha_last + _pv(u_last, p_last)
+        m_scr[:], l_scr[:], acc_scr[:] = m1, l1, acc1
+
     def _sweep(masked):
         """Three-stage software pipeline over compute sub-blocks (splash-style
         bkv vs bkv_compute).  With in-order issue and async MXU execution, the
@@ -462,13 +526,15 @@ def _fwd_kernel(
         acc = acc * pend[1] + _pv(pend[0], pend[2])
         m_scr[:], l_scr[:], acc_scr[:] = m, l, acc
 
+    sweep = _sweep_loop if loop else _sweep
+
     @pl.when(fast_cond)
     def _compute_fast():
-        _sweep(False)
+        sweep(False)
 
     @pl.when(masked_cond)
     def _compute_masked():
-        _sweep(True)
+        sweep(True)
 
     @pl.when(is_fin)
     def _finish():
@@ -490,7 +556,7 @@ def _fwd_kernel(
 def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
               block_q=1024, block_kv=1024, block_kv_compute=None,
               interpret=None, cast_p=True, triangular=False, window=None,
-              segments=None, emit_o=False, _ablate=None):
+              segments=None, emit_o=False, loop_sweep=False, _ablate=None):
     """One online-softmax ring round on TPU.  Same contract as
     ops/tile.py:tile_fwd: returns updated (m, lse, acc).
 
@@ -513,6 +579,9 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     """
     if interpret is None:
         interpret = _interpret_default()
+    if _ablate is not None and loop_sweep:
+        raise ValueError("_ablate has no loop_sweep variant — the ablation "
+                         "would silently time the full softmax chain")
     b, n, s_q, d = q.shape
     n_kv, s_kv = k.shape[1], k.shape[2]
     group = _gqa_group(n, n_kv)
@@ -563,7 +632,8 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, bq=bq, bkv=bkv, bkv_compute=bkc, lp=lp,
         n_kv_blocks=nkb, cast_p=cast_p, tri=tri, wnd=window,
-        seg=segments is not None, emit_o=emit_o, ablate=_ablate,
+        seg=segments is not None, emit_o=emit_o, loop=loop_sweep,
+        ablate=_ablate,
     )
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     in_specs = [

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-2 cliff-mechanism experiment: does the fori_loop sub-block sweep
+# (buffer reuse per iteration) lift the bq*bkv VMEM area cliff?
+cd /root/repo || exit 1
+LOG=${TPU_WATCH6_LOG:-/root/repo/.tpu_watch6.log}
+exec >>"$LOG" 2>&1
+. /root/repo/scripts/tpu_lib.sh
+wait_for_phase "tpu_watch[5].sh" /root/repo/.tpu_watch5.log "ALL DONE"
+wait_for_tpu
+# control (loop at the default blocks) + the two cliff configs, tri grid
+run_stage loop-sweep 10800 python -m benchmarks.sweep_blocks \
+  --out /root/repo/sweep_loop.jsonl --fwd "" --bwd "" \
+  --fwd-loop "2048x2048x1024,2048x4096x1024,4096x4096x1024"
+echo "=== [$(date -u +%F' '%T)] WATCH6 ALL DONE ==="

@@ -206,6 +206,41 @@ def test_block_tuning_table():
     assert resolve_blocks(block_kv_compute=512).block_kv_compute == 512
 
 
+@pytest.mark.parametrize("causal,tri,window,segs",
+                         [(False, False, None, False),
+                          (True, False, None, False),
+                          (True, True, None, False),
+                          (True, False, 48, False),
+                          (True, True, None, True),
+                          (True, False, 48, True)])
+def test_loop_sweep_matches_unrolled(causal, tri, window, segs):
+    """The fori_loop sub-block sweep (loop_sweep=True — the VMEM-cliff
+    probe variant) is numerically identical to the unrolled pipeline,
+    including its independently-implemented window band and segment
+    terms in mask_of."""
+    from burst_attn_tpu.ops.masks import round_spec
+    from burst_attn_tpu.ops.tile import init_state
+
+    b, n, s, d = 1, 2, 128, 16
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(x, (b, n, s, d), jnp.float32) for x in ks)
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, causal, "contig")
+    st = init_state(b, n, s, d)
+    seg = None
+    if segs:
+        ids = jnp.concatenate([jnp.zeros((b, 50), jnp.int32),
+                               jnp.ones((b, s - 50), jnp.int32)], axis=1)
+        seg = (ids, ids)
+    kw = dict(block_q=32, block_kv=32, block_kv_compute=16, triangular=tri,
+              window=window, segments=seg)
+    base = pallas_flash.flash_fwd(q, k, v, *st, d**-0.5, spec, **kw)
+    got = pallas_flash.flash_fwd(q, k, v, *st, d**-0.5, spec,
+                                 loop_sweep=True, **kw)
+    for name, a, b_ in zip(("m", "lse", "acc"), base, got):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-6, atol=1e-6, err_msg=name)
+
+
 def test_cliff_clamp(monkeypatch):
     """Configs past the measured VMEM-cliff area are clamped (kv block
     shrunk at fixed bq); BURST_ALLOW_CLIFF=1 lets sweeps measure them."""
