@@ -45,6 +45,41 @@ from .decode import _flash_prompt_attention, sample_logits
 from ..ops.paged_attention import paged_decode_attention
 
 
+def _check_tp_mesh(cfg: ModelConfig, mesh):
+    """Shared head-axis validation for the tp serving paths; returns the
+    tp size (1 = run unsharded)."""
+    if mesh is None or cfg.head_axis is None:
+        return 1
+    if cfg.head_axis not in mesh.shape:
+        raise ValueError(
+            f"head_axis {cfg.head_axis!r} is not an axis of the mesh "
+            f"{dict(mesh.shape)}; pass mesh=None for single-device serving "
+            "or set cfg.head_axis to a mesh axis")
+    tp = mesh.shape[cfg.head_axis]
+    if tp > 1 and (cfg.n_kv_heads % tp or cfg.n_heads % tp):
+        raise ValueError(
+            f"n_heads {cfg.n_heads} / n_kv_heads {cfg.n_kv_heads} not "
+            f"divisible by {cfg.head_axis!r} mesh size {tp}")
+    return tp
+
+
+def _prompt_attention_dispatch(q, k, v, cfg: ModelConfig, mesh):
+    """Head-sharded prompt (prefill) attention under a tp mesh — same
+    rationale as _paged_attention_dispatch: the Pallas flash call must be
+    split explicitly."""
+    if _check_tp_mesh(cfg, mesh) == 1:
+        return _flash_prompt_attention(q, k, v, window=cfg.window)
+    spec = P(None, cfg.head_axis, None, None)
+    fn = jax.shard_map(
+        partial(_flash_prompt_attention, window=cfg.window),
+        mesh=mesh,
+        in_specs=(spec,) * 3,
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
 def _paged_attention_dispatch(qg, kp, vp, table, lengths, cfg: ModelConfig,
                               mesh):
     """Route the paged kernel through a head-sharded shard_map when serving
@@ -53,23 +88,9 @@ def _paged_attention_dispatch(qg, kp, vp, table, lengths, cfg: ModelConfig,
     cannot be partitioned by GSPMD, so the split must be explicit.  The
     table/lengths ride in replicated.  Everything else in the step (qkv
     projections, MLP, logits) stays GSPMD-sharded by the params' specs."""
-    if mesh is None or cfg.head_axis is None:
+    if _check_tp_mesh(cfg, mesh) == 1:
         return paged_decode_attention(qg, kp, vp, table, lengths,
                                       window=cfg.window)
-    if cfg.head_axis not in mesh.shape:
-        # loud, like pp_forward_with_aux: a silently-unsharded decode would
-        # replicate the full pools on every device
-        raise ValueError(
-            f"head_axis {cfg.head_axis!r} is not an axis of the mesh "
-            f"{dict(mesh.shape)}; pass mesh=None for single-device serving "
-            "or set cfg.head_axis to a mesh axis")
-    if mesh.shape[cfg.head_axis] == 1:
-        return paged_decode_attention(qg, kp, vp, table, lengths,
-                                      window=cfg.window)
-    if cfg.n_kv_heads % mesh.shape[cfg.head_axis]:
-        raise ValueError(
-            f"n_kv_heads {cfg.n_kv_heads} not divisible by "
-            f"{cfg.head_axis!r} mesh size {mesh.shape[cfg.head_axis]}")
     spec4 = P(None, cfg.head_axis, None, None)
     fn = jax.shard_map(
         partial(paged_decode_attention, window=cfg.window),
@@ -154,7 +175,7 @@ def _scatter_pages(pages, new, page_ids):
 
 
 def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
-                  slot: int, cfg: ModelConfig):
+                  slot: int, cfg: ModelConfig, mesh=None):
     """Absorb one prompt [T] into batch slot `slot`.
 
     Host-side wrapper: acquires ceil(T/page) pages, runs the jitted prompt
@@ -162,11 +183,10 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
     row.  Returns (last-token logits [vocab] fp32, new PagedState); the
     acquired page ids are recorded in the returned state's table.
 
-    Tensor-parallel note: only the DECODE step is head-sharded
-    (paged_decode_step(mesh=)); prefill runs single-device — its Pallas
-    flash call has no shard_map wrapper yet, so under a tp mesh the
-    prompt pass computes replicated.  Serving-side follow-up, not a
-    correctness limit.
+    Tensor-parallel: pass the same `mesh` as paged_decode_step — the
+    prompt's flash attention runs head-sharded through its own shard_map
+    (_prompt_attention_dispatch) and the pool scatter follows the pools'
+    sharding under GSPMD.
     """
     t = int(tokens.shape[0])
     page = state.k_pages[0].shape[2]
@@ -182,16 +202,16 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
     try:
         logits, state = _paged_prefill_jit(
             params, tokens[None, :], state, jnp.asarray(ids, jnp.int32),
-            jnp.int32(slot), cfg)
+            jnp.int32(slot), cfg, mesh)
     except Exception:
         pool.release(ids)
         raise
     return logits[0], state
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
 def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
-                       slot, cfg: ModelConfig):
+                       slot, cfg: ModelConfig, mesh=None):
     """slot is a TRACED int32 (one compile serves every slot); page_ids'
     static LENGTH keys the compile — one cache entry per prompt page count."""
     b, t = tokens.shape
@@ -202,8 +222,8 @@ def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
     k_pools, v_pools = [], []
     for p, kp, vp in zip(params["layers"], state.k_pages, state.v_pages):
         q, k, v = _qkv_proj(p, x, pos, cfg)
-        o = _flash_prompt_attention(q, k.astype(kp.dtype), v.astype(vp.dtype),
-                                    window=cfg.window)
+        o = _prompt_attention_dispatch(q, k.astype(kp.dtype),
+                                       v.astype(vp.dtype), cfg, mesh)
         pad = [(0, 0), (0, 0), (0, t_pad - t), (0, 0)]
         k_pools.append(_scatter_pages(kp, jnp.pad(k, pad), page_ids))
         v_pools.append(_scatter_pages(vp, jnp.pad(v, pad), page_ids))

@@ -197,7 +197,8 @@ def test_paged_decode_tp_matches_single(model):
     def run(mesh_arg, c):
         state, pool = init_paged_state(c, slots=2, n_pages=8, page=128,
                                        max_pages_per_seq=3)
-        lg, state = paged_prefill(params, prompt, state, pool, 0, c)
+        lg, state = paged_prefill(params, prompt, state, pool, 0, c,
+                                  mesh=mesh_arg)
         toks = [int(jnp.argmax(lg))]
         blank = jnp.zeros((2,), jnp.int32)
         for _ in range(3):
@@ -208,6 +209,10 @@ def test_paged_decode_tp_matches_single(model):
         return toks
 
     assert run(None, cfg) == run(mesh, cfgt)
+    # misconfigured mesh (axis name not in mesh) fails loudly
+    import dataclasses as _dc
+    with pytest.raises(ValueError, match="not an axis"):
+        run(mesh, _dc.replace(cfg, head_axis="model"))
 
 
 def test_retire_returns_boundary_preacquired_page(model):
