@@ -32,7 +32,7 @@ notes in ops/paged_attention.py.
 """
 
 from functools import partial
-from typing import List, NamedTuple, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +42,7 @@ from jax.sharding import PartitionSpec as P
 
 from .transformer import ModelConfig, _attn_out, _mlp, _qkv_proj, _rms_norm
 from .decode import _flash_prompt_attention, sample_logits
-from ..ops.paged_attention import paged_decode_attention
+from ..ops.paged_attention import paged_decode_attention, quantize_tokens
 
 
 def _check_tp_mesh(cfg: ModelConfig, mesh):
@@ -80,8 +80,8 @@ def _prompt_attention_dispatch(q, k, v, cfg: ModelConfig, mesh):
     return fn(q, k, v)
 
 
-def _paged_attention_dispatch(qg, kp, vp, table, lengths, cfg: ModelConfig,
-                              mesh):
+def _paged_attention_dispatch(qg, kp, vp, ks, vs, table, lengths,
+                              cfg: ModelConfig, mesh):
     """Route the paged kernel through a head-sharded shard_map when serving
     tensor-parallel (mesh given and cfg.head_axis present): the pool's kv
     heads split over tp, each shard walks its own pages — a Pallas call
@@ -90,24 +90,46 @@ def _paged_attention_dispatch(qg, kp, vp, table, lengths, cfg: ModelConfig,
     projections, MLP, logits) stays GSPMD-sharded by the params' specs."""
     if _check_tp_mesh(cfg, mesh) == 1:
         return paged_decode_attention(qg, kp, vp, table, lengths,
+                                      k_scales=ks, v_scales=vs,
                                       window=cfg.window)
     spec4 = P(None, cfg.head_axis, None, None)
+    spec3 = P(None, cfg.head_axis, None)
+    quant = ks is not None
+    in_specs = [spec4, spec4, spec4]
+    args = [qg, kp, vp]
+    if quant:
+        in_specs += [spec3, spec3]
+        args += [ks, vs]
+    in_specs += [P(None, None), P(None)]
+    args += [table, lengths]
+
+    def shard(qg, kp, vp, *rest):
+        if quant:
+            ks_l, vs_l, table_l, lengths_l = rest
+        else:
+            ks_l, vs_l = None, None
+            table_l, lengths_l = rest
+        return paged_decode_attention(qg, kp, vp, table_l, lengths_l,
+                                      k_scales=ks_l, v_scales=vs_l,
+                                      window=cfg.window)
+
     fn = jax.shard_map(
-        partial(paged_decode_attention, window=cfg.window),
-        mesh=mesh,
-        in_specs=(spec4, spec4, spec4, P(None, None), P(None)),
-        out_specs=spec4,
+        shard, mesh=mesh, in_specs=tuple(in_specs), out_specs=spec4,
         check_vma=False,
     )
-    return fn(qg, kp, vp, table, lengths)
+    return fn(*args)
 
 
 class PagedState(NamedTuple):
-    """Device-side paged cache (one pool per layer, table shared)."""
+    """Device-side paged cache (one pool per layer, table shared).
+    Quantized serving (init_paged_state(quantize=True)): pools are int8
+    with per-token dequant scales — half the bf16 pool memory."""
     k_pages: Tuple[jax.Array, ...]  # each [P, Nkv, page, D]
     v_pages: Tuple[jax.Array, ...]
     page_table: jax.Array           # [slots, max_pages_per_seq] int32
     lengths: jax.Array              # [slots] int32 (0 = empty slot)
+    k_scales: Optional[Tuple[jax.Array, ...]] = None  # each [P, Nkv, page]
+    v_scales: Optional[Tuple[jax.Array, ...]] = None
 
 
 class PagePool:
@@ -147,31 +169,45 @@ class PagePool:
 
 
 def init_paged_state(cfg: ModelConfig, *, slots: int, n_pages: int,
-                     page: int = 128, max_pages_per_seq: int = 64
-                     ) -> Tuple[PagedState, PagePool]:
+                     page: int = 128, max_pages_per_seq: int = 64,
+                     quantize: bool = False) -> Tuple[PagedState, PagePool]:
     """Fresh pool + allocator.  `page` must be a multiple of 128 (TPU lane
     tile); total pool capacity is n_pages * page tokens shared by all
-    slots."""
+    slots.  `quantize`: INT8 pools with per-token dequant scales."""
     if page % 128:
         raise ValueError(f"page size {page} must be a multiple of 128")
     shape = (n_pages, cfg.n_kv_heads, page, cfg.d_head)
-    k_pages = tuple(jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers))
-    v_pages = tuple(jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers))
+    dt = jnp.int8 if quantize else cfg.dtype
+    k_pages = tuple(jnp.zeros(shape, dt) for _ in range(cfg.n_layers))
+    v_pages = tuple(jnp.zeros(shape, dt) for _ in range(cfg.n_layers))
     table = jnp.zeros((slots, max_pages_per_seq), jnp.int32)
     lengths = jnp.zeros((slots,), jnp.int32)
-    return PagedState(k_pages, v_pages, table, lengths), PagePool(n_pages)
+    ks = vs = None
+    if quantize:
+        ks = tuple(jnp.ones(shape[:3], jnp.float32)
+                   for _ in range(cfg.n_layers))
+        vs = tuple(jnp.ones(shape[:3], jnp.float32)
+                   for _ in range(cfg.n_layers))
+    return (PagedState(k_pages, v_pages, table, lengths, ks, vs),
+            PagePool(n_pages))
 
 
-def _scatter_pages(pages, new, page_ids):
+def _scatter_pages(pages, new, page_ids, scales=None):
     """Write [1, Nkv, T, D] rope'd K/V into pool pages `page_ids` (device
-    scatter; T padded to a whole number of pages by the caller)."""
+    scatter; T padded to a whole number of pages by the caller).  With
+    int8 pools pass the matching `scales` array: the chunks quantize
+    per token and both arrays scatter; returns (pages, scales)."""
     page = pages.shape[2]
     n = new.shape[2] // page
     # [n, Nkv, page, D] chunks in page order
     chunks = jnp.moveaxis(new[0], 1, 0).reshape(n, page, new.shape[1],
                                                 new.shape[3])
     chunks = jnp.moveaxis(chunks, 2, 1)
-    return pages.at[page_ids].set(chunks.astype(pages.dtype))
+    if scales is None:
+        return pages.at[page_ids].set(chunks.astype(pages.dtype)), None
+    q8, s = quantize_tokens(chunks)
+    return (pages.at[page_ids].set(q8),
+            scales.at[page_ids].set(s))
 
 
 def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
@@ -219,14 +255,26 @@ def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
     t_pad = -(-t // page) * page
     pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
     x = params["embed"].astype(cfg.dtype)[tokens]
-    k_pools, v_pools = [], []
-    for p, kp, vp in zip(params["layers"], state.k_pages, state.v_pages):
+    quant = state.k_scales is not None
+    k_pools, v_pools, k_scs, v_scs = [], [], [], []
+    for li, (p, kp, vp) in enumerate(zip(params["layers"], state.k_pages,
+                                         state.v_pages)):
         q, k, v = _qkv_proj(p, x, pos, cfg)
-        o = _prompt_attention_dispatch(q, k.astype(kp.dtype),
-                                       v.astype(vp.dtype), cfg, mesh)
+        # attention consumes the full-precision K/V; only the POOL stores
+        # the (possibly int8-quantized) copies
+        o = _prompt_attention_dispatch(q, k.astype(cfg.dtype),
+                                       v.astype(cfg.dtype), cfg, mesh)
         pad = [(0, 0), (0, 0), (0, t_pad - t), (0, 0)]
-        k_pools.append(_scatter_pages(kp, jnp.pad(k, pad), page_ids))
-        v_pools.append(_scatter_pages(vp, jnp.pad(v, pad), page_ids))
+        kp2, ks2 = _scatter_pages(
+            kp, jnp.pad(k, pad), page_ids,
+            state.k_scales[li] if quant else None)
+        vp2, vs2 = _scatter_pages(
+            vp, jnp.pad(v, pad), page_ids,
+            state.v_scales[li] if quant else None)
+        k_pools.append(kp2)
+        v_pools.append(vp2)
+        k_scs.append(ks2)
+        v_scs.append(vs2)
         x = x + _attn_out(p, o)
         m, _ = _mlp(p, x, cfg, inference=True)
         x = x + m
@@ -240,7 +288,9 @@ def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
         (slot, jnp.int32(0)),
     )
     lengths = state.lengths.at[slot].set(t)
-    return logits, PagedState(tuple(k_pools), tuple(v_pools), table, lengths)
+    return logits, PagedState(
+        tuple(k_pools), tuple(v_pools), table, lengths,
+        tuple(k_scs) if quant else None, tuple(v_scs) if quant else None)
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
@@ -271,15 +321,27 @@ def paged_decode_step(params, tokens, state: PagedState, cfg: ModelConfig,
     # mandatory scatter never collides with a live page
     page_id = jnp.where(live, page_id, 0)
 
-    k_pools, v_pools = [], []
-    for p, kp, vp in zip(params["layers"], state.k_pages, state.v_pages):
+    quant = state.k_scales is not None
+    k_pools, v_pools, k_scs, v_scs = [], [], [], []
+    for li, (p, kp, vp) in enumerate(zip(params["layers"], state.k_pages,
+                                         state.v_pages)):
         q, k, v = _qkv_proj(p, x, pos[:, None], cfg)
         # append: scatter each slot's new K/V row into its page
-        kp = kp.at[page_id, :, offset].set(k[:, :, 0].astype(kp.dtype))
-        vp = vp.at[page_id, :, offset].set(v[:, :, 0].astype(vp.dtype))
+        k_row, v_row = k[:, :, 0], v[:, :, 0]
+        ks = vs = None
+        if quant:
+            k8, k_s = quantize_tokens(k_row)
+            v8, v_s = quantize_tokens(v_row)
+            kp = kp.at[page_id, :, offset].set(k8)
+            vp = vp.at[page_id, :, offset].set(v8)
+            ks = state.k_scales[li].at[page_id, :, offset].set(k_s)
+            vs = state.v_scales[li].at[page_id, :, offset].set(v_s)
+        else:
+            kp = kp.at[page_id, :, offset].set(k_row.astype(kp.dtype))
+            vp = vp.at[page_id, :, offset].set(v_row.astype(vp.dtype))
         qg = q.reshape(slots, cfg.n_kv_heads, group, cfg.d_head)
         o = _paged_attention_dispatch(
-            qg, kp, vp, state.page_table,
+            qg, kp, vp, ks, vs, state.page_table,
             state.lengths + live.astype(jnp.int32), cfg, mesh)
         o = o.reshape(slots, cfg.n_heads, 1, cfg.d_head)
         x = x + _attn_out(p, o)
@@ -287,12 +349,15 @@ def paged_decode_step(params, tokens, state: PagedState, cfg: ModelConfig,
         x = x + m
         k_pools.append(kp)
         v_pools.append(vp)
+        k_scs.append(ks)
+        v_scs.append(vs)
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)[:, 0]
     lengths = state.lengths + live.astype(jnp.int32)
-    return logits, PagedState(tuple(k_pools), tuple(v_pools),
-                              state.page_table, lengths)
+    return logits, PagedState(
+        tuple(k_pools), tuple(v_pools), state.page_table, lengths,
+        tuple(k_scs) if quant else None, tuple(v_scs) if quant else None)
 
 
 def ensure_capacity(state: PagedState, pool: PagePool, slot: int) -> PagedState:

@@ -54,11 +54,14 @@ def _pad_group(q):
 
 def _decode_kernel(
     table_ref, n_live_ref, len_ref, lo_ref,  # scalar prefetch
-    q_ref, k_ref, v_ref,
-    o_ref,
-    m_scr, l_scr, acc_scr,
-    *, scale, page, n_slots,
+    *refs,
+    scale, page, n_slots, quant,
 ):
+    if quant:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -73,10 +76,19 @@ def _decode_kernel(
     @pl.when(live)
     def _accum():
         q = q_ref[0, 0, :, :] * (scale * LOG2E)
+        k_tile = k_ref[0, :, :]
+        if quant:
+            k_tile = k_tile.astype(jnp.bfloat16)
         s = jax.lax.dot_general(
-            q, k_ref[0, :, :], (((1,), (1,)), ((), ())),
+            q, k_tile, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if quant:
+            # per-token dequant folds into a column rescale of the scores:
+            # q . (k_t * s_t) = (q . k_t) * s_t.  The matmuls run in bf16
+            # (int8 casts exactly — |v| <= 127); int8 buys MEMORY, not MXU
+            # throughput here.  One [G, page] multiply on the VPU.
+            s = s * ks_ref[:, :]  # [1, page] broadcast over [G, page]
         # mask the final partial page's tail and (sliding window) the
         # positions below the window's lower edge
         pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -89,10 +101,20 @@ def _decode_kernel(
         p = jnp.where(valid, p, 0.0)
         m_scr[:] = m_new
         l_scr[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, :, :], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if quant:
+            # symmetric trick on the v side: p @ (v_t * s_t) = (p * s_t) @ v_t
+            pv = jax.lax.dot_general(
+                (p * vs_ref[:, :]).astype(jnp.bfloat16),
+                v_ref[0, :, :].astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            pv = jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0, :, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        acc_scr[:] = acc_scr[:] * alpha + pv
 
     @pl.when(j == n_slots - 1)
     def _finish():
@@ -101,7 +123,19 @@ def _decode_kernel(
         o_ref[0, 0, :, :] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
+def quantize_tokens(x):
+    """Per-token symmetric int8 quantization of [..., T, D] K/V rows:
+    returns (int8 values, f32 scales [..., T]).  scale = max|x| / 127 per
+    token; zero rows get scale 1 (they dequantize to exact zeros)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q8 = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                  -127, 127).astype(jnp.int8)
+    return q8, s
+
+
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                           k_scales=None, v_scales=None,
                            window=None, scale=None, interpret=None):
     """One ragged decode step against a paged KV pool.
 
@@ -116,6 +150,10 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
                                 position lengths-1) sees only the last
                                 `window` positions — pages fully below the
                                 band are skipped, so cost ∝ window
+    k_scales / v_scales  [P, Nkv, page] f32: per-token dequant scales for
+                INT8 pools (quantize_tokens) — both or neither.  The
+                dequant rides the matmuls as column rescales; pool memory
+                halves vs bf16 (int8 + 4B scale per 128·2B token).
 
     Returns [B, Nkv, G, D] attention output in q's dtype.
     """
@@ -146,17 +184,29 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
         slot = jnp.clip(j, lo_[b_] // page, jnp.maximum(n_live_[b_] - 1, 0))
         return (table[b_, slot], h, 0, 0)
 
+    quant = k_scales is not None
+    if quant != (v_scales is not None):
+        raise ValueError("k_scales and v_scales must be given together")
     kernel = functools.partial(
-        _decode_kernel, scale=scale, page=page, n_slots=n_slots,
+        _decode_kernel, scale=scale, page=page, n_slots=n_slots, quant=quant,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, gp, d), q_map),
+        pl.BlockSpec((None, 1, page, d), kv_map),
+        pl.BlockSpec((None, 1, page, d), kv_map),
+    ]
+    inputs = [page_table, n_live, lengths, lo, q, k_pages, v_pages]
+    if quant:
+        def sc_map(b_, h, j, table, n_live_, len_, lo_):
+            return kv_map(b_, h, j, table, n_live_, len_, lo_)[:3]
+
+        in_specs.append(pl.BlockSpec((None, 1, page), sc_map))
+        in_specs.append(pl.BlockSpec((None, 1, page), sc_map))
+        inputs += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(b, n_kv, n_slots),
-        in_specs=[
-            pl.BlockSpec((1, 1, gp, d), q_map),
-            pl.BlockSpec((None, 1, page, d), kv_map),
-            pl.BlockSpec((None, 1, page, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, gp, d), q_map),
         scratch_shapes=[
             pltpu.VMEM((gp, 1), jnp.float32),
@@ -173,15 +223,19 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(page_table, n_live, lengths, lo, q, k_pages, v_pages)
+    )(*inputs)
     return o[:, :, :g, :]
 
 
 def paged_decode_reference(q, k_pages, v_pages, page_table, lengths,
-                           window=None, scale=None):
+                           window=None, scale=None,
+                           k_scales=None, v_scales=None):
     """jnp oracle for the kernel: gathers each sequence's pages into a
     contiguous cache and runs dense masked attention.  O(B·S·page) memory —
-    tests only."""
+    tests only.  int8 pools dequantize with the per-token scales first."""
+    if k_scales is not None:
+        k_pages = k_pages.astype(jnp.float32) * k_scales[..., None]
+        v_pages = v_pages.astype(jnp.float32) * v_scales[..., None]
     b, n_kv, g, d = q.shape
     page = k_pages.shape[2]
     n_slots = page_table.shape[1]
