@@ -88,7 +88,7 @@ def _decode_kernel(
             # q . (k_t * s_t) = (q . k_t) * s_t.  The matmuls run in bf16
             # (int8 casts exactly — |v| <= 127); int8 buys MEMORY, not MXU
             # throughput here.  One [G, page] multiply on the VPU.
-            s = s * ks_ref[:, :]  # [1, page] broadcast over [G, page]
+            s = s * ks_ref[0]  # [1, page] broadcast over [G, page]
         # mask the final partial page's tail and (sliding window) the
         # positions below the window's lower edge
         pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -104,7 +104,7 @@ def _decode_kernel(
         if quant:
             # symmetric trick on the v side: p @ (v_t * s_t) = (p * s_t) @ v_t
             pv = jax.lax.dot_general(
-                (p * vs_ref[:, :]).astype(jnp.bfloat16),
+                (p * vs_ref[0]).astype(jnp.bfloat16),
                 v_ref[0, :, :].astype(jnp.bfloat16),
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -198,11 +198,14 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
     inputs = [page_table, n_live, lengths, lo, q, k_pages, v_pages]
     if quant:
         def sc_map(b_, h, j, table, n_live_, len_, lo_):
-            return kv_map(b_, h, j, table, n_live_, len_, lo_)[:3]
+            return kv_map(b_, h, j, table, n_live_, len_, lo_)[:3] + (0,)
 
-        in_specs.append(pl.BlockSpec((None, 1, page), sc_map))
-        in_specs.append(pl.BlockSpec((None, 1, page), sc_map))
-        inputs += [k_scales, v_scales]
+        # scales reshape to [P, Nkv, 1, page] so the block's LAST TWO dims
+        # are (1, page) — legal Mosaic tiling for any Nkv (a [P, Nkv, page]
+        # block would put the size-1 block dim against Nkv)
+        in_specs.append(pl.BlockSpec((None, 1, 1, page), sc_map))
+        in_specs.append(pl.BlockSpec((None, 1, 1, page), sc_map))
+        inputs += [k_scales[:, :, None, :], v_scales[:, :, None, :]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(b, n_kv, n_slots),
