@@ -353,8 +353,10 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
             spec = round_spec(q_part, part_me, s, s, True, "striped")
             return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec,
                              triangular=True, segments=segs)
-        spec = round_spec(q_part, part_me, s, s, cfg.causal, cfg.layout,
-                          window=cfg.window)
+        # cross-attention (s_kv_local != s): the resident kv side's length
+        # comes from k, not from the rotating q payload
+        spec = round_spec(q_part, part_me, s, k.shape[2], cfg.causal,
+                          cfg.layout, window=cfg.window)
         return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec,
                          segments=segs)
 
@@ -414,12 +416,23 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
 def burst_attn_shard(q, k, v, cfg: BurstConfig, segment_ids=None):
     """Burst attention on per-shard arrays — call inside shard_map.
 
-    q: [B, N, S_local, D]; k, v: [B, Nk, S_local, D] (GQA when Nk < N).
+    q: [B, N, S_local, D]; k, v: [B, Nk, Skv_local, D] (GQA when Nk < N;
+    Skv_local != S_local is CROSS-attention — non-causal contig only).
     segment_ids: optional [B, S_local] int32 packed-sequence ids for the
     LOCAL shard, in the same layout order as q/k/v (use
     layouts.to_layout(ids, layout, world, axis=1) for zigzag/striped).
     Returns o: [B, N, S_local, D] in q.dtype.
     """
+    if q.shape[2] != k.shape[2] and (
+            cfg.causal or cfg.window is not None or segment_ids is not None):
+        # causal cross-lengths have no defined diagonal alignment (and the
+        # zigzag/striped bwd case splits assume equal shards); the single
+        # segment_ids array covers both sides only when lengths match.
+        # Fail here, loudly — the fwd would otherwise run and the bwd die
+        # inside a lax.cond with an opaque shape error.
+        raise ValueError(
+            f"cross-attention (s_q {q.shape[2]} != s_kv {k.shape[2]}) "
+            "supports non-causal contig without segment_ids only")
     if segment_ids is None:
         return _burst_attn_shard_plain(q, k, v, cfg)
     return _burst_attn_shard_seg(q, k, v, segment_ids, cfg)
