@@ -71,7 +71,8 @@ def make_mesh(spec: str):
     return Mesh(np.array(devs[:w]), ("sp",)), ("sp",)
 
 
-def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend):
+def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend,
+               fwd_only=False):
     from burst_attn_tpu import burst_attn
     from burst_attn_tpu.parallel import layouts
 
@@ -86,10 +87,14 @@ def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend)
         q = jax.random.normal(kq, (b, n, s, d), dtype)
         k = jax.random.normal(kk, (b, n_kv, s, d), dtype)
         v = jax.random.normal(kv, (b, n_kv, s, d), dtype)
-        do = jax.random.normal(kg, (b, n, s, d), dtype)
         fwd = jax.jit(
             lambda q, k, v: jnp.sum(
                 flash_attention(q, k, v, None, causal).astype(jnp.float32)))
+        if fwd_only:
+            # at the longest sequences the bwd residuals don't fit one chip;
+            # fwd-only still anchors the TFLOPs scaling curve (BASELINE.md)
+            return bench_fn(fwd, q, k, v), None, 1
+        do = jax.random.normal(kg, (b, n, s, d), dtype)
 
         # NB: big arrays (do) must be jit ARGUMENTS, not closures — a closed-
         # over array is embedded in the compile payload (multi-hundred-MB
@@ -110,7 +115,10 @@ def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend)
     q = jax.device_put(jax.random.normal(kq, (b, n, s, d), dtype), shard)
     k = jax.device_put(jax.random.normal(kk, (b, n_kv, s, d), dtype), shard)
     v = jax.device_put(jax.random.normal(kv, (b, n_kv, s, d), dtype), shard)
-    do = jax.device_put(jax.random.normal(kg, (b, n, s, d), dtype), shard)
+    # the gradient seed is only materialized when the bwd actually runs —
+    # fwd-only exists for configs where one more q-sized buffer OOMs
+    do = (None if fwd_only
+          else jax.device_put(jax.random.normal(kg, (b, n, s, d), dtype), shard))
 
     if method == "ring":
         from benchmarks.ring_baseline import ring_attention
@@ -120,6 +128,8 @@ def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend)
         fwd = jax.jit(
             lambda q, k, v: jnp.sum(
                 ring_attention(q, k, v, mesh=mesh, causal=causal).astype(jnp.float32)))
+        if fwd_only:
+            return bench_fn(fwd, q, k, v), None, w
 
         @jax.jit
         def fb(q, k, v, do):
@@ -135,6 +145,8 @@ def run_method(method, mesh, seq_axes, b, s, n, d, n_kv, causal, dtype, backend)
         backend=backend,
     )
     fwd = jax.jit(lambda q, k, v: jnp.sum(attn(q, k, v).astype(jnp.float32)))
+    if fwd_only:
+        return bench_fn(fwd, q, k, v), None, w
 
     @jax.jit
     def fb(q, k, v, do):
@@ -157,35 +169,42 @@ def main():
     ap.add_argument("--causal", action="store_true")
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--backend", default="auto")
-    ap.add_argument("--out", default="results.jsonl")
+    ap.add_argument("--fwd-only", action="store_true",
+                    help="skip the fwd+bwd timing (longest seqs OOM the bwd)")
+    ap.add_argument("--out", default="results/results.jsonl")
     args = ap.parse_args()
 
+    import os
+
+    if os.path.dirname(args.out):
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
     mesh, seq_axes = make_mesh(args.mesh)
     dtype = jnp.dtype(args.dtype)
     n_kv = args.kv_heads or args.heads
-    results = []
     for s in (int(x) for x in args.seqs.split(",")):
         for method in args.methods.split(","):
             t_f, t_fb, w = run_method(
                 method, mesh, seq_axes, args.batch, s, args.heads, args.dim,
                 n_kv, args.causal, dtype, args.backend,
+                fwd_only=args.fwd_only,
             )
             rec = {
                 "method": method, "seq": s, "batch": args.batch,
                 "heads": args.heads, "kv_heads": n_kv, "dim": args.dim,
                 "causal": args.causal, "dtype": str(dtype), "world": w,
                 "fwd_ms": round(t_f * 1e3, 3),
-                "fwd_bwd_ms": round(t_fb * 1e3, 3),
                 "fwd_tflops_per_chip": round(
                     efficiency(flops(args.batch, s, args.heads, args.dim, "fwd", args.causal), t_f) / w, 2),
-                "fwd_bwd_tflops_per_chip": round(
-                    efficiency(flops(args.batch, s, args.heads, args.dim, "fwd_bwd", args.causal), t_fb) / w, 2),
             }
+            if t_fb is not None:
+                rec["fwd_bwd_ms"] = round(t_fb * 1e3, 3)
+                rec["fwd_bwd_tflops_per_chip"] = round(
+                    efficiency(flops(args.batch, s, args.heads, args.dim, "fwd_bwd", args.causal), t_fb) / w, 2)
             print(json.dumps(rec))
-            results.append(rec)
-    with open(args.out, "a") as f:
-        for rec in results:
-            f.write(json.dumps(rec) + "\n")
+            # append per record: an interrupted multi-config run (tunnel
+            # drop mid-sweep) keeps what it measured
+            with open(args.out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
 
 
 if __name__ == "__main__":

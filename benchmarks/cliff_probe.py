@@ -49,7 +49,7 @@ def main(argv=None):
     ap.add_argument("--dim", type=int, default=128)
     ap.add_argument("--trace-root", default=None,
                     help="capture one XLA trace per config under this dir")
-    ap.add_argument("--out", default="cliff_probe.jsonl")
+    ap.add_argument("--out", default="results/cliff_probe.jsonl")
     args = ap.parse_args(argv)
 
     import jax

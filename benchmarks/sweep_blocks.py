@@ -19,7 +19,7 @@ def main():
     p.add_argument("--heads", type=int, default=32)
     p.add_argument("--kv-heads", type=int, default=None)
     p.add_argument("--dim", type=int, default=128)
-    p.add_argument("--out", default="sweep_blocks.jsonl")
+    p.add_argument("--out", default="results/sweep_blocks.jsonl")
     p.add_argument("--fwd", default="2048x2048,2048x4096,1024x4096",
                    help="comma list of BQxBKV (fwd), empty to skip")
     p.add_argument("--bwd", default="1024x2048,1024x4096,2048x2048,512x4096",

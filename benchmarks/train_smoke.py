@@ -11,7 +11,7 @@ MFU convention: model FLOPs/token = 6 * n_params  (fwd+bwd dense matmuls)
               bf16 TFLOPs (v5e: 197).
 
     python -m benchmarks.train_smoke --steps 8 --seq 32768 \
-        --trace-dir /root/repo/trace_smoke
+        --trace-dir /root/repo/results/trace_smoke
 """
 
 import argparse
@@ -54,7 +54,7 @@ def main(argv=None):
     ap.add_argument("--trace-dir", default=None,
                     help="capture an XLA profile of the traced steps here")
     ap.add_argument("--trace-steps", type=int, default=2)
-    ap.add_argument("--out", default="results_smoke.jsonl")
+    ap.add_argument("--out", default="results/results_smoke.jsonl")
     args = ap.parse_args(argv)
 
     import jax

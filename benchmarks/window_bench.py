@@ -20,7 +20,7 @@ def main(argv=None):
     ap.add_argument("--dim", type=int, default=128)
     ap.add_argument("--windows", default="65536,16384,4096",
                     help="comma list; 'none' = plain causal (tri grid)")
-    ap.add_argument("--out", default="results_window.jsonl")
+    ap.add_argument("--out", default="results/results_window.jsonl")
     args = ap.parse_args(argv)
 
     import jax

@@ -5,7 +5,7 @@ from .decode import Cache, forward_cached, generate, init_cache, prefill, sample
 from .dist_decode import DistCache, dist_generate, dist_prefill
 from .paged_decode import (
     PagePool, PagedState, ensure_capacity, init_paged_state,
-    paged_decode_step, paged_prefill, retire_slot,
+    paged_decode_step, paged_prefill, provision_capacity, retire_slot,
 )
 from .pipeline_lm import stack_layers, unstack_layers
 
@@ -38,5 +38,6 @@ __all__ = [
     "init_paged_state",
     "paged_decode_step",
     "paged_prefill",
+    "provision_capacity",
     "retire_slot",
 ]

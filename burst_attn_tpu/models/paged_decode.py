@@ -36,6 +36,7 @@ from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from jax.sharding import PartitionSpec as P
@@ -149,6 +150,7 @@ class PagePool:
         # own keeps live pages clobber-free without per-slot predication.
         self.n_pages = n_pages
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        self._free_set = set(self._free)
 
     @property
     def available(self) -> int:
@@ -159,13 +161,22 @@ class PagePool:
             raise RuntimeError(
                 f"page pool exhausted: want {n}, have {len(self._free)}")
         out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
         return out
 
     def release(self, ids) -> None:
+        # a double release would put the page on the free list twice and
+        # later hand it to two live sequences — corrupt both, silently
+        ids = [int(i) for i in ids]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate page ids in release: {ids}")
         for i in ids:
             if not 0 < i < self.n_pages:  # page 0 is the reserved sink
                 raise ValueError(f"bad page id {i}")
-            self._free.append(int(i))
+            if i in self._free_set:
+                raise ValueError(f"page {i} released while already free")
+        self._free.extend(ids)
+        self._free_set.update(ids)
 
 
 def init_paged_state(cfg: ModelConfig, *, slots: int, n_pages: int,
@@ -245,6 +256,13 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
     return logits[0], state
 
 
+# `state` is donated: serving deployments size the pools to fill HBM, so
+# prefill must alias them in place — without donation every admission
+# transiently needs 2x pool memory (old + new pools per layer) and a pool
+# that fits would OOM on the first prompt.  The cost: if the jit fails at
+# RUNTIME (post-donation), the caller's state is consumed and the
+# release-and-reraise in paged_prefill only restores pool bookkeeping, not
+# the state — trace/shape errors (pre-execution) leave it retryable.
 @partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
 def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
                        slot, cfg: ModelConfig, mesh=None):
@@ -319,6 +337,11 @@ def paged_decode_step(params, tokens, state: PagedState, cfg: ModelConfig,
                                   axis=1)[:, 0]
     # dead slots write into the reserved sink page 0 (see PagePool) so their
     # mandatory scatter never collides with a live page
+    # a LIVE slot mapping to page 0 means the caller skipped ensure_capacity
+    # at an exact page boundary: the new token would scatter into the sink
+    # and attention would read sink garbage — per-sequence silent corruption.
+    # A jitted fn can't raise, so poison that slot's logits with NaN below.
+    boundary_unassigned = live & (page_id == 0)
     page_id = jnp.where(live, page_id, 0)
 
     quant = state.k_scales is not None
@@ -354,6 +377,7 @@ def paged_decode_step(params, tokens, state: PagedState, cfg: ModelConfig,
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)[:, 0]
+    logits = jnp.where(boundary_unassigned[:, None], jnp.nan, logits)
     lengths = state.lengths + live.astype(jnp.int32)
     return logits, PagedState(
         tuple(k_pools), tuple(v_pools), state.page_table, lengths,
@@ -379,19 +403,49 @@ def ensure_capacity(state: PagedState, pool: PagePool, slot: int) -> PagedState:
     return state._replace(page_table=table)
 
 
+def provision_capacity(state: PagedState, pool: PagePool, slot: int,
+                       n_tokens: int) -> PagedState:
+    """Host-side: pre-assign every page `slot` needs to absorb `n_tokens`
+    MORE tokens, so a decode loop of that many steps needs no further
+    host-side allocation (one host fetch here vs one `ensure_capacity`
+    length sync per slot per step in the hot loop)."""
+    if n_tokens <= 0:
+        return state
+    length = int(state.lengths[slot])
+    if length == 0:
+        raise RuntimeError(
+            f"slot {slot} is empty; paged_prefill acquires its own pages — "
+            "provisioning now would leak them when prefill rewrites the row")
+    page = state.k_pages[0].shape[2]
+    last = length + n_tokens - 1  # final position to be written
+    need_through = last // page   # highest table column required
+    if need_through >= state.page_table.shape[1]:
+        raise RuntimeError(
+            f"slot {slot}: {n_tokens} more tokens need table column "
+            f"{need_through} >= max_pages_per_seq {state.page_table.shape[1]}")
+    row = np.asarray(state.page_table[slot])  # one fetch for all columns
+    missing = [p for p in range(need_through + 1) if row[p] == 0]
+    if not missing:
+        return state
+    ids = pool.acquire(len(missing))
+    table = state.page_table.at[slot, np.asarray(missing)].set(
+        np.asarray(ids, dtype=np.int32))
+    return state._replace(page_table=table)
+
+
 def retire_slot(state: PagedState, pool: PagePool, slot: int) -> PagedState:
     """Host-side: release a finished sequence's pages and empty the slot."""
     length = int(state.lengths[slot])
     if length == 0:
         return state
-    page = state.k_pages[0].shape[2]
-    n_used = -(-length // page)
-    ids = [int(i) for i in state.page_table[slot, :n_used]]
-    # a page pre-acquired by ensure_capacity at an exact page boundary (the
-    # slot retired before its next decode step) sits at column n_used —
-    # page 0 is the unassigned sentinel, so non-zero there means acquired
-    if (n_used < state.page_table.shape[1]
-            and int(state.page_table[slot, n_used]) != 0):
-        ids.append(int(state.page_table[slot, n_used]))
+    # release EVERY assigned page in the row, used or pre-acquired
+    # (ensure_capacity adds one ahead; provision_capacity may add many) —
+    # page 0 is the unassigned sentinel, so non-zero means acquired.
+    # Zero the row so a later ensure/provision on the re-prefilled slot
+    # can't mistake stale ids for assignments.
+    row = np.asarray(state.page_table[slot])
+    ids = [int(i) for i in row if i != 0]
     pool.release(ids)
-    return state._replace(lengths=state.lengths.at[slot].set(0))
+    return state._replace(
+        lengths=state.lengths.at[slot].set(0),
+        page_table=state.page_table.at[slot].set(0))

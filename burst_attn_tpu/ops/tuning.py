@@ -162,7 +162,7 @@ def _clamp_cliff(bq: int, bkv: int, area: int, which: str):
     new_bkv = max(area // bq, 128)
     logger.warning(
         "%s blocks %dx%d exceed the measured VMEM-cliff area (%d); clamping "
-        "kv block to %d (see cliff_probe.jsonl; BURST_ALLOW_CLIFF=1 to "
+        "kv block to %d (see results/cliff_probe.jsonl; BURST_ALLOW_CLIFF=1 to "
         "measure cliff configs anyway)", which, bq, bkv, area, new_bkv)
     return bq, new_bkv
 

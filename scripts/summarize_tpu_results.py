@@ -1,10 +1,11 @@
 """Summarize the TPU watcher artifacts into README-ready tables.
 
-Reads (whichever exist): .bench_r2.json, sweep_r2.jsonl,
+Reads (whichever exist): results/{headline.json, sweep_r2.jsonl,
 results_scaling.jsonl, results_smoke.jsonl, cliff_probe.jsonl,
-results_window.jsonl — and prints the measured numbers in the reference
-README's table format, plus the tuning-table row the sweep implies.  Run
-after scripts/tpu_watch{,2,3}.sh finish.
+results_window.jsonl, sweep_loop.jsonl, serve.jsonl, scaling_long.jsonl}
+— and prints the measured numbers in the reference README's table format,
+plus the tuning-table row the sweep implies.  Run after scripts/tpu_run.sh
+finishes.
 """
 
 import json
@@ -29,32 +30,60 @@ def _rows(path):
     return out
 
 
+def _obj(path):
+    # single (possibly indented) JSON object, e.g. results/headline.json
+    p = os.path.join(ROOT, path)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def main():
-    bench = _rows(".bench_r2.json")
-    if bench:
-        b = bench[-1]
+    b = _obj("results/headline.json")
+    bench = [b] if b else []
+    if b:
         print(f"HEADLINE: {b.get('metric')}: {b.get('value')} "
-              f"{b.get('unit')}  (vs_baseline {b.get('vs_baseline')})")
+              f"{b.get('unit')}  (vs_baseline {b.get('vs_baseline')}, "
+              f"commit {b.get('commit')}, {b.get('timestamp_utc')})")
         if b.get("tri_fallback"):
             print("  !! tri_fallback set — triangular kernels failed on-chip")
 
-    sweep = _rows("sweep_r2.jsonl")
+    sweep = _rows("results/sweep_r2.jsonl") + _rows("results/sweep_loop.jsonl")
     if sweep:
         print("\nSWEEP (per config):")
         for r in sweep:
             print("  ", json.dumps(r))
 
-    scaling = _rows("results_scaling.jsonl")
+    scaling = (_rows("results/results_scaling.jsonl")
+               + _rows("results/scaling_long.jsonl"))
     if scaling:
         print("\nSCALING TABLE (reference README format, single-chip flash):")
         print("| Seq | Batch | fwd ms | fwd+bwd ms | fwd TFLOPs/s | fwd+bwd TFLOPs/s |")
         print("|---:|---:|---:|---:|---:|---:|")
         for r in scaling:
             print(f"| {r['seq']:,} | {r['batch']} | {r['fwd_ms']} | "
-                  f"{r['fwd_bwd_ms']} | {r['fwd_tflops_per_chip']} | "
-                  f"{r['fwd_bwd_tflops_per_chip']} |")
+                  f"{r.get('fwd_bwd_ms', '—')} | {r['fwd_tflops_per_chip']} | "
+                  f"{r.get('fwd_bwd_tflops_per_chip', '—')} |")
 
-    smoke = _rows("results_smoke.jsonl")
+    serve = _rows("results/serve.jsonl")
+    if serve:
+        print("\nSERVING (paged continuous batching):")
+        for r in serve:
+            if r.get("phase") == "decode":
+                print(f"  slots={r['slots']} ctx={r['context']}"
+                      f"{' int8' if r.get('quantize') else ' bf16'}: "
+                      f"{r['step_ms']} ms/step, {r['tokens_per_s']} tok/s")
+            elif r.get("phase") == "prefill":
+                print(f"  prefill ctx={r['context']}"
+                      f"{' int8' if r.get('quantize') else ' bf16'}: "
+                      f"{r['ms_per_prompt']} ms/prompt "
+                      f"({r['prefill_tokens_per_s']} tok/s)")
+
+    smoke = _rows("results/results_smoke.jsonl")
     if smoke:
         r = smoke[-1]
         n_params = r.get("params")
@@ -65,7 +94,7 @@ def main():
               f"{', EXTRAPOLATED PEAK' if r.get('peak_extrapolated') else ''})"
               f"; trace: {r.get('trace_dir')}")
 
-    cliff = _rows("cliff_probe.jsonl")
+    cliff = _rows("results/cliff_probe.jsonl")
     if cliff:
         print("\nCLIFF PROBE (rect grids, BURST_NO_TRI):")
         for r in cliff:
@@ -77,14 +106,14 @@ def main():
                       f"bkc{r['block_kv_compute']}: {r['fwd_tflops']} TFLOPs/s "
                       f"({r['fwd_ms']} ms)")
 
-    window = _rows("results_window.jsonl")
+    window = _rows("results/results_window.jsonl")
     if window:
         print("\nWINDOW SCALING (fwd, fixed seq):")
         for r in window:
             print(f"  window={r.get('window')}: {r.get('fwd_ms')} ms "
                   f"({r.get('band_tflops')} band-TFLOPs/s)")
 
-    if not any((bench, sweep, scaling, smoke, cliff, window)):
+    if not any((bench, sweep, scaling, serve, smoke, cliff, window)):
         print("no TPU artifacts found yet — watchers still waiting?")
 
 

@@ -1,0 +1,58 @@
+#!/bin/bash
+# THE TPU watcher: waits for the axon tunnel, then runs named stages in
+# order.  Replaces the round-2 tpu_watch{,2..6}.sh one-offs.
+#
+#   scripts/tpu_run.sh [stage ...]        # default: the current round queue
+#   TPU_RUN_LOG=... scripts/tpu_run.sh    # log elsewhere (default results/tpu_run.log)
+#
+# One TPU python at a time (the chip is exclusive through the tunnel):
+# stages run strictly sequentially, each with wait-for-tunnel + 3 retries
+# (run_stage re-probes between attempts, surviving mid-stage tunnel drops).
+cd /root/repo || exit 1
+LOG=${TPU_RUN_LOG:-/root/repo/results/tpu_run.log}
+mkdir -p /root/repo/results
+exec >>"$LOG" 2>&1
+. /root/repo/scripts/tpu_lib.sh
+
+stage_head_tests() {  # on-chip validation of the HEAD kernels
+  run_stage head-tests 7200 env BURST_TESTS_TPU=1 \
+    python -m pytest tests/test_fused_bwd.py tests/test_pallas.py -q
+}
+
+stage_loop_sweep() {  # fori_loop cliff-break experiment (VERDICT r2 #1)
+  run_stage loop-sweep 10800 python -m benchmarks.sweep_blocks \
+    --fwd "" --bwd "" \
+    --fwd-loop "2048x2048x1024,2048x4096x1024,4096x4096x1024,4096x4096x2048" \
+    --out /root/repo/results/sweep_loop.jsonl
+}
+
+stage_bench() {  # driver headline metric (also refreshes results/headline.json)
+  run_stage bench 3600 python bench.py
+}
+
+stage_serve_bf16() {  # first hardware serving number
+  run_stage serve-bf16 7200 python -m benchmarks.serve_bench \
+    --out /root/repo/results/serve.jsonl
+}
+
+stage_serve_int8() {  # first hardware execution of the int8 paged kernel
+  run_stage serve-int8 7200 python -m benchmarks.serve_bench --quantize \
+    --out /root/repo/results/serve.jsonl
+}
+
+stage_seq256k() {  # 256K evidence point, fwd-only (bwd residuals OOM one chip)
+  run_stage seq256k 7200 python -m benchmarks.benchmark \
+    --methods flash --seqs 262144 --causal --mesh 1 --fwd-only \
+    --out /root/repo/results/scaling_long.jsonl
+}
+
+DEFAULT_STAGES="head_tests loop_sweep bench serve_bf16 serve_int8 seq256k"
+STAGES=${*:-$DEFAULT_STAGES}
+
+echo "=== [$(date -u +%F' '%T)] tpu_run: queue = $STAGES ==="
+for s in $STAGES; do
+  wait_for_tpu
+  "stage_$s" || echo "=== stage $s FAILED after retries; continuing ==="
+  sleep 15
+done
+echo "=== [$(date -u +%F' '%T)] ALL DONE ==="

@@ -10,7 +10,7 @@ from burst_attn_tpu.models import ModelConfig, init_params
 from burst_attn_tpu.models.decode import generate
 from burst_attn_tpu.models.paged_decode import (
     PagePool, ensure_capacity, init_paged_state, paged_decode_step,
-    paged_prefill, retire_slot,
+    paged_prefill, provision_capacity, retire_slot,
 )
 from burst_attn_tpu.ops.paged_attention import (
     paged_decode_attention, paged_decode_reference,
@@ -162,6 +162,19 @@ def test_page_pool_accounting():
         pool.release([0])
 
 
+def test_page_pool_double_release_raises():
+    """A double release would hand the same page to two live sequences."""
+    pool = PagePool(8)
+    got = pool.acquire(2)
+    pool.release(got[:1])
+    with pytest.raises(ValueError, match="already free"):
+        pool.release(got[:1])
+    # the failed call must not have corrupted the free list
+    assert pool.available == 6
+    pool.release(got[1:])
+    assert pool.available == 7
+
+
 @pytest.fixture(scope="module")
 def model():
     cfg = ModelConfig(
@@ -283,3 +296,49 @@ def test_retire_returns_boundary_preacquired_page(model):
     assert pool.available == before - 1
     state = retire_slot(state, pool, 0)
     assert pool.available == before + 1       # prompt page AND pre-acquired
+
+
+def test_provision_capacity_covers_decode_run(model):
+    """provision_capacity pre-assigns every page a decode loop will touch,
+    the loop then needs no per-step host allocation, and retire returns
+    every page — used and pre-acquired alike."""
+    cfg, params = model
+    state, pool = init_paged_state(cfg, slots=1, n_pages=8, page=128,
+                                   max_pages_per_seq=4)
+    full = pool.available
+    prompt = jax.random.randint(jax.random.PRNGKey(7), (120,), 0, cfg.vocab)
+    _, state = paged_prefill(params, prompt, state, pool, 0, cfg)
+    # 120 + 300 tokens spans table columns 0..3 -> 3 more pages
+    state = provision_capacity(state, pool, 0, 300)
+    assert pool.available == full - 4
+    state = provision_capacity(state, pool, 0, 300)  # idempotent
+    assert pool.available == full - 4
+
+    # cross the 128 boundary with NO ensure_capacity in the loop
+    tok = jnp.zeros((1,), jnp.int32)
+    for _ in range(10):
+        lg, state = paged_decode_step(params, tok, state, cfg)
+        assert not np.isnan(np.asarray(lg)).any()
+    assert int(state.lengths[0]) == 130
+
+    state = retire_slot(state, pool, 0)
+    assert pool.available == full
+    assert np.all(np.asarray(state.page_table[0]) == 0)
+
+    with pytest.raises(RuntimeError, match="empty"):
+        provision_capacity(state, pool, 0, 1)
+
+
+def test_skipped_ensure_capacity_poisons_logits(model):
+    """A live slot at an exact page boundary whose next page was never
+    assigned must fail LOUDLY (NaN logits), not scatter into the sink page
+    and silently corrupt the sequence."""
+    cfg, params = model
+    state, pool = init_paged_state(cfg, slots=2, n_pages=8, page=128,
+                                   max_pages_per_seq=3)
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (128,), 0, cfg.vocab)
+    _, state = paged_prefill(params, prompt, state, pool, 0, cfg)
+    # no ensure_capacity: slot 0 is live at the boundary, column 1 unassigned
+    lg, _ = paged_decode_step(params, jnp.zeros((2,), jnp.int32), state, cfg)
+    assert np.isnan(np.asarray(lg[0])).all()      # misused slot: loud
+    assert not np.isnan(np.asarray(lg[1])).any()  # empty slot: unaffected
