@@ -1,0 +1,122 @@
+"""Batch-scaling probe (round-2 verdict item 3): same-FLOPs configs lose
+~25% per-chip throughput as batch count rises (results/results_scaling.jsonl:
+fwd 158.4 @ b=1/64K -> 117.0 @ b=4/32K; the reference instead RISES with
+batch, reference README.md:100-103).
+
+Per-step arithmetic from round 2: 13.1us (b=1, 64K) -> 14.1 (b=2, 32K) ->
+17.3 (b=4, 32K) with IDENTICAL 2048x2048 blocks — per-step cost grows with
+batch count / shrinking per-entry rows.  Candidate causes this probe
+separates:
+
+  * batch-count term: b=1 vs b=2 vs b=4 at FIXED seq=32K (same per-entry
+    grid, same per-step work; flat TFLOPs/s here acquits the batch dim)
+  * row-length term: the tri grid's init/finalize steps (_read_rows /
+    _write_rows state repacking) are a 4/(nqb+1) fraction of all steps —
+    nqb=16 at 32K pays 23.5%, nqb=32 at 64K pays 12%
+  * grid-geometry term: tri vs rect (BURST_NO_TRI) at the same configs
+    (the rect grid has uniform init/fin density by construction)
+  * block-size term: bq=1024 at 32K restores nqb=32 (the 64K init/fin
+    density) at 4x the step count
+
+Writes one jsonl row per config to --out; run on a real chip:
+
+    python -m benchmarks.batch_probe --out results/batch_probe.jsonl
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--heads", type=int, default=32)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--out", default="results/batch_probe.jsonl")
+    ap.add_argument("--trace-dir", default="",
+                    help="capture an XLA trace of the worst config")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.benchmark import bench_fn, flops
+
+    if jax.default_backend() != "tpu":
+        print("batch_probe: not on TPU; refusing to record numbers",
+              file=sys.stderr)
+        sys.exit(1)
+
+    from burst_attn_tpu.ops.pallas_flash import flash_attention
+
+    n, d = args.heads, args.dim
+    if os.path.dirname(args.out):
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+
+    def record(row):
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        print(json.dumps(row), flush=True)
+
+    # (batch, seq, block_q, no_tri)
+    cases = [
+        (1, 65536, None, False),   # round-2 anchor: 158.4
+        (1, 32768, None, False),   # NEW: batch-free seq term
+        (2, 32768, None, False),   # round-2: 143.1
+        (4, 32768, None, False),   # round-2: 117.0
+        (4, 32768, None, True),    # rect grid: uniform init/fin density
+        (1, 32768, 1024, False),   # nqb=32 at 32K: 64K's init/fin fraction
+        (4, 32768, 1024, False),
+        (8, 16384, None, False),   # extreme: nqb=8, 4/9 steps init/fin
+    ]
+    for b, s, bq, no_tri in cases:
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, n, s, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, n, s, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, n, s, d), jnp.bfloat16)
+        if no_tri:
+            os.environ["BURST_NO_TRI"] = "1"
+        try:
+            f = jax.jit(lambda q, k, v, bq=bq: jnp.sum(
+                flash_attention(q, k, v, None, True, bq, bq)
+                .astype(jnp.float32)))
+            t = bench_fn(f, q, k, v)
+            fl = flops(b, s, n, d, "fwd", True)
+            bq_eff = bq or 2048
+            # tri-grid step count: b*n * (nqb/2) * (nqb+1)
+            nqb = s // bq_eff
+            steps = b * n * (nqb // 2) * (nqb + 1) if not no_tri else (
+                b * n * nqb * nqb)
+            record({"batch": b, "seq": s, "block_q": bq_eff,
+                    "grid": "rect" if no_tri else "tri",
+                    "ms": round(t * 1e3, 2),
+                    "tflops": round(fl / t / 1e12, 1),
+                    "us_per_step": round(t * 1e6 / steps, 2),
+                    "initfin_frac": round(4 / (nqb + 1), 3)})
+        except Exception as e:  # noqa: BLE001 — record and continue
+            record({"batch": b, "seq": s, "block_q": bq or 2048,
+                    "grid": "rect" if no_tri else "tri",
+                    "error": f"{type(e).__name__}: {e}"[:200]})
+        finally:
+            if no_tri:
+                os.environ.pop("BURST_NO_TRI", None)
+
+    if args.trace_dir:
+        b, s = 4, 32768
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, n, s, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, n, s, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, n, s, d), jnp.bfloat16)
+        f = jax.jit(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, None, True).astype(jnp.float32)))
+        float(f(q, k, v))  # compile + warm
+        with jax.profiler.trace(args.trace_dir):
+            float(f(q, k, v))
+        print(f"trace written to {args.trace_dir}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -331,7 +331,7 @@ def _fwd_kernel(
     q_ref, k_ref, v_ref, m_in_ref, lse_in_ref, acc_in_ref,
     *rest,
     scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p, tri, wnd=None,
-    seg=False, emit_o=False, loop=False, ablate=None,
+    seg=False, emit_o=False, loop=False, ablate=None, band_nb=None,
 ):
     if seg:
         qseg_ref, kvseg_ref = rest[0], rest[1]
@@ -340,6 +340,16 @@ def _fwd_kernel(
     if tri:
         nqb = n_kv_blocks  # square: bq == bkv, s_q == s_kv
         i, j, is_init, is_fin = _tri_coords(nqb)
+    elif band_nb is not None:
+        # band grid (see flash_fwd): dim 3 walks only the <=band_nb kv
+        # blocks that can intersect q-block i's sliding-window band, instead
+        # of all n_kv_blocks — the all-live-steps idea of the tri grid
+        # applied to the window structure
+        i = pl.program_id(2)
+        c = pl.program_id(3)
+        j = _kv_jmin(spec_ref, i, bq, bkv, n_kv_blocks, wnd) + c
+        is_init = c == 0
+        is_fin = c == band_nb - 1
     else:
         i = pl.program_id(2)
         j = pl.program_id(3)
@@ -614,6 +624,19 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     nkb = s_kv // bkv
     tri = (bool(triangular) and window is None and not _tri_disabled()
            and bq == bkv and s_q == s_kv and nqb % 2 == 0 and nqb >= 2)
+    # band grid: the window analogue of the tri grid.  A q-block's band can
+    # intersect at most band_nb kv blocks (worst alignment, offset -1), so
+    # the kv grid dim shrinks from nkb to band_nb — at window=4K/seq=64K/
+    # bkv=2048 that is 3 steps per row instead of 32, and per-grid-step
+    # overhead is what dominates small-window runs (measured 53 band-
+    # TFLOPs/s at window=4K vs 158 full-causal, results/results_window.jsonl).
+    # Same caller contract as tri (static full-window causal, offset 0/-1),
+    # which `triangular=True` already promises.
+    band_nb = None
+    if bool(triangular) and window is not None and not _tri_disabled():
+        nb = min(nkb, (bq + window - 2) // bkv + 2)
+        if nb < nkb:
+            band_nb = nb
     if tri:
         def q_map(b_, h, p, jp, sp):
             return (b_, h, jnp.where(jp > p, nqb - 1 - p, p), 0)
@@ -625,6 +648,19 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
             return (b_, h, 0, 0)
 
         grid = (b, n, nqb // 2, nqb + 1)
+    elif band_nb is not None:
+        def q_map(b_, h, i, c, sp):
+            return (b_, h, i, 0)
+
+        def kv_map(b_, h, i, c, sp):
+            j = _kv_jmin(sp, i, bq, bkv, nkb, window) + c
+            j_eff = jnp.minimum(j, _kv_jmax(sp, i, bq, bkv, nkb))
+            return (b_, h // group, j_eff, 0)
+
+        def state_map(b_, h, i, c, sp):
+            return (b_, h, 0, 0)
+
+        grid = (b, n, nqb, band_nb)
     else:
         q_map, kv_map, state_map = _make_index_maps(bq, bkv, nqb, nkb, group,
                                                     wnd=window)
@@ -633,7 +669,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
         _fwd_kernel, scale=scale, bq=bq, bkv=bkv, bkv_compute=bkc, lp=lp,
         n_kv_blocks=nkb, cast_p=cast_p, tri=tri, wnd=window,
         seg=segments is not None, emit_o=emit_o, loop=loop_sweep,
-        ablate=_ablate,
+        ablate=_ablate, band_nb=band_nb,
     )
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     in_specs = [
@@ -940,16 +976,42 @@ def _bwd_accum_tile(
 # the separation argument needs a reasonably long sweep).
 
 
+def _bwd_fused_iq(spec_ref, j, c, bq, bkv, n_q_blocks, wnd):
+    """Shared kernel/index-map iq schedule for the fused bwd sweep: descend
+    from the sweep's bottom-most useful q block.  Without a window that is
+    n_q_blocks-1; with one it is _q_imax (rows below it have their whole
+    band left of kv block j), floored at imin so an empty column still
+    yields a deterministic (passthrough-written) block.  Returns
+    (iq_clamped, clamped): clamped steps revisit imin's block and must not
+    write dq.  Note the band preserves the descending-separation argument:
+    a block written at step c_w of sweep j re-appears in sweep j+1 at
+    c_r = c_w + (imax(j+1) - imax(j)) >= c_w, i.e. a full sweep later."""
+    imin = _q_imin(spec_ref, j, bq, bkv, n_q_blocks)
+    if wnd is None:
+        imax = n_q_blocks - 1
+    else:
+        imax = jnp.maximum(_q_imax(spec_ref, j, bq, bkv, n_q_blocks, wnd),
+                           imin)
+    iq_raw = imax - c
+    return jnp.maximum(iq_raw, imin), iq_raw < imin
+
+
 def _bwd_fused_kernel(
     spec_ref,
     do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref, dq_in_ref,
-    dq_out_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, ds_pend, q_pend, pend_flag,
-    *, scale, bq, bkv, lp, n_q_blocks, group,
+    *rest,
+    scale, bq, bkv, lp, n_q_blocks, group, nbq, wnd=None, seg=False,
 ):
+    if seg:
+        qseg_ref, kvseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+    (dq_out_ref, dk_ref, dv_ref,
+     dk_scr, dv_scr, ds_pend, q_pend, pend_flag) = rest
     j = pl.program_id(2)
     t = pl.program_id(3)
-    iq = n_q_blocks - 1 - (t % n_q_blocks)  # descending (see header comment)
+    # descending within the (possibly window-banded) sweep of nbq steps
+    iq, clamped = _bwd_fused_iq(spec_ref, j, t % nbq, bq, bkv, n_q_blocks,
+                                wnd)
     r0 = iq * bq
     c0 = j * bkv
 
@@ -959,13 +1021,22 @@ def _bwd_fused_kernel(
         dv_scr[:] = jnp.zeros_like(dv_scr)
         pend_flag[0] = 0
 
-    imin = _q_imin(spec_ref, j, bq, bkv, n_q_blocks)
-    # clamped steps (iq < imin) revisit block imin, whose live visit came just
-    # before them in the descending sweep; they must not touch dq_out or
-    # they'd overwrite that visit's accumulation with the stale dq_in buffer
-    clamped = iq < imin
-    live = _block_has_work(spec_ref, r0, c0, bq, bkv) & ~clamped
-    full = _block_full(spec_ref, r0, c0, bq, bkv)
+    # clamped steps revisit block imin, whose live visit came just before
+    # them in the descending sweep; they must not touch dq_out or they'd
+    # overwrite that visit's accumulation with the stale dq_in buffer
+    live = _block_has_work(spec_ref, r0, c0, bq, bkv, wnd) & ~clamped
+    full = _block_full(spec_ref, r0, c0, bq, bkv, wnd)
+    if seg:
+        # packed sequences: only single-segment-matching blocks skip the
+        # mask (same classification as _fwd_kernel)
+        qs_tile = qseg_ref[0, :, :]   # [bq, 1]
+        ks_tile = kvseg_ref[0, :, :]  # [1, bkv]
+        seg_ok = _seg_uniform_eq(qs_tile, ks_tile)
+        fast_cond = live & full & seg_ok
+        masked_cond = live & ~(full & seg_ok)
+    else:
+        fast_cond = live & full
+        masked_cond = live & ~full
 
     @pl.when(pend_flag[0] == 1)
     def _flush_prev():
@@ -986,13 +1057,14 @@ def _bwd_fused_kernel(
             iq, mask, scale=scale, bq=bq, lp=lp, dq_update=_dq_update,
         )
 
-    @pl.when(live & full)
+    @pl.when(fast_cond)
     def _compute_fast():
         _accum(None)
 
-    @pl.when(live & ~full)
+    @pl.when(masked_cond)
     def _compute_masked():
-        _accum(_block_mask(spec_ref, r0, c0, bq, bkv))
+        _accum(_block_mask(spec_ref, r0, c0, bq, bkv, wnd,
+                           seg=(qs_tile, ks_tile) if seg else None))
 
     @pl.when(~live & ~clamped)
     def _passthrough():
@@ -1000,7 +1072,7 @@ def _bwd_fused_kernel(
         # own buffer flush at the next index change; keep its content valid
         dq_out_ref[0, 0, :, :] = dq_in_ref[0, 0, :, :]
 
-    @pl.when(t == n_q_blocks * group - 1)
+    @pl.when(t == nbq * group - 1)
     def _finish():
         # drain: this sweep's last live step just stashed its pend tiles
         @pl.when(pend_flag[0] == 1)
@@ -1097,10 +1169,14 @@ def _bwd_accum_tile_sub(
 def _bwd_fused_tri_kernel(
     spec_ref,
     do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
-    dq_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, ds_pend, q_pend, pend_flag,
-    *, scale, bq, bkv, bkvc, lp, nqb, nkb, ratio,
+    *rest,
+    scale, bq, bkv, bkvc, lp, nqb, nkb, ratio, seg=False,
 ):
+    if seg:
+        qseg_ref, kvseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+    (dq_ref, dk_ref, dv_ref,
+     dk_scr, dv_scr, ds_pend, q_pend, pend_flag) = rest
     """Wrapped-diagonal causal backward (static full-window causal with
     offset 0 or -1 — see the flash_fwd docstring's triangular contract —
     and group=1).
@@ -1156,18 +1232,29 @@ def _bwd_fused_tri_kernel(
 
     # the diagonal blocks are the trailing `ratio` steps of each segment
     full = jnp.where(seg_b, c < ncols - ratio, c < len_a - ratio)
+    if seg:
+        # packed sequences: a structurally-full block still needs masking
+        # unless both tiles share one segment (same as the fwd tri grid —
+        # seg only widens which steps take the masked path)
+        qs_tile = qseg_ref[0, :, :]   # [bq, 1]
+        ks_tile = kvseg_ref[0, :, :]  # [1, bkv]
+        full = full & _seg_uniform_eq(qs_tile, ks_tile)
 
     def _dq_update(dq_acc):
         # dq accumulates straight into the resident whole-head out buffer
         rows = pl.ds(iq * bq, bq)
         dq_ref[0, 0, rows, :] = dq_ref[0, 0, rows, :] + scale * dq_acc
 
+    def _mask_of(u):
+        # u is a Python int (the sub-block loop is unrolled): static slice
+        seg_u = (qs_tile, ks_tile[:, u * bkvc:(u + 1) * bkvc]) if seg else None
+        return _block_mask(spec_ref, r0, c0 + u * bkvc, bq, bkvc, seg=seg_u)
+
     def _accum(masked):
         _bwd_accum_tile_sub(
             do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
             dv_scr, dk_scr, ds_pend, q_pend, pend_flag,
-            iq, masked,
-            lambda u: _block_mask(spec_ref, r0, c0 + u * bkvc, bq, bkvc),
+            iq, masked, _mask_of,
             scale=scale, bq=bq, bkvc=bkvc, n_sub=bkv // bkvc, lp=lp,
             dq_update=_dq_update,
         )
@@ -1182,7 +1269,8 @@ def _bwd_fused_tri_kernel(
 
 
 def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
-                         block_q, block_kv, interpret, block_kv_compute=None):
+                         block_q, block_kv, interpret, block_kv_compute=None,
+                         segments=None):
     b, n, s_q, d = q.shape
     s_kv = k.shape[2]
     bq = _pick_block(s_q, block_q)
@@ -1222,22 +1310,34 @@ def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
         return (b_, h, 0, 0)
 
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bkv, d), kv_map),
+        pl.BlockSpec((1, 1, bkv, d), kv_map),
+        state_block,
+        state_block,
+    ]
+    inputs = [_spec_array(spec), do, q, k, v, _pack(delta, lp),
+              _pack(lse, lp)]
+    if segments is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, bq, 1),
+            lambda b_, h, p, c, sp: (b_, q_map(b_, h, p, c, sp)[2], 0)))
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bkv),
+            lambda b_, h, p, c, sp: (b_, 0, kv_map(b_, h, p, c, sp)[2])))
+        inputs.append(jnp.asarray(segments[0], jnp.int32)[:, :, None])
+        inputs.append(jnp.asarray(segments[1], jnp.int32)[:, None, :])
     dq, dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_fused_tri_kernel, scale=scale, bq=bq, bkv=bkv, bkvc=bkvc,
-            lp=lp, nqb=nqb, nkb=nkb, ratio=ratio,
+            lp=lp, nqb=nqb, nkb=nkb, ratio=ratio, seg=segments is not None,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, n, nkb // 2, ncols + 1),
-            in_specs=[
-                pl.BlockSpec((1, 1, bq, d), q_map),
-                pl.BlockSpec((1, 1, bq, d), q_map),
-                pl.BlockSpec((1, 1, bkv, d), kv_map),
-                pl.BlockSpec((1, 1, bkv, d), kv_map),
-                state_block,
-                state_block,
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, 1, s_q, d), dq_map),
                 pl.BlockSpec((1, 1, bkv, d), kv_out_map),
@@ -1261,12 +1361,22 @@ def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(_spec_array(spec), do, q, k, v, _pack(delta, lp), _pack(lse, lp))
+    )(*inputs)
     return dq, dk, dv
 
 
+def bwd_band_nbq(bq, bkv, nqb, window):
+    """Static q-block count of a fused-bwd window band sweep: the q rows
+    whose band intersects a bkv-wide kv block span bkv + window - 1 rows
+    (worst alignment), same derivation as flash_fwd's band_nb."""
+    if window is None:
+        return nqb
+    return min(nqb, (bkv + window - 2) // bq + 2)
+
+
 def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
-                     block_q, block_kv, interpret):
+                     block_q, block_kv, interpret, window=None,
+                     segments=None):
     b, n, s_q, d = q.shape
     n_kv, s_kv = k.shape[1], k.shape[2]
     group = _gqa_group(n, n_kv)
@@ -1275,16 +1385,15 @@ def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
     lp = _pick_block(bq, 128)
     nqb = s_q // bq
     nkb = s_kv // bkv
+    # window: sweep only the q blocks whose band can touch kv block j
+    nbq = bwd_band_nbq(bq, bkv, nqb, window)
 
     def qh_of(h, t):
-        return h * group + t // nqb
-
-    def iq_of(t, j, sp):
-        # descending within the sweep, clamped onto the first live block
-        return jnp.maximum(nqb - 1 - (t % nqb), _q_imin(sp, j, bq, bkv, nqb))
+        return h * group + t // nbq
 
     def bq_map(b_, h, j, t, sp):
-        return (b_, qh_of(h, t), iq_of(t, j, sp), 0)
+        iq, _ = _bwd_fused_iq(sp, j, t % nbq, bq, bkv, nqb, window)
+        return (b_, qh_of(h, t), iq, 0)
 
     def bstate_map(b_, h, j, t, sp):
         return (b_, qh_of(h, t), 0, 0)
@@ -1294,23 +1403,36 @@ def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
 
     bstate_block = pl.BlockSpec((1, 1, s_q // lp, lp), bstate_map)
     dq0 = jnp.zeros((b, n, s_q, d), jnp.float32)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), bq_map),
+        pl.BlockSpec((1, 1, bq, d), bq_map),
+        pl.BlockSpec((1, 1, bkv, d), bkv_map),
+        pl.BlockSpec((1, 1, bkv, d), bkv_map),
+        bstate_block,
+        bstate_block,
+        pl.BlockSpec((1, 1, bq, d), bq_map),
+    ]
+    inputs = [_spec_array(spec), do, q, k, v, _pack(delta, lp),
+              _pack(lse, lp), dq0]
+    if segments is not None:
+        # seg ids appended AFTER dq0 so the alias index below stays stable
+        in_specs.append(pl.BlockSpec(
+            (1, bq, 1),
+            lambda b_, h, j, t, sp: (b_, bq_map(b_, h, j, t, sp)[2], 0)))
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bkv), lambda b_, h, j, t, sp: (b_, 0, j)))
+        inputs.append(jnp.asarray(segments[0], jnp.int32)[:, :, None])
+        inputs.append(jnp.asarray(segments[1], jnp.int32)[:, None, :])
     dq, dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_fused_kernel, scale=scale, bq=bq, bkv=bkv, lp=lp,
-            n_q_blocks=nqb, group=group,
+            n_q_blocks=nqb, group=group, nbq=nbq, wnd=window,
+            seg=segments is not None,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b, n_kv, nkb, nqb * group),
-            in_specs=[
-                pl.BlockSpec((1, 1, bq, d), bq_map),
-                pl.BlockSpec((1, 1, bq, d), bq_map),
-                pl.BlockSpec((1, 1, bkv, d), bkv_map),
-                pl.BlockSpec((1, 1, bkv, d), bkv_map),
-                bstate_block,
-                bstate_block,
-                pl.BlockSpec((1, 1, bq, d), bq_map),
-            ],
+            grid=(b, n_kv, nkb, nbq * group),
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, 1, bq, d), bq_map),
                 pl.BlockSpec((1, 1, bkv, d), bkv_map),
@@ -1338,7 +1460,7 @@ def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(_spec_array(spec), do, q, k, v, _pack(delta, lp), _pack(lse, lp), dq0)
+    )(*inputs)
     return dq, dk, dv
 
 
@@ -1432,15 +1554,15 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     nqb = s_q // bq
     nkb = s_kv // bkv
     explicit_split = fused is False
-    if window is not None or segments is not None:
-        # windowed and packed-sequence runs take the split kernels: the
-        # fused/tri schedules' dead-step and aliasing arguments assume
-        # full-window causality and have not been re-derived for a band /
-        # segment structure (perf follow-up, not a correctness limit)
-        fused = False
+    if window is not None:
+        # the wrapped-diagonal tri grid assumes full-window causality; a
+        # window instead takes the BANDED fused sweep below.  Segments ride
+        # BOTH fused kernels' masked paths (round-2 verdict item 5 — neither
+        # mode downgrades to the 7-matmul split kernels any more).
         triangular = False
     if fused is None:
-        fused = not interpret and (s_q // bq) * group >= 4
+        fused = (not interpret
+                 and bwd_band_nbq(bq, bkv, s_q // bq, window) * group >= 4)
     tri = (
         bool(triangular) and not explicit_split and not _tri_disabled()
         and tri_bwd_supported(s_q, s_kv, n, n_kv, d, block_q=bq, block_kv=bkv,
@@ -1450,12 +1572,13 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
         return _flash_bwd_fused_tri(
             do, q, k, v, delta, lse, scale, spec,
             block_q=block_q, block_kv=block_kv, interpret=interpret,
-            block_kv_compute=block_kv_compute,
+            block_kv_compute=block_kv_compute, segments=segments,
         )
     if fused:
         return _flash_bwd_fused(
             do, q, k, v, delta, lse, scale, spec,
             block_q=block_q, block_kv=block_kv, interpret=interpret,
+            window=window, segments=segments,
         )
 
     # ---- dq ----
@@ -1590,14 +1713,16 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=None, block_kv=No
 
     `window` (static int) enables sliding-window attention: each query
     attends to its last `window` positions (inclusive of itself); requires
-    causal=True.  Off-diagonal blocks outside the band are skipped, so cost
-    scales with window, not sequence.
+    causal=True.  Both directions run BAND grids (fwd band_nb /
+    bwd _bwd_fused_iq): the grid enumerates only blocks intersecting the
+    band, so cost scales with window, not sequence.
 
     `segment_ids` [B, S] int32 (non-negative; negatives are reserved for
     internal padding) packs multiple documents into one row — attention
     never crosses a segment boundary.  Blocks wholly inside one segment
     keep the fast path; only boundary-straddling blocks pay for the id
-    compare.  The backward takes the split (non-fused) kernels."""
+    compare, in the forward and in the fused (tri or rect) backward
+    alike."""
     if segment_ids is None:
         return _flash_attention_plain(q, k, v, scale, causal, block_q,
                                       block_kv, block_q_bwd, block_kv_bwd,
@@ -1717,8 +1842,10 @@ def _flash_attention_seg_vjp_bwd(scale, causal, block_q, block_kv, block_q_bwd,
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
     dq, dk, dv = flash_bwd(
         do, q, k, v, delta, lse, scale, spec,
+        # statically plain full-window causal; segments compose with the
+        # tri bwd kernel (a window instead selects the banded fused sweep)
+        triangular=causal, window=window,
         block_q=block_q_bwd, block_kv=block_kv_bwd,
-        triangular=False, window=window,
         segments=(segment_ids, segment_ids),
     )
     # integer inputs carry symbolic-zero (float0) cotangents

@@ -28,6 +28,13 @@ class BlockTable(NamedTuple):
     bwd_block_q: int
     bwd_block_kv: int
     measured: bool  # False = extrapolated, re-sweep on hardware
+    # VMEM-cliff clamp budgets (elements of q-block x kv-block area a fwd /
+    # bwd grid step may keep live before throughput collapses ~3x).  The
+    # v5e values are MEASURED (benchmarks/cliff_probe.py); other
+    # generations scale them by their larger per-core VMEM and inherit
+    # measured=False until a sweep pins them.
+    fwd_cliff_area: int = 2048 * 2048
+    bwd_cliff_area: int = 1024 * 2048
 
 
 class ResolvedBlocks(NamedTuple):
@@ -46,11 +53,16 @@ _TABLE = {
     # measured with benchmarks/sweep_blocks.py on one v5e chip; see
     # docs/design.md §3 for the cliff analysis
     "v5e": BlockTable(2048, 2048, 1024, 1024, 2048, True),
-    # v4/v5p have larger cores (two TensorCores on v4, more VMEM per core);
-    # same shape defaults until swept
-    "v5p": BlockTable(2048, 2048, 1024, 1024, 2048, False),
-    "v4": BlockTable(2048, 2048, 1024, 1024, 2048, False),
-    # v6e (Trillium): bigger MXU; start from the v5e optimum
+    # v4/v5p have roughly twice the v5e per-core VMEM, so the area at which
+    # a grid step's live blocks spill — the cliff — should sit one power of
+    # two higher; block shapes stay at the v5e optimum until swept
+    "v5p": BlockTable(2048, 2048, 1024, 1024, 2048, False,
+                      fwd_cliff_area=2 * 2048 * 2048,
+                      bwd_cliff_area=2 * 1024 * 2048),
+    "v4": BlockTable(2048, 2048, 1024, 1024, 2048, False,
+                     fwd_cliff_area=2 * 2048 * 2048,
+                     bwd_cliff_area=2 * 1024 * 2048),
+    # v6e (Trillium): bigger MXU, comparable VMEM — keep the v5e budgets
     "v6": BlockTable(2048, 2048, 1024, 1024, 2048, False),
 }
 
@@ -137,15 +149,17 @@ def block_defaults(device=None) -> BlockTable:
 
 
 # Measured VMEM-cliff law (benchmarks/cliff_probe.py on v5e, traces under
-# cliff_traces/): a fwd grid step whose q-block x kv-block AREA exceeds
-# 2048*2048 elements collapses ~3x (57 TFLOPs/s at 2048x4096 — at EVERY
-# compute-sub-block size, so it is not score materialization or pipeline
-# overlap; halving bq to 1024 recovers 142).  The backward's per-step
-# residency is larger (5 matmul operands + dk/dv scratch), so its cliff sits
-# one power of two lower.  It's a cliff, not a slope — exceeding the budget
-# is never a trade-off worth making, hence a clamp rather than a warning.
-_FWD_CLIFF_AREA = 2048 * 2048
-_BWD_CLIFF_AREA = 1024 * 2048
+# results/cliff_traces/): a fwd grid step whose q-block x kv-block AREA
+# exceeds the generation's budget collapses ~3x (57 TFLOPs/s at 2048x4096
+# on v5e — at EVERY compute-sub-block size, so it is not score
+# materialization or pipeline overlap; halving bq to 1024 recovers 142).
+# The backward's per-step residency is larger (5 matmul operands + dk/dv
+# scratch), so its cliff sits one power of two lower.  It's a cliff, not a
+# slope — exceeding the budget is never a trade-off worth making, hence a
+# clamp rather than a warning.  The budgets live in the per-generation
+# BlockTable rows (a v5p with twice the VMEM must not be clamped to v5e's
+# areas); unknown device kinds inherit the v5e-measured values through the
+# BlockTable field defaults (and _DEFAULT).
 
 
 def _cliff_ok():
@@ -168,23 +182,25 @@ def _clamp_cliff(bq: int, bkv: int, area: int, which: str):
 
 
 def resolve_blocks(block_q=None, block_kv=None, block_q_bwd=None,
-                   block_kv_bwd=None, block_kv_compute=None) -> ResolvedBlocks:
+                   block_kv_bwd=None, block_kv_compute=None,
+                   device=None) -> ResolvedBlocks:
     """Fill unspecified kernel block sizes from the per-generation table.
 
     The bwd defaults never exceed the (resolved) fwd blocks, so a caller who
     shrinks the fwd blocks for VMEM keeps that budget in bwd; likewise the
     compute sub-block never exceeds the kv memory block.  Explicit configs
-    past the measured VMEM cliff are clamped (see _clamp_cliff).  Always
+    past the generation's measured VMEM cliff are clamped (see
+    _clamp_cliff; budgets come from the device's BlockTable row).  Always
     returns a 5-field ResolvedBlocks; callers without a compute sub-block
     ignore the last field.
     """
-    t = block_defaults()
+    t = block_defaults(device)
     bq = t.fwd_block_q if block_q is None else block_q
     bkv = t.fwd_block_kv if block_kv is None else block_kv
     bqb = min(t.bwd_block_q, bq) if block_q_bwd is None else block_q_bwd
     bkvb = min(t.bwd_block_kv, bkv) if block_kv_bwd is None else block_kv_bwd
-    bq, bkv = _clamp_cliff(bq, bkv, _FWD_CLIFF_AREA, "fwd")
-    bqb, bkvb = _clamp_cliff(bqb, bkvb, _BWD_CLIFF_AREA, "bwd")
+    bq, bkv = _clamp_cliff(bq, bkv, t.fwd_cliff_area, "fwd")
+    bqb, bkvb = _clamp_cliff(bqb, bkvb, t.bwd_cliff_area, "bwd")
     if block_kv_compute is None:
         block_kv_compute = (bkv if t.fwd_block_kv_compute is None
                             else min(t.fwd_block_kv_compute, bkv))

@@ -137,3 +137,99 @@ def test_segments_on_tpu():
         err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
                                     - b_.astype(jnp.float32))))
         assert err < 5e-2, f"{name} max abs err {err}"
+
+
+@pytest.mark.parametrize("window", [512, 1024, 3000])
+def test_fused_banded_window_bwd_matches_split(window):
+    """The window-banded fused sweep (grid dim 3 = nbq*group instead of
+    nqb*group, _bwd_fused_iq) vs the split kernels, production tiles.
+    Covers block-aligned and unaligned windows."""
+    b, n, s, d = 1, 4, 4096, 128
+    ks = jax.random.split(jax.random.PRNGKey(21), 4)
+    dt = jnp.bfloat16
+    q = jax.random.normal(ks[0], (b, n, s, d), dt)
+    k = jax.random.normal(ks[1], (b, n, s, d), dt)
+    v = jax.random.normal(ks[2], (b, n, s, d), dt)
+    do = jax.random.normal(ks[3], (b, n, s, d), dt)
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
+    scale = d**-0.5
+    m0, lse0, acc0 = T.init_state(b, n, s, d)
+    m, lse, acc = pf.flash_fwd(q, k, v, m0, lse0, acc0, scale, spec,
+                               block_q=512, block_kv=512, window=window)
+    o = T.finalize(m, lse, acc, q.dtype)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    args = (do, q, k, v, delta, lse, scale, spec)
+    split = pf.flash_bwd(*args, block_q=512, block_kv=512, fused=False,
+                         window=window)
+    fused = pf.flash_bwd(*args, block_q=512, block_kv=512, fused=True,
+                         window=window)
+    for name, a, b_ in zip(("dq", "dk", "dv"), split, fused):
+        err = float(jnp.max(jnp.abs(a - b_)))
+        assert err < 1e-3, f"{name} max abs err {err}"
+
+
+def test_fused_segments_bwd_matches_split():
+    """Packed-segment masking through the FUSED kernel (seg tiles ride the
+    masked path) vs the split kernels, production tiles + GQA."""
+    b, n, nkv, s, d = 1, 8, 2, 4096, 128
+    ks = jax.random.split(jax.random.PRNGKey(22), 4)
+    dt = jnp.bfloat16
+    q = jax.random.normal(ks[0], (b, n, s, d), dt)
+    k = jax.random.normal(ks[1], (b, nkv, s, d), dt)
+    v = jax.random.normal(ks[2], (b, nkv, s, d), dt)
+    do = jax.random.normal(ks[3], (b, n, s, d), dt)
+    seg = jnp.concatenate([
+        jnp.zeros((b, 900), jnp.int32),
+        jnp.ones((b, 1600), jnp.int32),
+        jnp.full((b, s - 2500), 2, jnp.int32),
+    ], axis=1)
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
+    scale = d**-0.5
+    m0, lse0, acc0 = T.init_state(b, n, s, d)
+    m, lse, acc = pf.flash_fwd(q, k, v, m0, lse0, acc0, scale, spec,
+                               block_q=512, block_kv=512,
+                               segments=(seg, seg))
+    o = T.finalize(m, lse, acc, q.dtype)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    args = (do, q, k, v, delta, lse, scale, spec)
+    split = pf.flash_bwd(*args, block_q=512, block_kv=512, fused=False,
+                         segments=(seg, seg))
+    fused = pf.flash_bwd(*args, block_q=512, block_kv=512, fused=True,
+                         segments=(seg, seg))
+    for name, a, b_ in zip(("dq", "dk", "dv"), split, fused):
+        err = float(jnp.max(jnp.abs(a - b_)))
+        assert err < 1e-3, f"{name} max abs err {err}"
+
+
+def test_tri_segments_bwd_matches_split():
+    """Packed segments through the WRAPPED-DIAGONAL bwd kernel (seg only
+    narrows the fast path, same as the fwd tri grid) vs split kernels."""
+    b, n, s, d = 1, 4, 4096, 128
+    ks = jax.random.split(jax.random.PRNGKey(23), 4)
+    dt = jnp.bfloat16
+    q = jax.random.normal(ks[0], (b, n, s, d), dt)
+    k = jax.random.normal(ks[1], (b, n, s, d), dt)
+    v = jax.random.normal(ks[2], (b, n, s, d), dt)
+    do = jax.random.normal(ks[3], (b, n, s, d), dt)
+    seg = jnp.concatenate([
+        jnp.zeros((b, 700), jnp.int32),
+        jnp.ones((b, 1800), jnp.int32),
+        jnp.full((b, s - 2500), 2, jnp.int32),
+    ], axis=1)
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
+    scale = d**-0.5
+    m0, lse0, acc0 = T.init_state(b, n, s, d)
+    m, lse, acc = pf.flash_fwd(q, k, v, m0, lse0, acc0, scale, spec,
+                               block_q=512, block_kv=512,
+                               segments=(seg, seg))
+    o = T.finalize(m, lse, acc, q.dtype)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    args = (do, q, k, v, delta, lse, scale, spec)
+    split = pf.flash_bwd(*args, block_q=512, block_kv=512, fused=False,
+                         segments=(seg, seg))
+    tri = pf.flash_bwd(*args, block_q=512, block_kv=512, triangular=True,
+                       segments=(seg, seg))
+    assert pf.tri_bwd_supported(s, s, n, n, d, block_q=512, block_kv=512)
+    for name, a, b_ in zip(("dq", "dk", "dv"), split, tri):
+        err = float(jnp.max(jnp.abs(a - b_)))
+        assert err < 1e-3, f"{name} max abs err {err}"

@@ -294,3 +294,121 @@ def test_burst_config_validates_window():
         bat.BurstConfig(causal=False, layout="contig", window=8)
     with pytest.raises(ValueError, match=">= 1"):
         bat.BurstConfig(causal=True, layout="contig", window=0)
+
+
+@pytest.mark.parametrize("window,blocks,seq", [
+    (16, 16, 128),   # nb=2 < nkb=8: band active, several full blocks/row
+    (24, 16, 128),   # unaligned window crossing block boundaries (nb=3)
+    (48, 16, 96),    # band nearly spans the grid (nb=4 < nkb=6)
+    (16, 16, 32),    # nb >= nkb: band declines, rect path (guard the gate)
+])
+def test_band_grid_matches_dense(window, blocks, seq):
+    """The banded fwd grid (kv dim = blocks intersecting the window band,
+    flash_fwd band_nb) reproduces the dense banded oracle, values and
+    grads, wherever the gate enables it."""
+    q, k, v, do = _inputs(seq, seed=7)
+    ref_o = banded_dense(q, k, v, window)
+    got_o = pallas_flash.flash_attention(q, k, v, None, True, blocks, blocks,
+                                         window=window)
+    np.testing.assert_allclose(np.asarray(got_o), np.asarray(ref_o),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * do)
+
+    ref_g = jax.grad(loss(lambda q, k, v: banded_dense(q, k, v, window)),
+                     argnums=(0, 1, 2))(q, k, v)
+    got_g = jax.grad(loss(lambda q, k, v: pallas_flash.flash_attention(
+        q, k, v, None, True, blocks, blocks, window=window)),
+        argnums=(0, 1, 2))(q, k, v)
+    for name, x, y in zip(("dq", "dk", "dv"), ref_g, got_g):
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_band_grid_gqa_and_segments():
+    """Band grid composes with GQA kv fetching and packed-segment masking
+    (segments only widen the masked path, same argument as the tri grid)."""
+    from burst_attn_tpu.ops.tile import init_state
+
+    seq, blocks, window = 128, 16, 24
+    q, k, v, _ = _inputs(seq, seed=11, n_kv=1)  # group=2
+    seg = jnp.asarray(
+        np.repeat(np.arange(4), seq // 4)[None], jnp.int32)  # 4 docs
+    spec = round_spec(jnp.int32(0), jnp.int32(0), seq, seq, True, "contig")
+    st = init_state(B, N, seq, D)
+    # banded+segmented kernel vs the jnp oracle tile
+    ref = tile.tile_fwd(q, k, v, *st, SCALE, spec, window=window,
+                        segments=(seg, seg))
+    got = pallas_flash.flash_fwd(q, k, v, *st, SCALE, spec,
+                                 block_q=blocks, block_kv=blocks,
+                                 interpret=True, triangular=True,
+                                 window=window, segments=(seg, seg))
+    for name, x, y in zip(("m", "lse", "acc"), ref, got):
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("window,bq,bkv,nqb,qp,kp,layout,causal", [
+    (24, 16, 16, 8, 0, 0, "contig", True),
+    (16, 16, 16, 8, 0, 0, "contig", True),
+    (33, 16, 32, 8, 0, 0, "contig", True),   # bkv != bq, unaligned window
+    (None, 16, 16, 8, 0, 0, "contig", True),  # no window: degenerates to rect
+    (None, 16, 16, 8, 1, 2, "zigzag", True),  # ring round, partial bounds
+    (None, 16, 16, 8, 0, 0, "contig", False),
+])
+def test_fused_bwd_banded_schedule_coverage(window, bq, bkv, nqb, qp, kp,
+                                            layout, causal):
+    """Pure-python replay of the fused bwd grid schedule (_bwd_fused_iq +
+    the kernel's live/clamped/passthrough conditions): every block with
+    work is computed EXACTLY once, clamped steps never write dq, and every
+    fetched dq block is written at least once per sweep (the aliased-buffer
+    flush contract).  Interpret mode cannot check this — it does not model
+    the in-place dq aliasing (test_fused_bwd.py validates numerics
+    on-chip); this test pins the schedule logic itself."""
+    import numpy as np
+    from burst_attn_tpu.ops.pallas_flash import (
+        _bwd_fused_iq, _block_has_work, bwd_band_nbq,
+    )
+    from burst_attn_tpu.ops.masks import round_spec
+
+    s_q = s_kv = bq * nqb
+    nkb = s_kv // bkv
+    spec = round_spec(jnp.int32(qp), jnp.int32(kp), s_q, s_kv, causal, layout)
+    sp = np.asarray([int(x) for x in
+                     np.asarray(jnp.stack([spec.q_lo, spec.q_hi, spec.kv_hi,
+                                           spec.causal, spec.offset]))])
+
+    class SpecRef:  # indexable like the kernel's prefetched scalar ref
+        def __getitem__(self, idx):
+            return sp[idx]
+
+    spec_ref = SpecRef()
+    nbq = bwd_band_nbq(bq, bkv, nqb, window)
+    computed = np.zeros((nqb, nkb), int)
+    for j in range(nkb):
+        fetched, written = set(), set()
+        for c in range(nbq):
+            iq, clamped = _bwd_fused_iq(spec_ref, j, c, bq, bkv, nqb, window)
+            iq, clamped = int(iq), bool(clamped)
+            fetched.add(iq)
+            live = (not clamped) and bool(
+                _block_has_work(spec_ref, iq * bq, j * bkv, bq, bkv, window))
+            if live:
+                computed[iq, j] += 1
+                written.add(iq)
+            elif not clamped:  # passthrough write
+                written.add(iq)
+        assert fetched == written, (j, fetched - written)
+
+    # oracle: which (i, j) blocks contain at least one visible element
+    q_lo, q_hi, kv_hi, cz, off = sp
+    rows = np.arange(s_q)[:, None]
+    cols = np.arange(s_kv)[None, :]
+    m = (rows >= q_lo) & (rows < q_hi) & (cols < kv_hi)
+    if cz:
+        m &= cols <= rows + off
+    if window is not None:
+        m &= cols > rows + off - window
+    want = m.reshape(nqb, bq, nkb, bkv).any(axis=(1, 3)).astype(int)
+    np.testing.assert_array_equal(computed, want)
