@@ -51,6 +51,12 @@ def main():
     from burst_attn_tpu.ops.pallas_flash import flash_attention
 
     n, d = args.heads, args.dim
+    if os.environ.get("BURST_NO_TRI", "").strip().lower() not in ("", "0", "false"):
+        # _tri_disabled() is read at trace time: with the env var exported
+        # the "tri" rows would silently compile rect grids and the per-step
+        # arithmetic would be ~2x off.  The probe owns this knob.
+        sys.exit("batch_probe: unset BURST_NO_TRI first (the probe toggles "
+                 "it per case and needs both grids)")
     if os.path.dirname(args.out):
         os.makedirs(os.path.dirname(args.out), exist_ok=True)
 

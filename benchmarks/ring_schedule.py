@@ -12,7 +12,7 @@ in-flight window.
 
 CPU (simulated 8-device mesh) runs everywhere:
 
-    python -m benchmarks.ring_schedule --mesh 8 --seq 4096
+    python -m benchmarks.ring_schedule --cpu --mesh 8 --seq 4096
 
 On TPU (through the tunnel) the same lowering shows the real Mosaic/ICI
 schedule; append --out to record the summary jsonl.
@@ -73,13 +73,24 @@ def main():
 
     import os
 
+    world_req = 1
+    for part in args.mesh.split("x"):
+        world_req *= int(part)
     if args.cpu:
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + " --xla_force_host_platform_device_count=8")
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={max(8, world_req)}")
     import jax
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < world_req:
+        # make_mesh's integer path silently builds a 1-device mesh — a W=1
+        # "ring" has no permute at all and would record a misleading
+        # zero-overlap row.  Refuse instead.
+        sys.exit(f"ring_schedule: mesh {args.mesh} needs {world_req} devices, "
+                 f"have {len(jax.devices())} ({jax.default_backend()}); "
+                 "pass --cpu for a simulated host-device mesh")
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

@@ -108,6 +108,26 @@ def round_spec(q_part, kv_part, s_q: int, s_kv: int, causal: bool, layout: str,
         raise ValueError(f"unknown layout {layout!r}; expected one of {LAYOUTS}")
 
 
+def spec_live(spec: MaskSpec, window=None):
+    """Traced bool scalar: does ANY (row, col) of this round's tile attend?
+
+    False for a contig-causal ring's future rounds (q_hi == 0) and for
+    windowed rounds whose whole band lies outside the resident kv chunk —
+    the ring caller wraps the tile computation in `lax.cond` on this and
+    skips the kernel launch entirely on dead rounds.  With window << seq a
+    contig windowed ring of W devices has only ~ceil(window/chunk)+1 live
+    rounds per device; every other round previously ran a full grid of
+    masked-out blocks."""
+    live = (spec.q_hi > spec.q_lo) & (spec.kv_hi > 0)
+    # causal: some row must see col 0 (the earliest col of the chunk)
+    live = live & ((spec.causal == 0) | (spec.q_hi - 1 + spec.offset >= 0))
+    if window is not None:
+        # band union over rows starts at q_lo + offset - window + 1; the
+        # round is dead when that already exceeds the last kv col
+        live = live & (spec.q_lo + spec.offset - window + 1 <= spec.kv_hi - 1)
+    return live
+
+
 def dense_mask(spec: MaskSpec, s_q: int, s_kv: int, window=None) -> jnp.ndarray:
     """Materialize the [s_q, s_kv] boolean mask (True = attend).
 

@@ -304,6 +304,42 @@ def _unpack(x):
 # forward
 
 
+def _lcm(a, b):
+    import math
+
+    return a * b // math.gcd(a, b)
+
+
+def fwd_band_nb(bq, bkv, window):
+    """Exact max kv-block count a q-row-block's sliding-window band can
+    intersect, over the alignments the triangular contract can produce
+    (r0 = i*bq, offset in {0, -1}).  A closed-form upper bound
+    ((bq+window-2)//bkv + 2) overcounts by one at every aligned config —
+    e.g. window=4K, bq=bkv=2048 intersects at most 3 blocks, not 4 — and a
+    permanently-dead extra grid step per row is exactly the overhead the
+    band grid exists to remove."""
+    best = 0
+    for r0 in range(0, _lcm(bq, bkv), bq):  # residues cycle at lcm
+        for off in (0, -1):
+            jmin = (r0 + off - window + 1) // bkv
+            jmax = (r0 + bq - 1 + off) // bkv
+            best = max(best, jmax - jmin + 1)
+    return best
+
+
+def bwd_band_nb(bq, bkv, window):
+    """Exact max q-block count whose band can reach a kv block (the fused
+    bwd sweep length), over reachable alignments c0 = j*bkv, offset 0/-1.
+    Mirror of fwd_band_nb with the roles swapped (_q_imin/_q_imax)."""
+    best = 0
+    for c0 in range(0, _lcm(bq, bkv), bkv):
+        for off in (0, -1):
+            imin = (c0 - off) // bq          # first causal q row's block
+            imax = (c0 + bkv - 1 + window - 1 - off) // bq
+            best = max(best, imax - imin + 1)
+    return best
+
+
 def _tri_coords(nqb):
     """Wrapped-diagonal coordinates for the static-causal triangular grid.
 
@@ -625,16 +661,17 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     tri = (bool(triangular) and window is None and not _tri_disabled()
            and bq == bkv and s_q == s_kv and nqb % 2 == 0 and nqb >= 2)
     # band grid: the window analogue of the tri grid.  A q-block's band can
-    # intersect at most band_nb kv blocks (worst alignment, offset -1), so
-    # the kv grid dim shrinks from nkb to band_nb — at window=4K/seq=64K/
-    # bkv=2048 that is 3 steps per row instead of 32, and per-grid-step
-    # overhead is what dominates small-window runs (measured 53 band-
-    # TFLOPs/s at window=4K vs 158 full-causal, results/results_window.jsonl).
-    # Same caller contract as tri (static full-window causal, offset 0/-1),
-    # which `triangular=True` already promises.
+    # intersect at most band_nb kv blocks (exact max over the reachable
+    # alignments r0 = i*bq and offsets {0,-1}), so the kv grid dim shrinks
+    # from nkb to band_nb — at window=4K/seq=64K/bkv=2048 that is 3 steps
+    # per row instead of 32, and per-grid-step overhead is what dominates
+    # small-window runs (measured 53 band-TFLOPs/s at window=4K vs 158
+    # full-causal, results/results_window.jsonl).  Same caller contract as
+    # tri (static full-window causal, offset 0/-1), which `triangular=True`
+    # already promises.
     band_nb = None
     if bool(triangular) and window is not None and not _tri_disabled():
-        nb = min(nkb, (bq + window - 2) // bkv + 2)
+        nb = min(nkb, fwd_band_nb(bq, bkv, window))
         if nb < nkb:
             band_nb = nb
     if tri:
@@ -1172,11 +1209,6 @@ def _bwd_fused_tri_kernel(
     *rest,
     scale, bq, bkv, bkvc, lp, nqb, nkb, ratio, seg=False,
 ):
-    if seg:
-        qseg_ref, kvseg_ref = rest[0], rest[1]
-        rest = rest[2:]
-    (dq_ref, dk_ref, dv_ref,
-     dk_scr, dv_scr, ds_pend, q_pend, pend_flag) = rest
     """Wrapped-diagonal causal backward (static full-window causal with
     offset 0 or -1 — see the flash_fwd docstring's triangular contract —
     and group=1).
@@ -1192,6 +1224,11 @@ def _bwd_fused_tri_kernel(
     index map lagged one step (jsel(c-1)), with one trailing no-compute step
     (c == C) to flush the final dk pend and write segment B's dk/dv.
     """
+    if seg:
+        qseg_ref, kvseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+    (dq_ref, dk_ref, dv_ref,
+     dk_scr, dv_scr, ds_pend, q_pend, pend_flag) = rest
     p = pl.program_id(2)
     c = pl.program_id(3)
     j_hi = nkb - 1 - p
@@ -1366,12 +1403,11 @@ def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
 
 
 def bwd_band_nbq(bq, bkv, nqb, window):
-    """Static q-block count of a fused-bwd window band sweep: the q rows
-    whose band intersects a bkv-wide kv block span bkv + window - 1 rows
-    (worst alignment), same derivation as flash_fwd's band_nb."""
+    """Static q-block count of a fused-bwd window band sweep (exact over
+    reachable alignments, see bwd_band_nb); nqb when no window."""
     if window is None:
         return nqb
-    return min(nqb, (bkv + window - 2) // bq + 2)
+    return min(nqb, bwd_band_nb(bq, bkv, window))
 
 
 def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,

@@ -47,7 +47,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops import tile as jnp_tile
-from ..ops.masks import full_spec, round_spec
+from ..ops.masks import full_spec, round_spec, spec_live
 from .ring import ppermute_next, my_partition, partition_at_round
 
 
@@ -237,6 +237,16 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
                              triangular=True, segments=segs)
         spec = round_spec(part_me, kv_part, s, s_kv, cfg.causal, cfg.layout,
                           window=cfg.window)
+        if cfg.layout == "contig" and cfg.causal:
+            # contig-causal rings have provably dead rounds (futures; with a
+            # window also everything beyond the band's reach): skip the
+            # whole kernel launch, not just its blocks (ops/masks.spec_live)
+            return lax.cond(
+                spec_live(spec, cfg.window),
+                lambda st_: _tile_fwd(cfg, q, k_c, v_c, *st_, scale, spec,
+                                      segments=segs),
+                lambda st_: st_,
+                st)
         return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec, segments=segs)
 
     kv = (k, v) if seg is None else (k, v, seg)
@@ -357,6 +367,17 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
         # comes from k, not from the rotating q payload
         spec = round_spec(q_part, part_me, s, k.shape[2], cfg.causal,
                           cfg.layout, window=cfg.window)
+        if cfg.layout == "contig" and cfg.causal:
+            # dead-round skip, bwd roles (fwd comment above): contribute
+            # exact zeros without touching the kernels
+            return lax.cond(
+                spec_live(spec, cfg.window),
+                lambda _: _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r,
+                                    scale, spec, segments=segs),
+                lambda _: (jnp.zeros((b, n, s, d), jnp.float32),
+                           jnp.zeros(k.shape, jnp.float32),
+                           jnp.zeros(v.shape, jnp.float32)),
+                None)
         return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec,
                          segments=segs)
 

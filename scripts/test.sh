@@ -2,9 +2,24 @@
 # Test launcher (reference test/test.sh:6 analogue).  No torchrun, no GPU
 # fleet: the distributed tests run on a simulated 8-device CPU mesh anywhere;
 # pass --tpu to also run the real-hardware kernel tests on this machine.
+# --fast selects the <10-min lane (-m "not slow"); default runs everything.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-python -m pytest tests/ -q "${@/--tpu/}"
-if [[ " $* " == *" --tpu "* ]]; then
+args=("$@")
+filtered=()
+fast=0; tpu=0
+for a in "${args[@]}"; do
+  case "$a" in
+    --fast) fast=1 ;;
+    --tpu) tpu=1 ;;
+    *) filtered+=("$a") ;;
+  esac
+done
+if [[ $fast == 1 ]]; then
+  python -m pytest tests/ -q -m "not slow" ${filtered[@]+"${filtered[@]}"}
+else
+  python -m pytest tests/ -q ${filtered[@]+"${filtered[@]}"}
+fi
+if [[ $tpu == 1 ]]; then
   BURST_TESTS_TPU=1 python -m pytest tests/test_fused_bwd.py tests/test_pallas.py -q
 fi

@@ -20,3 +20,70 @@ if not os.environ.get("BURST_TESTS_TPU"):
     # deterministic f32 CPU matmuls for the numerics oracle; NOT set on TPU
     # (it would force multi-pass f32 MXU matmuls and breaks Mosaic bf16 dots)
     jax.config.update("jax_default_matmul_precision", "highest")
+
+
+# ---------------------------------------------------------------------------
+# fast/slow split: tests measured >= ~19 s under contention (the top-60 of a
+# full-suite --durations run, 2026-07-31, total 4591 s) are marked slow here
+# in ONE place rather than as decorators in 15 files, so the list can be
+# regenerated mechanically from any fresh --durations log.
+# `pytest -m "not slow"` = the fast lane (~10 min); full suite for releases.
+
+_SLOW = {
+    ("test_burst.py", "test_causal_double_ring"),
+    ("test_burst.py", "test_causal_single_ring"),
+    ("test_burst.py", "test_cross_attention_lengths"),
+    ("test_burst.py", "test_gqa"),
+    ("test_burst.py", "test_noncausal"),
+    ("test_burst.py", "test_pallas_backend_in_ring_interpret"),
+    ("test_burst.py", "test_pallas_striped_triangular_in_ring_interpret"),
+    ("test_burst.py", "test_segments_double_ring_gqa"),
+    ("test_burst.py", "test_segments_no_case_split"),
+    ("test_burst.py", "test_segments_noncausal"),
+    ("test_burst.py", "test_segments_single_ring"),
+    ("test_burst.py", "test_small_world_2"),
+    ("test_burst.py", "test_uniform_spec_path_no_case_split"),
+    ("test_burst.py", "test_unoptimized_bwd_comm"),
+    ("test_checkpoint.py", "test_save_restore_roundtrip"),
+    ("test_decode.py", "test_generate_greedy_matches_recompute"),
+    ("test_decode.py", "test_moe_decode_chunked_prefill_matches_forward"),
+    ("test_dist_decode.py", "test_dist_prefill_matches_single_device"),
+    ("test_model.py", "test_double_ring_model"),
+    ("test_model.py", "test_forward_matches_single_device"),
+    ("test_model.py", "test_moe_forward_matches_dense_expert_compute"),
+    ("test_model.py", "test_moe_model_trains"),
+    ("test_model.py", "test_moe_model_trains_with_remat"),
+    ("test_model.py", "test_train_step_decreases_loss"),
+    ("test_moe.py", "test_ep_sharded_matches_dense"),
+    ("test_moe.py", "test_grads_flow"),
+    ("test_packed_training.py", "test_packed_doc_isolated_from_prefix"),
+    ("test_packed_training.py", "test_packed_pp_matches_no_pp"),
+    ("test_packed_training.py", "test_packed_train_step_runs"),
+    ("test_pallas.py", "test_single_device_flash_attention"),
+    ("test_pipeline.py", "test_pipeline_grads_match"),
+    ("test_pp_model.py", "test_pp_double_ring_parity"),
+    ("test_pp_model.py", "test_pp_dp_sp_train_step"),
+    ("test_pp_model.py", "test_pp_loss_and_grad_parity"),
+    ("test_pp_model.py", "test_pp_moe_ep_parity"),
+    ("test_pp_model.py", "test_pp_pallas_backend_parity"),
+    ("test_pp_model.py", "test_pp_tp_moe_combined_parity"),
+    ("test_pp_model.py", "test_pp_tp_sp_parity"),
+    ("test_runner.py", "test_fit_pp_with_checkpoint_resume"),
+    ("test_runner.py", "test_fit_resume_continues_stream"),
+    ("test_runner.py", "test_grad_accum_exact_with_uneven_masking"),
+    ("test_runner.py", "test_grad_accum_matches_full_batch"),
+    ("test_schedule.py", "test_schedule_matches_host_expectation"),
+    ("test_ulysses.py", "test_ulysses_fwd_grad"),
+    ("test_window.py", "test_burst_ring_window_grad"),
+    ("test_window.py", "test_decode_window_matches_forward"),
+    ("test_window.py", "test_model_trains_with_window"),
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        key = (item.path.name, item.originalname or item.name)
+        if key in _SLOW:
+            item.add_marker(pytest.mark.slow)

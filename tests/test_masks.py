@@ -42,3 +42,26 @@ def test_round_spec_matches_global_mask(layout, W, causal):
 def test_full_spec_is_all_ones():
     m = np.asarray(dense_mask(full_spec(8, 12), 8, 12))
     assert m.all() and m.shape == (8, 12)
+
+
+def test_spec_live():
+    """Dead-round detection (ring kernel-launch skipping): contig-causal
+    futures and out-of-band windowed rounds are dead; everything that has
+    one visible element is live."""
+    import jax.numpy as jnp
+
+    from burst_attn_tpu.ops.masks import round_spec, spec_live, dense_mask
+
+    s = 16
+    for layout in ("contig",):
+        for qp in range(4):
+            for kp in range(4):
+                for window in (None, 4, 16, 40):
+                    spec = round_spec(jnp.int32(qp), jnp.int32(kp), s, s,
+                                      True, layout, window=window)
+                    want = bool(dense_mask(spec, s, s, window=window).any())
+                    got = bool(spec_live(spec, window))
+                    assert got == want, (layout, qp, kp, window)
+    # non-causal full tiles are always live
+    spec = round_spec(jnp.int32(3), jnp.int32(0), s, s, False, "contig")
+    assert bool(spec_live(spec))
