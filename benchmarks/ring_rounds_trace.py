@@ -1,0 +1,64 @@
+"""Single-chip trace of two chained flash_fwd ring rounds (docs §5.1).
+
+A W=1 ring has no permute, but the KERNEL side of the overlap story is
+observable on one chip: two back-to-back `flash_fwd` rounds with the
+carry-in state are exactly what each device executes per ring round, and
+the XProf trace shows whether the second round's DMA warm-up hides behind
+the first round's tail (the intra-kernel analogue of the scan-level
+overlap the scheduler provides between permute and compute).
+
+    python -m benchmarks.ring_rounds_trace --trace-dir results/trace_rounds
+"""
+
+import argparse
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=16384)
+    ap.add_argument("--heads", type=int, default=32)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--trace-dir", default="results/trace_rounds")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print("ring_rounds_trace: not on TPU; refusing", file=sys.stderr)
+        sys.exit(1)
+
+    from burst_attn_tpu.ops.masks import round_spec
+    from burst_attn_tpu.ops.pallas_flash import flash_fwd
+    from burst_attn_tpu.ops.tile import finalize, init_state
+
+    b, n, s, d = 1, args.heads, args.seq, args.dim
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    q = jax.random.normal(ks[0], (b, n, s, d), jnp.bfloat16)
+    k0 = jax.random.normal(ks[1], (b, n, s, d), jnp.bfloat16)
+    v0 = jax.random.normal(ks[2], (b, n, s, d), jnp.bfloat16)
+    k1 = jax.random.normal(ks[3], (b, n, s, d), jnp.bfloat16)
+    v1 = jax.random.normal(ks[4], (b, n, s, d), jnp.bfloat16)
+    scale = d**-0.5
+    # two rounds as a striped ring sees them: own partition (offset 0) then
+    # the neighbor's (offset -1) — both full-window causal tri grids
+    spec0 = round_spec(jnp.int32(1), jnp.int32(1), s, s, True, "striped")
+    spec1 = round_spec(jnp.int32(1), jnp.int32(0), s, s, True, "striped")
+
+    @jax.jit
+    def two_rounds(q, k0, v0, k1, v1):
+        st = init_state(b, n, s, d)
+        st = flash_fwd(q, k0, v0, *st, scale, spec0, triangular=True)
+        st = flash_fwd(q, k1, v1, *st, scale, spec1, triangular=True)
+        return jnp.sum(finalize(*st, q.dtype).astype(jnp.float32))
+
+    print(float(two_rounds(q, k0, v0, k1, v1)), flush=True)  # compile+warm
+    with jax.profiler.trace(args.trace_dir):
+        for _ in range(3):
+            r = float(two_rounds(q, k0, v0, k1, v1))
+    print(f"trace written to {args.trace_dir} (result {r})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

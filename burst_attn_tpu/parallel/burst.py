@@ -48,7 +48,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops import tile as jnp_tile
 from ..ops.masks import full_spec, round_spec, spec_live
-from .ring import ppermute_next, my_partition, partition_at_round
+from .ring import ppermute_by, ppermute_next, my_partition, partition_at_round
 
 
 @dataclass(frozen=True)
@@ -159,6 +159,16 @@ def _sizes(cfg):
     return inter, intra
 
 
+def _r_live(cfg, s, s_kv, n_inter, n_intra):
+    """Static live-round count of a windowed SINGLE contig ring (shared by
+    fwd and bwd — the two passes' truncation must stay in lockstep with
+    ops/masks.spec_live's band algebra).  n_intra = no truncation."""
+    if (cfg.window is not None and n_inter == 1 and n_intra > 1
+            and s_kv == s):
+        return min(n_intra, (s + cfg.window - 2) // s + 1)
+    return n_intra
+
+
 # ---------------------------------------------------------------------------
 # forward
 
@@ -249,6 +259,14 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
                 st)
         return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec, segments=segs)
 
+    # Static round truncation (windowed single ring): round r's kv offset is
+    # delta = r*s for r <= me and negative (future, dead) past that, so
+    # every round >= r_live is dead on EVERY device — don't run them and
+    # don't pay their kv permutes.  (The double ring keeps the per-round
+    # lax.cond skip instead: its visit order interleaves inter hops, so the
+    # live set is not a prefix.)
+    r_live = _r_live(cfg, s, k.shape[2], n_inter, n_intra)
+
     kv = (k, v) if seg is None else (k, v, seg)
     kv_base = kv
     for c in range(n_inter):
@@ -256,7 +274,7 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
             # prefetch next cycle's base one full intra-cycle early
             # (reference: comm.py:229-237); consumed at the cycle boundary.
             kv_base_next = ppermute_next(kv_base, cfg.inter_axis)
-        if n_intra > 1:
+        if r_live > 1:
 
             def body(carry, s_idx, c=c):
                 kv_c, st = carry
@@ -264,9 +282,9 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
                 st = compute(st, kv_c, c * n_intra + s_idx)
                 return (kv_next, st), None
 
-            (kv, state), _ = lax.scan(body, (kv, state), jnp.arange(n_intra - 1))
+            (kv, state), _ = lax.scan(body, (kv, state), jnp.arange(r_live - 1))
         # last round of the cycle: no intra send (reference comm.py:238-251)
-        state = compute(state, kv, jnp.int32(c * n_intra + n_intra - 1))
+        state = compute(state, kv, jnp.int32(c * n_intra + r_live - 1))
         if c < n_inter - 1:
             kv = kv_base = kv_base_next
     m, lse, acc = state
@@ -381,6 +399,20 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
         return _tile_bwd(cfg, do_r, q_r, k, v, delta_r, lse_r, scale, spec,
                          segments=segs)
 
+    # Static round truncation, bwd roles (fwd comment in _fwd_impl): with the
+    # q side rotating, round r's offset is delta = -r*s (dead causally) for
+    # r <= me and (W-r)*s past the wrap — so the LIVE rounds are round 0
+    # plus a tail of r_live-1 rounds at the end of the schedule.  The
+    # payload jumps the dead middle in ONE ppermute (an arbitrary
+    # permutation costs one collective regardless of hop count) instead of
+    # paying a q-sized transfer per dead round.  Round 0's dq (the OWN
+    # chunk's gradient) does not ride along at all — a full circle would
+    # return it exactly where it started — it is held out in dq_home and
+    # folded in after the ring's return-home hop.
+    r_live = _r_live(cfg, s, k.shape[2], n_inter, n_intra)
+    truncated = r_live < n_intra
+    dq_home = None
+
     pay_base = payload
     for c in range(n_inter):
         if c < n_inter - 1:
@@ -393,12 +425,19 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
             dq_intra = jnp.zeros_like(dq_intra)
         # ---- first round of the cycle (r = c*I): no dq rotation ----
         dqc, dkc, dvc = compute(payload, jnp.int32(c * n_intra))
-        dq_intra = dq_intra + dqc
+        if truncated:
+            dq_home = dqc
+        else:
+            dq_intra = dq_intra + dqc
         dk = dk + dkc
         dv = dv + dvc
-        if n_intra > 1:
-            payload = ppermute_next(payload, cfg.intra_axis)
-            if n_intra > 2:
+        if r_live > 1:
+            # start == 1 without truncation; the jump is a single hop then.
+            # dq_intra is still all-zero at the jump when truncated
+            # (rotation-invariant), so only the payload travels.
+            start = n_intra - (r_live - 1)
+            payload = ppermute_by(payload, cfg.intra_axis, start)
+            if n_intra - 1 > start:
 
                 def body(carry, s_idx, c=c):
                     pay, dq_i, dk_c, dv_c = carry
@@ -410,7 +449,8 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
                     return (pay_next, dq_rot + dqc, dk_c + dkc, dv_c + dvc), None
 
                 (payload, dq_intra, dk, dv), _ = lax.scan(
-                    body, (payload, dq_intra, dk, dv), jnp.arange(1, n_intra - 1)
+                    body, (payload, dq_intra, dk, dv),
+                    jnp.arange(start, n_intra - 1)
                 )
             # ---- last round of the cycle: rotate dq but not the payload ----
             dq_rot = ppermute_next(dq_intra, cfg.intra_axis)
@@ -422,11 +462,15 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
             payload = pay_base = pay_base_next
 
     # final return-home hops (reference burst_attn_interface.py:391-396,
-    # comm.py:206-216): fold, one inter hop, one intra hop.
+    # comm.py:206-216): fold, one inter hop, one intra hop; then the
+    # held-out round-0 dq (truncated rings only — it never traveled).
     dq = dq_inter + dq_intra
     if cfg.inter_axis is not None:
         dq = ppermute_next(dq, cfg.inter_axis)
-    dq = ppermute_next(dq, cfg.intra_axis)
+    if r_live > 1:
+        dq = ppermute_next(dq, cfg.intra_axis)
+    if dq_home is not None:
+        dq = dq + dq_home
     return dq, dk, dv
 
 

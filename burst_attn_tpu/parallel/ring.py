@@ -22,8 +22,21 @@ from jax import lax
 
 def ppermute_next(x, axis_name: str):
     """Rotate a pytree one hop forward (rank i -> i+1) along a mesh axis."""
+    return ppermute_by(x, axis_name, 1)
+
+
+def ppermute_by(x, axis_name: str, hops: int):
+    """Rotate a pytree `hops` positions forward in ONE collective.
+
+    A ppermute is an arbitrary permutation — jumping h hops costs one
+    collective, not h.  The windowed ring uses this to skip its dead
+    middle rounds (parallel/burst.py round truncation) without paying
+    their payload traffic.  hops is static; hops % world == 0 is a no-op."""
     n = lax.axis_size(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    h = hops % n
+    if h == 0:
+        return x
+    perm = [(i, (i + h) % n) for i in range(n)]
     return jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), x)
 
 
