@@ -8,6 +8,7 @@ from .paged_decode import (
     paged_decode_step, paged_prefill, provision_capacity, retire_slot,
 )
 from .pipeline_lm import stack_layers, unstack_layers
+from .serve import ServeEngine
 
 __all__ = [
     "sample_logits",
@@ -40,4 +41,5 @@ __all__ = [
     "paged_prefill",
     "provision_capacity",
     "retire_slot",
+    "ServeEngine",
 ]

@@ -1,0 +1,198 @@
+"""Continuous-batching serving engine over the paged KV stack.
+
+`models/paged_decode.py` provides the primitives (page pool, ragged paged
+attention, prefill/decode steps); this module is the host-side ENGINE a
+server actually runs:
+
+  * `ServeEngine.submit(tokens, max_new_tokens)` queues a request.
+  * `ServeEngine.step()` advances the world by one token: admits queued
+    requests into free slots whenever the pool can cover their prompt AND
+    their whole decode budget (admission control = page accounting, so a
+    mid-generation OOM is impossible by construction), runs ONE jitted
+    decode step for every live slot, retires finished sequences (EOS or
+    budget), and returns the newly finished (id, tokens) pairs.
+  * `ServeEngine.run()` loops `step()` until no work remains.
+
+Design notes (TPU-shaped):
+  * Device arrays never change shape — admission/retirement only rewrites
+    the page table and lengths, so the decode step stays one compiled
+    program no matter how requests come and go (paged_decode.py's core
+    contract).
+  * All per-slot bookkeeping (budgets, emitted tokens, EOS checks) is
+    host-side python over ONE [slots] logits fetch per step — the engine
+    adds no device chatter beyond the step itself.
+  * Sampling uses decode.sample_logits on-device for the whole batch;
+    per-slot temperature is intentionally NOT supported (it would split
+    the batch into per-slot programs).
+
+Reference parity: none — the reference is an attention op library with no
+serving story (SURVEY.md §5); this is framework surface beyond it.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import sample_logits
+from .paged_decode import (
+    init_paged_state, paged_decode_step, paged_prefill, provision_capacity,
+    retire_slot,
+)
+from .transformer import ModelConfig
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray          # [T] int32
+    max_new_tokens: int
+    tokens: List[int] = field(default_factory=list)  # generated so far
+
+
+class ServeEngine:
+    """Host-side continuous-batching loop.  Not thread-safe; drive it from
+    one thread (the usual asyncio/executor server pattern)."""
+
+    def __init__(self, params, cfg: ModelConfig, *, slots: int, n_pages: int,
+                 page: int = 128, max_pages_per_seq: int = 64,
+                 quantize: bool = False, mesh=None, eos_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k=None, top_p=None, rng=None):
+        self.params = params
+        self.cfg = cfg
+        self.mesh = mesh
+        self.eos_id = eos_id
+        self.page = page
+        self.temperature = temperature
+        self.top_k, self.top_p = top_k, top_p
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.state, self.pool = init_paged_state(
+            cfg, slots=slots, n_pages=n_pages, page=page,
+            max_pages_per_seq=max_pages_per_seq, quantize=quantize)
+        self.slots: List[Optional[_Request]] = [None] * slots
+        self._next_tok = np.zeros((slots,), np.int32)
+        self._queue: List[_Request] = []
+        self._next_id = 0
+        self._finished: Dict[int, List[int]] = {}
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, tokens, max_new_tokens: int) -> int:
+        """Queue a prompt; returns a request id (tokens appear in
+        step() results / results() once finished)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size == 0:
+            raise ValueError("empty prompt")
+        need = self._pages_for(tokens.size, max_new_tokens)
+        if need > self.state.page_table.shape[1]:
+            raise ValueError(
+                f"request needs {need} pages > max_pages_per_seq "
+                f"{self.state.page_table.shape[1]}")
+        if need > self.pool.n_pages - 1:  # page 0 is the reserved sink
+            # a permanently unservable request would deadlock the FIFO
+            # queue (admission waits forever for pages that cannot exist)
+            raise ValueError(
+                f"request needs {need} pages but the pool only has "
+                f"{self.pool.n_pages - 1} usable pages total")
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append(_Request(rid, tokens, max_new_tokens))
+        return rid
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    @property
+    def live(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    def results(self) -> Dict[int, List[int]]:
+        return dict(self._finished)
+
+    def run(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
+        """Drive step() until every submitted request finishes."""
+        for _ in range(max_steps):
+            if not self._queue and self.live == 0:
+                return self.results()
+            self.step()
+        raise RuntimeError(f"run() exceeded {max_steps} steps")
+
+    # -- engine ------------------------------------------------------------
+
+    def _pages_for(self, prompt_len: int, max_new: int) -> int:
+        return -(-(prompt_len + max_new) // self.page)
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots while the pool can cover
+        their FULL lifetime (prompt pages now + decode pages provisioned
+        up front — admission is the only allocation point)."""
+        for slot, occupant in enumerate(self.slots):
+            if occupant is not None or not self._queue:
+                continue
+            req = self._queue[0]
+            if self._pages_for(len(req.prompt), req.max_new_tokens) > \
+                    self.pool.available:
+                break  # FIFO: don't starve the head by admitting behind it
+            self._queue.pop(0)
+            logits, self.state = paged_prefill(
+                self.params, jnp.asarray(req.prompt), self.state, self.pool,
+                slot, self.cfg, mesh=self.mesh)
+            self.state = provision_capacity(
+                self.state, self.pool, slot, req.max_new_tokens)
+            tok = self._sample(logits[None, :])[0]
+            req.tokens.append(int(tok))
+            self.slots[slot] = req
+            self._next_tok[slot] = int(tok)
+
+    def _sample(self, logits):
+        self._rng, key = jax.random.split(self._rng)
+        return np.asarray(sample_logits(
+            logits, key, temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p))
+
+    def _retire_finished(self) -> List[Tuple[int, List[int]]]:
+        done = []
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            hit_eos = self.eos_id is not None and req.tokens \
+                and req.tokens[-1] == self.eos_id
+            if hit_eos or len(req.tokens) >= req.max_new_tokens:
+                self.state = retire_slot(self.state, self.pool, slot)
+                self.slots[slot] = None
+                self._finished[req.rid] = req.tokens
+                done.append((req.rid, req.tokens))
+        return done
+
+    def step(self) -> List[Tuple[int, List[int]]]:
+        """One engine tick: retire -> admit -> one decode step for every
+        live slot.  Returns requests that finished THIS tick.
+
+        Admit and retire alternate until stable: a freshly admitted request
+        can already be complete (max_new_tokens == 1, or the prefill-sampled
+        token IS eos) and must retire — and free its slot for the next
+        queued request — WITHOUT running a decode step, or it would receive
+        a token past its budget / past EOS and break parity with
+        generate()."""
+        done = self._retire_finished()
+        while True:
+            before = self.pending
+            self._admit()
+            done += self._retire_finished()
+            if self.pending == before:
+                break
+        if self.live == 0:
+            return done
+        logits, self.state = paged_decode_step(
+            self.params, jnp.asarray(self._next_tok), self.state, self.cfg,
+            mesh=self.mesh)
+        toks = self._sample(logits)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            req.tokens.append(int(toks[slot]))
+            self._next_tok[slot] = int(toks[slot])
+        return done

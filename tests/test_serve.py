@@ -1,0 +1,126 @@
+"""Continuous-batching serving engine (models/serve.py): greedy parity
+with the dense-cache generate() per request, staggered admission when
+requests outnumber slots, EOS stop, and pool accounting across the whole
+request lifecycle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from burst_attn_tpu.models import ModelConfig, init_params
+from burst_attn_tpu.models.decode import generate
+from burst_attn_tpu.models.serve import ServeEngine
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(
+        vocab=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_head=16,
+        d_ff=128, block_q=8, block_kv=8, attn_backend="jnp", remat=False,
+        dtype=jnp.float32, batch_axis=None, head_axis=None,
+    )
+    return cfg, init_params(jax.random.PRNGKey(0), cfg)
+
+
+def _prompts(cfg, lengths, seed=11):
+    out = []
+    for i, t in enumerate(lengths):
+        out.append(np.asarray(jax.random.randint(
+            jax.random.PRNGKey(seed + i), (t,), 1, cfg.vocab), np.int32))
+    return out
+
+
+def test_engine_matches_solo_generate(model):
+    """Four requests of different lengths through TWO slots (forcing
+    staggered admission and slot reuse) produce exactly the tokens each
+    request gets from a solo dense-cache greedy decode."""
+    cfg, params = model
+    prompts = _prompts(cfg, [9, 5, 12, 7])
+    steps = [5, 4, 3, 6]
+
+    eng = ServeEngine(params, cfg, slots=2, n_pages=10, page=128,
+                      max_pages_per_seq=3)
+    rids = [eng.submit(p, s) for p, s in zip(prompts, steps)]
+    got = eng.run()
+    assert eng.pool.available == 9  # every page returned
+
+    for rid, p, s in zip(rids, prompts, steps):
+        want = np.asarray(generate(params, p[None], cfg, steps=s,
+                                   max_seq=256))[0]
+        np.testing.assert_array_equal(np.asarray(got[rid]), want,
+                                      err_msg=f"request {rid}")
+
+
+def test_engine_eos_stops_and_frees_slot(model):
+    """A request that samples EOS retires early; its slot and pages are
+    reused by a queued request."""
+    cfg, params = model
+    (p0,) = _prompts(cfg, [9], seed=31)
+    # find what greedy emits so we can designate token #2 as "EOS"
+    ref = np.asarray(generate(params, p0[None], cfg, steps=3, max_seq=256))[0]
+    eos = int(ref[1])
+
+    (p1,) = _prompts(cfg, [6], seed=41)
+    eng = ServeEngine(params, cfg, slots=1, n_pages=6, page=128,
+                      max_pages_per_seq=2, eos_id=eos)
+    r0 = eng.submit(p0, 10)   # would run 10 without EOS
+    r1 = eng.submit(p1, 2)
+    got = eng.run()
+    assert got[r0] == [int(ref[0]), eos]  # stopped AT the eos token
+    assert len(got[r1]) == 2              # admitted after r0 freed the slot
+    assert eng.pool.available == 5
+
+
+def test_engine_admission_control(model):
+    """A request whose lifetime exceeds the free pool waits (FIFO, no
+    starvation) instead of failing mid-generation."""
+    cfg, params = model
+    pa, pb = _prompts(cfg, [100, 100], seed=51)
+    # pool of 3 usable pages; each request needs 2 (ceil((100+30)/128)=2)
+    eng = ServeEngine(params, cfg, slots=2, n_pages=4, page=128,
+                      max_pages_per_seq=2)
+    ra = eng.submit(pa, 30)
+    rb = eng.submit(pb, 30)
+    eng.step()
+    assert eng.live == 1 and eng.pending == 1  # only one fits at a time
+    got = eng.run()
+    assert len(got[ra]) == 30 and len(got[rb]) == 30
+    assert eng.pool.available == 3
+
+
+def test_engine_single_token_and_prefill_eos(model):
+    """Corner ticks: a max_new_tokens=1 request gets EXACTLY one token
+    (no decode past budget), and a request whose FIRST sampled token is
+    EOS stops there — both retiring without a decode step, freeing the
+    slot for the queue in the same tick."""
+    cfg, params = model
+    (p0,) = _prompts(cfg, [9], seed=61)
+    want1 = np.asarray(generate(params, p0[None], cfg, steps=1,
+                                max_seq=256))[0]
+
+    eng = ServeEngine(params, cfg, slots=1, n_pages=4, page=128,
+                      max_pages_per_seq=2)
+    r0 = eng.submit(p0, 1)
+    (p1,) = _prompts(cfg, [5], seed=62)
+    r1 = eng.submit(p1, 2)
+    got = eng.run()
+    np.testing.assert_array_equal(np.asarray(got[r0]), want1)
+    assert len(got[r1]) == 2
+    assert eng.pool.available == 3
+
+    # prefill-sampled EOS: designate the solo run's FIRST token as eos
+    eos = int(want1[0])
+    eng2 = ServeEngine(params, cfg, slots=1, n_pages=4, page=128,
+                       max_pages_per_seq=2, eos_id=eos)
+    r2 = eng2.submit(p0, 10)
+    got2 = eng2.run()
+    assert got2[r2] == [eos]  # stopped at the prefill token, no decode
+
+
+def test_engine_rejects_unservable_request(model):
+    cfg, params = model
+    eng = ServeEngine(params, cfg, slots=1, n_pages=3, page=128,
+                      max_pages_per_seq=64)
+    with pytest.raises(ValueError, match="usable pages total"):
+        eng.submit(np.ones(300, np.int32), 200)  # needs 4 > 2 usable

@@ -440,3 +440,28 @@ def test_ring_truncation_matches_dense(window):
     for name, a, b in zip(("dq", "dk", "dv"), gr, g):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+def test_window_double_ring_matches_dense():
+    """Windowed contig attention on the 2x4 DOUBLE ring: the static
+    truncation declines (non-prefix live set) and the spec_live lax.cond
+    carries the dead-round skipping alone — values and grads vs oracle."""
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("inter", "intra"))
+    window = 100
+    q, k, v, do = _inputs(512, seed=19)
+
+    def ring(q, k, v):
+        return bat.burst_attn(q, k, v, mesh=mesh,
+                              seq_axes=("inter", "intra"), causal=True,
+                              layout="contig", backend="jnp", window=window)
+
+    ref = banded_dense(q, k, v, window)
+    np.testing.assert_allclose(np.asarray(ring(q, k, v)), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    g = jax.grad(lambda q, k, v: jnp.sum(ring(q, k, v) * do),
+                 argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(banded_dense(q, k, v, window) * do),
+                  argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), gr, g):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=2e-5, err_msg=name)
