@@ -30,6 +30,11 @@ def main(argv=None):
     ap.add_argument("--page", type=int, default=128)
     ap.add_argument("--quantize", action="store_true",
                     help="int8 page pools with per-token dequant scales")
+    ap.add_argument("--churn", type=int, default=0,
+                    help="N > 0: third phase — N requests (2x slots queue "
+                         "depth) through the ServeEngine with staggered "
+                         "budgets, measuring end-to-end tokens/s including "
+                         "admission/retirement churn")
     ap.add_argument("--out", default="results/serve.jsonl")
     args = ap.parse_args(argv)
 
@@ -114,6 +119,41 @@ def main(argv=None):
             "quantize": args.quantize,
             "step_ms": round(dt * 1e3, 2),
             "tokens_per_s": round(args.slots / dt, 1)})
+
+    if args.churn > 0:
+        # end-to-end engine throughput WITH request turnover: staggered
+        # budgets force continuous retirement + admission, the regime a
+        # server actually runs in (the decode phase above is the
+        # steady-state upper bound)
+        import numpy as np
+
+        from burst_attn_tpu.models.serve import ServeEngine
+
+        del state  # free the phase-1/2 pools before allocating the engine's
+        n_req = args.churn
+        budgets = [args.decode_steps // 2 + (i % 4) * (args.decode_steps // 4)
+                   for i in range(n_req)]
+        pages_per_req = -(-(args.context + max(budgets)) // args.page)
+        eng = ServeEngine(
+            params, cfg, slots=args.slots,
+            n_pages=args.slots * pages_per_req + 2, page=args.page,
+            max_pages_per_seq=pages_per_req, quantize=args.quantize)
+        rng = np.random.RandomState(0)
+        for i in range(n_req):
+            eng.submit(rng.randint(1, cfg.vocab, args.context), budgets[i])
+        # warm the prefill+decode compiles outside the timed region — and
+        # exclude the tokens that warm step produced from the numerator
+        eng.step()
+        warm_tokens = (sum(len(r.tokens) for r in eng.slots if r is not None)
+                       + sum(len(v) for v in eng.results().values()))
+        t0 = time.perf_counter()
+        out = eng.run()
+        wall = time.perf_counter() - t0
+        total = sum(len(v) for v in out.values()) - warm_tokens
+        record({"phase": "churn", "requests": n_req, "slots": args.slots,
+                "context": args.context, "quantize": args.quantize,
+                "total_tokens": total, "wall_s": round(wall, 2),
+                "tokens_per_s": round(total / wall, 1)})
 
 
 if __name__ == "__main__":

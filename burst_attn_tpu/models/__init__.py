@@ -4,7 +4,7 @@ from .train import (TrainConfig, make_mesh, init_train_state, train_step,
 from .decode import Cache, forward_cached, generate, init_cache, prefill, sample_logits
 from .dist_decode import DistCache, dist_generate, dist_prefill
 from .paged_decode import (
-    PagePool, PagedState, ensure_capacity, init_paged_state,
+    PagePool, PagedState, PrefixCache, ensure_capacity, init_paged_state,
     paged_decode_step, paged_prefill, provision_capacity, retire_slot,
 )
 from .pipeline_lm import stack_layers, unstack_layers
@@ -35,6 +35,7 @@ __all__ = [
     "dist_prefill",
     "PagePool",
     "PagedState",
+    "PrefixCache",
     "ensure_capacity",
     "init_paged_state",
     "paged_decode_step",

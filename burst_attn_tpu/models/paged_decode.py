@@ -134,13 +134,16 @@ class PagedState(NamedTuple):
 
 
 class PagePool:
-    """Host-side page allocator for a PagedState.
+    """Host-side REFCOUNTED page allocator for a PagedState.
 
     Not a jax object: allocation decisions happen between jitted steps.
-    `acquire(n)` pops page ids from the free list (raises if exhausted —
-    callers use `available` for admission control); `release(ids)` returns
-    them.  The pool never touches device memory: pages are recycled by
-    table rewrite, stale contents are simply never addressed.
+    `acquire(n)` pops page ids from the free list at refcount 1 (raises if
+    exhausted — callers use `available` for admission control);
+    `release(ids)` decrements and returns a page to the free list when its
+    count reaches zero; `share(ids)` increments (prefix caching: the same
+    physical page referenced from several sequences' table rows and/or the
+    prefix cache).  The pool never touches device memory: pages are
+    recycled by table rewrite, stale contents are simply never addressed.
     """
 
     def __init__(self, n_pages: int):
@@ -150,33 +153,175 @@ class PagePool:
         # own keeps live pages clobber-free without per-slot predication.
         self.n_pages = n_pages
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
-        self._free_set = set(self._free)
+        self._refs = [0] * n_pages
 
     @property
     def available(self) -> int:
         return len(self._free)
+
+    def refcount(self, i: int) -> int:
+        return self._refs[int(i)]
 
     def acquire(self, n: int) -> List[int]:
         if n > len(self._free):
             raise RuntimeError(
                 f"page pool exhausted: want {n}, have {len(self._free)}")
         out = [self._free.pop() for _ in range(n)]
-        self._free_set.difference_update(out)
+        for i in out:
+            self._refs[i] = 1
         return out
 
-    def release(self, ids) -> None:
-        # a double release would put the page on the free list twice and
-        # later hand it to two live sequences — corrupt both, silently
+    def share(self, ids) -> None:
+        """Add one reference to already-live pages (prefix reuse)."""
         ids = [int(i) for i in ids]
-        if len(set(ids)) != len(ids):
-            raise ValueError(f"duplicate page ids in release: {ids}")
         for i in ids:
+            if not 0 < i < self.n_pages:
+                raise ValueError(f"bad page id {i}")
+            if self._refs[i] == 0:
+                raise ValueError(f"page {i} is free; share() needs a live page")
+        for i in ids:
+            self._refs[i] += 1
+
+    def release(self, ids) -> None:
+        # an over-release would put the page on the free list while another
+        # sequence still references it — corrupt both, silently
+        ids = [int(i) for i in ids]
+        counts: dict = {}
+        for i in ids:
+            counts[i] = counts.get(i, 0) + 1
+        for i, c in counts.items():
             if not 0 < i < self.n_pages:  # page 0 is the reserved sink
                 raise ValueError(f"bad page id {i}")
-            if i in self._free_set:
-                raise ValueError(f"page {i} released while already free")
-        self._free.extend(ids)
-        self._free_set.update(ids)
+            if self._refs[i] < c:
+                raise ValueError(
+                    f"page {i} released {c}x but has {self._refs[i]} refs")
+        for i in ids:
+            self._refs[i] -= 1
+            if self._refs[i] == 0:
+                self._free.append(i)
+
+
+class PrefixCache:
+    """Host-side page-aligned prefix cache (vLLM-style automatic prefix
+    caching, restricted to FULL pages).
+
+    Maps the rolling hash of each full-page token prefix to the pool page
+    holding that page's K/V (one page id is valid across every layer's
+    pool — the table is layer-shared).  The cache owns ONE pool reference
+    per registered page, so cached pages survive their sequences retiring;
+    `evict(n)` drops the n least-recently-used entries and their refs.
+
+    Shared pages are never written: decode appends always target the
+    column at lengths//page, which lies beyond every full (cacheable)
+    page — so no copy-on-write is ever needed.
+    """
+
+    def __init__(self, pool: PagePool):
+        self._pool = pool
+        self._pages: "dict[bytes, int]" = {}   # prefix hash -> page id
+        self._lru: List[bytes] = []            # least recent first
+
+    @staticmethod
+    def chain(tokens, page: int) -> List[bytes]:
+        """Rolling hash per FULL page of `tokens` (1-D int array): entry i
+        identifies the whole prefix tokens[:(i+1)*page]."""
+        import hashlib
+
+        toks = np.asarray(tokens, np.int32)
+        out: List[bytes] = []
+        h = b""
+        for i in range(len(toks) // page):
+            h = hashlib.sha1(h + toks[i * page:(i + 1) * page].tobytes()
+                             ).digest()
+            out.append(h)
+        return out
+
+    def __len__(self):
+        return len(self._pages)
+
+    def _touch(self, h: bytes):
+        self._lru.remove(h)
+        self._lru.append(h)
+
+    def lookup(self, hashes: List[bytes]) -> List[int]:
+        """Longest cached prefix of `hashes`; bumps the pool refcount of
+        every returned page (caller owns the new references) and marks the
+        entries recently used."""
+        ids: List[int] = []
+        for h in hashes:
+            pid = self._pages.get(h)
+            if pid is None:
+                break
+            ids.append(pid)
+            self._touch(h)
+        self._pool.share(ids)
+        return ids
+
+    def insert(self, hashes: List[bytes], page_ids) -> None:
+        """Register pages for the (aligned, equal-length) hash list; the
+        cache takes one reference per NEWLY inserted page."""
+        assert len(hashes) == len(page_ids)
+        for h, pid in zip(hashes, page_ids):
+            if h in self._pages:
+                self._touch(h)
+                continue
+            self._pool.share([int(pid)])
+            self._pages[h] = int(pid)
+            self._lru.append(h)
+
+    def evict(self, n: int) -> int:
+        """Free up to n pages by dropping LRU entries whose page the cache
+        holds the LAST reference to (entries shared with live sequences
+        free nothing — evicting them would destroy reusable prefixes for
+        zero gain, so they are skipped).  Returns pages actually freed."""
+        freed = 0
+        for h in list(self._lru):
+            if freed >= n:
+                break
+            pid = self._pages[h]
+            if self._pool.refcount(pid) > 1:
+                continue  # a live sequence still shares it: freeing = 0
+            self._lru.remove(h)
+            self._pool.release([self._pages.pop(h)])
+            freed += 1
+        return freed
+
+
+def _suffix_attention(q, k, v, t_pre, window=None, use_flash=None):
+    """Causal attention of suffix queries (absolute positions t_pre..) over
+    the full [cached prefix + suffix] context: one offset MaskSpec — col j
+    visible from suffix row i iff j <= i + t_pre — instead of a separate
+    kernel (the same five-scalar tile contract the ring rounds use)."""
+    from ..ops.masks import MaskSpec
+
+    b, n, t_suf, d = q.shape
+    s_kv = k.shape[2]
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        from ..ops.pallas_flash import flash_fwd
+        from ..ops.tile import finalize, init_state
+
+        spec = MaskSpec(jnp.int32(0), jnp.int32(t_suf), jnp.int32(s_kv),
+                        jnp.int32(1), jnp.int32(t_pre))
+        st = init_state(b, n, t_suf, d)
+        m, lse, acc = flash_fwd(q, k, v, *st, d**-0.5, spec, window=window)
+        return finalize(m, lse, acc, q.dtype)
+    # CPU/tests: dense masked softmax (GQA via repeat; small shapes); the
+    # visibility mask comes from the shared oracle (ops/masks.dense_mask)
+    # so the band formula stays single-sourced with the kernels
+    from ..ops.masks import dense_mask
+
+    group = q.shape[1] // k.shape[1]
+    kf = jnp.repeat(k, group, axis=1).astype(jnp.float32)
+    vf = jnp.repeat(v, group, axis=1).astype(jnp.float32)
+    s = jnp.einsum("bnid,bnjd->bnij", q.astype(jnp.float32), kf) * d**-0.5
+    spec = MaskSpec(jnp.int32(0), jnp.int32(t_suf), jnp.int32(s_kv),
+                    jnp.int32(1), jnp.int32(t_pre))
+    s = jnp.where(dense_mask(spec, t_suf, s_kv, window=window), s,
+                  float("-inf"))
+    return jnp.einsum("bnij,bnjd->bnid", jax.nn.softmax(s, axis=-1),
+                      vf).astype(q.dtype)
 
 
 def init_paged_state(cfg: ModelConfig, *, slots: int, n_pages: int,
@@ -222,13 +367,20 @@ def _scatter_pages(pages, new, page_ids, scales=None):
 
 
 def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
-                  slot: int, cfg: ModelConfig, mesh=None):
+                  slot: int, cfg: ModelConfig, mesh=None,
+                  cache: Optional[PrefixCache] = None):
     """Absorb one prompt [T] into batch slot `slot`.
 
     Host-side wrapper: acquires ceil(T/page) pages, runs the jitted prompt
     pass (flash attention + paged K/V scatter), rewrites the slot's table
     row.  Returns (last-token logits [vocab] fp32, new PagedState); the
     acquired page ids are recorded in the returned state's table.
+
+    `cache` (PrefixCache, bf16/unsharded serving only): full pages whose
+    token prefix is cached are REUSED — their K/V is never recomputed, the
+    suffix runs a shorter prefill attending the cached context through an
+    offset spec (_suffix_attention) — and this prompt's own full pages are
+    registered for future requests.
 
     Tensor-parallel: pass the same `mesh` as paged_decode_step — the
     prompt's flash attention runs head-sharded through its own shard_map
@@ -245,6 +397,36 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
         raise RuntimeError(
             f"slot {slot} is still live (len {int(state.lengths[slot])}); "
             "retire_slot first or its pages leak")
+    if cache is not None:
+        if state.k_scales is not None:
+            raise ValueError("prefix caching with int8 pools is not "
+                             "supported (dequant scales are per-request)")
+        if mesh is not None:
+            raise ValueError("prefix caching with a tp mesh is not "
+                             "supported yet; pass cache=None")
+        hashes = PrefixCache.chain(tokens, page)
+        # always leave >= 1 suffix token: the caller needs last-token logits
+        hits = cache.lookup(hashes[: (t - 1) // page])
+        if hits:
+            t_pre = len(hits) * page
+            suffix = tokens[t_pre:]
+            n_suf = -(-int(suffix.shape[0]) // page)
+            ids = []
+            try:
+                # inside the try: an exhausted-pool acquire must release
+                # the lookup's hit references too, or they leak forever
+                ids = pool.acquire(n_suf)
+                logits, state = _paged_prefill_suffix_jit(
+                    params, suffix[None, :], state,
+                    jnp.asarray(hits, jnp.int32),
+                    jnp.asarray(ids, jnp.int32), jnp.int32(slot), cfg, t_pre)
+            except Exception:
+                pool.release(ids + hits)  # hits carry our lookup refs
+                raise
+            n_full = t // page
+            cache.insert(hashes[len(hits):n_full],
+                         ids[: n_full - len(hits)])
+            return logits[0], state
     ids = pool.acquire(n_need)
     try:
         logits, state = _paged_prefill_jit(
@@ -253,6 +435,8 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
     except Exception:
         pool.release(ids)
         raise
+    if cache is not None:
+        cache.insert(hashes[: t // page], ids[: t // page])
     return logits[0], state
 
 
@@ -309,6 +493,57 @@ def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
     return logits, PagedState(
         tuple(k_pools), tuple(v_pools), table, lengths,
         tuple(k_scs) if quant else None, tuple(v_scs) if quant else None)
+
+
+# static t_pre: one compile per (cached-page count, suffix-page count) pair —
+# the same page-count keying discipline as _paged_prefill_jit
+@partial(jax.jit, static_argnames=("cfg", "t_pre"), donate_argnums=(2,))
+def _paged_prefill_suffix_jit(params, tokens, state: PagedState, ctx_ids,
+                              suf_ids, slot, cfg: ModelConfig, t_pre: int):
+    """Prefill of a prompt whose first t_pre tokens' K/V already sit in
+    cached pages (ctx_ids): compute q/k/v for the SUFFIX only, attend the
+    gathered cached context + suffix through one offset spec, scatter the
+    suffix K/V into suf_ids, and point the slot's table row at
+    [ctx_ids | suf_ids]."""
+    b, t_suf = tokens.shape
+    page = state.k_pages[0].shape[2]
+    t_pad = -(-t_suf // page) * page
+    nkv, d_head = cfg.n_kv_heads, cfg.d_head
+    pos = t_pre + jnp.broadcast_to(jnp.arange(t_suf, dtype=jnp.int32)[None],
+                                   (b, t_suf))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    k_pools, v_pools = [], []
+    for p, kp, vp in zip(params["layers"], state.k_pages, state.v_pages):
+        q, k, v = _qkv_proj(p, x, pos, cfg)
+        # cached context, gathered page-contiguous: [n_ctx, Nkv, page, D]
+        # -> [1, Nkv, t_pre, D]
+        kc = jnp.moveaxis(kp[ctx_ids], 0, 1).reshape(nkv, t_pre, d_head)[None]
+        vc = jnp.moveaxis(vp[ctx_ids], 0, 1).reshape(nkv, t_pre, d_head)[None]
+        k_full = jnp.concatenate(
+            [kc.astype(cfg.dtype), k.astype(cfg.dtype)], axis=2)
+        v_full = jnp.concatenate(
+            [vc.astype(cfg.dtype), v.astype(cfg.dtype)], axis=2)
+        o = _suffix_attention(q, k_full, v_full, t_pre, window=cfg.window)
+        pad = [(0, 0), (0, 0), (0, t_pad - t_suf), (0, 0)]
+        kp2, _ = _scatter_pages(kp, jnp.pad(k, pad), suf_ids)
+        vp2, _ = _scatter_pages(vp, jnp.pad(v, pad), suf_ids)
+        k_pools.append(kp2)
+        v_pools.append(vp2)
+        x = x + _attn_out(p, o)
+        m, _ = _mlp(p, x, cfg, inference=True)
+        x = x + m
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,vd->bsv", x[:, -1:], params["lm_head"],
+                        preferred_element_type=jnp.float32)[:, 0]
+    row = jnp.concatenate([ctx_ids, suf_ids])
+    table = lax.dynamic_update_slice(
+        state.page_table,
+        jnp.pad(row, (0, state.page_table.shape[1] - row.shape[0]))[None, :],
+        (slot, jnp.int32(0)),
+    )
+    lengths = state.lengths.at[slot].set(t_pre + t_suf)
+    return logits, PagedState(
+        tuple(k_pools), tuple(v_pools), table, lengths, None, None)
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))

@@ -38,8 +38,8 @@ import numpy as np
 
 from .decode import sample_logits
 from .paged_decode import (
-    init_paged_state, paged_decode_step, paged_prefill, provision_capacity,
-    retire_slot,
+    PrefixCache, init_paged_state, paged_decode_step, paged_prefill,
+    provision_capacity, retire_slot,
 )
 from .transformer import ModelConfig
 
@@ -59,7 +59,8 @@ class ServeEngine:
     def __init__(self, params, cfg: ModelConfig, *, slots: int, n_pages: int,
                  page: int = 128, max_pages_per_seq: int = 64,
                  quantize: bool = False, mesh=None, eos_id: Optional[int] = None,
-                 temperature: float = 0.0, top_k=None, top_p=None, rng=None):
+                 temperature: float = 0.0, top_k=None, top_p=None, rng=None,
+                 prefix_cache: bool = False):
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -71,6 +72,9 @@ class ServeEngine:
         self.state, self.pool = init_paged_state(
             cfg, slots=slots, n_pages=n_pages, page=page,
             max_pages_per_seq=max_pages_per_seq, quantize=quantize)
+        if prefix_cache and (quantize or mesh is not None):
+            raise ValueError("prefix_cache requires bf16 pools and no tp mesh")
+        self.cache = PrefixCache(self.pool) if prefix_cache else None
         self.slots: List[Optional[_Request]] = [None] * slots
         self._next_tok = np.zeros((slots,), np.int32)
         self._queue: List[_Request] = []
@@ -85,6 +89,9 @@ class ServeEngine:
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
             raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens} (prefill always samples one)")
         need = self._pages_for(tokens.size, max_new_tokens)
         if need > self.state.page_table.shape[1]:
             raise ValueError(
@@ -133,13 +140,19 @@ class ServeEngine:
             if occupant is not None or not self._queue:
                 continue
             req = self._queue[0]
-            if self._pages_for(len(req.prompt), req.max_new_tokens) > \
-                    self.pool.available:
+            need = self._pages_for(len(req.prompt), req.max_new_tokens)
+            if need > self.pool.available and self.cache is not None:
+                # cached pages not referenced by live sequences free up here
+                # (LRU); the need estimate is cache-blind, so this can evict
+                # prefixes the request would have reused — correct, just
+                # conservative under pressure
+                self.cache.evict(need - self.pool.available)
+            if need > self.pool.available:
                 break  # FIFO: don't starve the head by admitting behind it
             self._queue.pop(0)
             logits, self.state = paged_prefill(
                 self.params, jnp.asarray(req.prompt), self.state, self.pool,
-                slot, self.cfg, mesh=self.mesh)
+                slot, self.cfg, mesh=self.mesh, cache=self.cache)
             self.state = provision_capacity(
                 self.state, self.pool, slot, req.max_new_tokens)
             tok = self._sample(logits[None, :])[0]

@@ -163,16 +163,35 @@ def test_page_pool_accounting():
 
 
 def test_page_pool_double_release_raises():
-    """A double release would hand the same page to two live sequences."""
+    """An over-release would hand a still-referenced page to a second
+    sequence; with refcounts the failure is 'more releases than refs'."""
     pool = PagePool(8)
     got = pool.acquire(2)
     pool.release(got[:1])
-    with pytest.raises(ValueError, match="already free"):
+    with pytest.raises(ValueError, match="released 1x but has 0 refs"):
         pool.release(got[:1])
     # the failed call must not have corrupted the free list
     assert pool.available == 6
+    with pytest.raises(ValueError, match="released 2x but has 1 refs"):
+        pool.release([got[1], got[1]])
+    assert pool.available == 6
     pool.release(got[1:])
     assert pool.available == 7
+
+
+def test_page_pool_sharing_refcounts():
+    """share() adds references; release() frees only at zero — the
+    prefix-cache contract (one physical page in several table rows)."""
+    pool = PagePool(8)
+    (pid,) = pool.acquire(1)
+    pool.share([pid])           # now 2 refs
+    assert pool.refcount(pid) == 2
+    pool.release([pid])         # 1 ref left: NOT free
+    assert pool.available == 6
+    pool.release([pid])         # 0: back on the free list
+    assert pool.available == 7
+    with pytest.raises(ValueError, match="needs a live page"):
+        pool.share([pid])
 
 
 @pytest.fixture(scope="module")
