@@ -9,6 +9,7 @@ from .paged_decode import (
 )
 from .pipeline_lm import stack_layers, unstack_layers
 from .serve import ServeEngine
+from .speculative import SpecStats, speculative_generate
 
 __all__ = [
     "sample_logits",
@@ -43,4 +44,6 @@ __all__ = [
     "provision_capacity",
     "retire_slot",
     "ServeEngine",
+    "SpecStats",
+    "speculative_generate",
 ]

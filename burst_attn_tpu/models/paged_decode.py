@@ -220,6 +220,11 @@ class PrefixCache:
         self._pool = pool
         self._pages: "dict[bytes, int]" = {}   # prefix hash -> page id
         self._lru: List[bytes] = []            # least recent first
+        # chain structure: a lookup stops at the first miss, so an entry
+        # whose PARENT is gone can never hit again — eviction must go
+        # leaf-first or it orphans reachable descendants
+        self._parent: "dict[bytes, Optional[bytes]]" = {}
+        self._nkids: "dict[bytes, int]" = {}
 
     @staticmethod
     def chain(tokens, page: int) -> List[bytes]:
@@ -258,52 +263,76 @@ class PrefixCache:
         return ids
 
     def insert(self, hashes: List[bytes], page_ids) -> None:
-        """Register pages for the (aligned, equal-length) hash list; the
-        cache takes one reference per NEWLY inserted page."""
+        """Register a prompt's FULL hash chain (hashes[i]'s parent is
+        hashes[i-1]); the cache takes one reference per NEWLY inserted
+        page.  Already-present entries are touched (LRU refresh) only."""
         assert len(hashes) == len(page_ids)
+        prev: Optional[bytes] = None
         for h, pid in zip(hashes, page_ids):
             if h in self._pages:
                 self._touch(h)
-                continue
-            self._pool.share([int(pid)])
-            self._pages[h] = int(pid)
-            self._lru.append(h)
+            else:
+                self._pool.share([int(pid)])
+                self._pages[h] = int(pid)
+                self._lru.append(h)
+                self._parent[h] = prev
+                self._nkids[h] = 0
+                if prev is not None:
+                    self._nkids[prev] += 1
+            prev = h
 
     def evict(self, n: int) -> int:
-        """Free up to n pages by dropping LRU entries whose page the cache
-        holds the LAST reference to (entries shared with live sequences
-        free nothing — evicting them would destroy reusable prefixes for
-        zero gain, so they are skipped).  Returns pages actually freed."""
+        """Free up to n pages by dropping entries, LRU-first among LEAVES
+        (an entry with cached children is never dropped first: lookups
+        stop at the first miss, so removing a chain root orphans every
+        descendant while freeing one page).  Entries whose page a live
+        sequence still shares are skipped — releasing them frees nothing
+        and destroys reusable prefixes.  Returns pages actually freed."""
         freed = 0
-        for h in list(self._lru):
-            if freed >= n:
-                break
-            pid = self._pages[h]
-            if self._pool.refcount(pid) > 1:
-                continue  # a live sequence still shares it: freeing = 0
-            self._lru.remove(h)
-            self._pool.release([self._pages.pop(h)])
-            freed += 1
+        progress = True
+        while freed < n and progress:
+            progress = False
+            for h in list(self._lru):
+                if freed >= n:
+                    break
+                if self._nkids.get(h, 0) > 0:
+                    continue  # not a leaf
+                if self._pool.refcount(self._pages[h]) > 1:
+                    continue  # shared with a live sequence
+                self._lru.remove(h)
+                self._pool.release([self._pages.pop(h)])
+                parent = self._parent.pop(h)
+                self._nkids.pop(h, None)
+                if parent is not None and parent in self._nkids:
+                    self._nkids[parent] -= 1
+                freed += 1
+                progress = True  # a parent may have become a leaf
         return freed
 
 
-def _suffix_attention(q, k, v, t_pre, window=None, use_flash=None):
+def _suffix_attention(q, k, v, t_pre, q_hi, kv_hi, window=None,
+                      use_flash=None):
     """Causal attention of suffix queries (absolute positions t_pre..) over
     the full [cached prefix + suffix] context: one offset MaskSpec — col j
     visible from suffix row i iff j <= i + t_pre — instead of a separate
-    kernel (the same five-scalar tile contract the ring rounds use)."""
+    kernel (the same five-scalar tile contract the ring rounds use).
+
+    q/k may carry PADDED tail rows/cols (page-multiple shapes keep the
+    enclosing jit's compile key at page granularity); the TRACED q_hi /
+    kv_hi bounds keep them invisible — pad-row outputs are garbage the
+    caller never reads."""
     from ..ops.masks import MaskSpec
 
     b, n, t_suf, d = q.shape
     s_kv = k.shape[2]
+    spec = MaskSpec(jnp.int32(0), jnp.int32(q_hi), jnp.int32(kv_hi),
+                    jnp.int32(1), jnp.int32(t_pre))
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu"
     if use_flash:
         from ..ops.pallas_flash import flash_fwd
         from ..ops.tile import finalize, init_state
 
-        spec = MaskSpec(jnp.int32(0), jnp.int32(t_suf), jnp.int32(s_kv),
-                        jnp.int32(1), jnp.int32(t_pre))
         st = init_state(b, n, t_suf, d)
         m, lse, acc = flash_fwd(q, k, v, *st, d**-0.5, spec, window=window)
         return finalize(m, lse, acc, q.dtype)
@@ -316,12 +345,13 @@ def _suffix_attention(q, k, v, t_pre, window=None, use_flash=None):
     kf = jnp.repeat(k, group, axis=1).astype(jnp.float32)
     vf = jnp.repeat(v, group, axis=1).astype(jnp.float32)
     s = jnp.einsum("bnid,bnjd->bnij", q.astype(jnp.float32), kf) * d**-0.5
-    spec = MaskSpec(jnp.int32(0), jnp.int32(t_suf), jnp.int32(s_kv),
-                    jnp.int32(1), jnp.int32(t_pre))
     s = jnp.where(dense_mask(spec, t_suf, s_kv, window=window), s,
                   float("-inf"))
-    return jnp.einsum("bnij,bnjd->bnid", jax.nn.softmax(s, axis=-1),
-                      vf).astype(q.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked pad rows softmax to NaN; zero them so downstream
+    # layer math (whose pad rows the caller ignores) stays finite
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    return jnp.einsum("bnij,bnjd->bnid", p, vf).astype(q.dtype)
 
 
 def init_paged_state(cfg: ModelConfig, *, slots: int, n_pages: int,
@@ -410,7 +440,12 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
         if hits:
             t_pre = len(hits) * page
             suffix = tokens[t_pre:]
-            n_suf = -(-int(suffix.shape[0]) // page)
+            t_suf = int(suffix.shape[0])
+            n_suf = -(-t_suf // page)
+            # page-multiple padding keeps the jit's compile key at page
+            # granularity (varying prompt tails share one program); the
+            # true length rides in as a traced scalar
+            suffix = jnp.pad(suffix, (0, n_suf * page - t_suf))
             ids = []
             try:
                 # inside the try: an exhausted-pool acquire must release
@@ -419,13 +454,15 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
                 logits, state = _paged_prefill_suffix_jit(
                     params, suffix[None, :], state,
                     jnp.asarray(hits, jnp.int32),
-                    jnp.asarray(ids, jnp.int32), jnp.int32(slot), cfg, t_pre)
+                    jnp.asarray(ids, jnp.int32), jnp.int32(slot),
+                    jnp.int32(t_suf), cfg, t_pre)
             except Exception:
                 pool.release(ids + hits)  # hits carry our lookup refs
                 raise
             n_full = t // page
-            cache.insert(hashes[len(hits):n_full],
-                         ids[: n_full - len(hits)])
+            # the FULL chain (hits included) so parent links are recorded
+            cache.insert(hashes[:n_full],
+                         hits + ids[: n_full - len(hits)])
             return logits[0], state
     ids = pool.acquire(n_need)
     try:
@@ -440,13 +477,40 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
     return logits[0], state
 
 
-# `state` is donated: serving deployments size the pools to fill HBM, so
-# prefill must alias them in place — without donation every admission
-# transiently needs 2x pool memory (old + new pools per layer) and a pool
-# that fits would OOM on the first prompt.  The cost: if the jit fails at
-# RUNTIME (post-donation), the caller's state is consumed and the
-# release-and-reraise in paged_prefill only restores pool bookkeeping, not
-# the state — trace/shape errors (pre-execution) leave it retryable.
+def _absorb_prompt(params, tokens, pos, state: PagedState, cfg,
+                   layer_attn, layer_scatter):
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    k_pools, v_pools, k_scs, v_scs = [], [], [], []
+    for li, (p, kp, vp) in enumerate(zip(params["layers"], state.k_pages,
+                                         state.v_pages)):
+        q, k, v = _qkv_proj(p, x, pos, cfg)
+        o = layer_attn(li, q, k, v)
+        kp2, ks2, vp2, vs2 = layer_scatter(li, kp, vp, k, v)
+        k_pools.append(kp2)
+        v_pools.append(vp2)
+        k_scs.append(ks2)
+        v_scs.append(vs2)
+        x = x + _attn_out(p, o)
+        m, _ = _mlp(p, x, cfg, inference=True)
+        x = x + m
+    return _rms_norm(x, params["final_norm"]), k_pools, v_pools, k_scs, v_scs
+
+
+def _write_table_row(state: PagedState, slot, row):
+    return lax.dynamic_update_slice(
+        state.page_table,
+        jnp.pad(row, (0, state.page_table.shape[1] - row.shape[0]))[None, :],
+        (slot, jnp.int32(0)),
+    )
+
+
+# `state` is donated (both prefill jits): serving deployments size the
+# pools to fill HBM, so prefill must alias them in place — without donation
+# every admission transiently needs 2x pool memory (old + new pools per
+# layer) and a pool that fits would OOM on the first prompt.  The cost: if
+# the jit fails at RUNTIME (post-donation), the caller's state is consumed
+# and the release-and-reraise in paged_prefill only restores pool
+# bookkeeping, not the state — trace/shape errors leave it retryable.
 @partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
 def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
                        slot, cfg: ModelConfig, mesh=None):
@@ -456,16 +520,15 @@ def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
     page = state.k_pages[0].shape[2]
     t_pad = -(-t // page) * page
     pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
-    x = params["embed"].astype(cfg.dtype)[tokens]
     quant = state.k_scales is not None
-    k_pools, v_pools, k_scs, v_scs = [], [], [], []
-    for li, (p, kp, vp) in enumerate(zip(params["layers"], state.k_pages,
-                                         state.v_pages)):
-        q, k, v = _qkv_proj(p, x, pos, cfg)
+
+    def layer_attn(li, q, k, v):
         # attention consumes the full-precision K/V; only the POOL stores
         # the (possibly int8-quantized) copies
-        o = _prompt_attention_dispatch(q, k.astype(cfg.dtype),
-                                       v.astype(cfg.dtype), cfg, mesh)
+        return _prompt_attention_dispatch(q, k.astype(cfg.dtype),
+                                          v.astype(cfg.dtype), cfg, mesh)
+
+    def layer_scatter(li, kp, vp, k, v):
         pad = [(0, 0), (0, 0), (0, t_pad - t), (0, 0)]
         kp2, ks2 = _scatter_pages(
             kp, jnp.pad(k, pad), page_ids,
@@ -473,74 +536,63 @@ def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
         vp2, vs2 = _scatter_pages(
             vp, jnp.pad(v, pad), page_ids,
             state.v_scales[li] if quant else None)
-        k_pools.append(kp2)
-        v_pools.append(vp2)
-        k_scs.append(ks2)
-        v_scs.append(vs2)
-        x = x + _attn_out(p, o)
-        m, _ = _mlp(p, x, cfg, inference=True)
-        x = x + m
-    x = _rms_norm(x, params["final_norm"])
+        return kp2, ks2, vp2, vs2
+
+    x, k_pools, v_pools, k_scs, v_scs = _absorb_prompt(
+        params, tokens, pos, state, cfg, layer_attn, layer_scatter)
     logits = jnp.einsum("bsd,vd->bsv", x[:, -1:], params["lm_head"],
                         preferred_element_type=jnp.float32)[:, 0]
-    table = lax.dynamic_update_slice(
-        state.page_table,
-        jnp.pad(page_ids, (0, state.page_table.shape[1] - page_ids.shape[0])
-                )[None, :],
-        (slot, jnp.int32(0)),
-    )
+    table = _write_table_row(state, slot, page_ids)
     lengths = state.lengths.at[slot].set(t)
     return logits, PagedState(
         tuple(k_pools), tuple(v_pools), table, lengths,
         tuple(k_scs) if quant else None, tuple(v_scs) if quant else None)
 
 
-# static t_pre: one compile per (cached-page count, suffix-page count) pair —
-# the same page-count keying discipline as _paged_prefill_jit
+# compile key: (cached-page count, suffix-page count) — the caller pads the
+# suffix tokens to a page multiple and passes the true length as a TRACED
+# scalar, so naturally varying prompt tails share one program
 @partial(jax.jit, static_argnames=("cfg", "t_pre"), donate_argnums=(2,))
 def _paged_prefill_suffix_jit(params, tokens, state: PagedState, ctx_ids,
-                              suf_ids, slot, cfg: ModelConfig, t_pre: int):
+                              suf_ids, slot, t_suf, cfg: ModelConfig,
+                              t_pre: int):
     """Prefill of a prompt whose first t_pre tokens' K/V already sit in
-    cached pages (ctx_ids): compute q/k/v for the SUFFIX only, attend the
-    gathered cached context + suffix through one offset spec, scatter the
-    suffix K/V into suf_ids, and point the slot's table row at
-    [ctx_ids | suf_ids]."""
-    b, t_suf = tokens.shape
-    page = state.k_pages[0].shape[2]
-    t_pad = -(-t_suf // page) * page
+    cached pages (ctx_ids): compute q/k/v for the SUFFIX only (tokens is
+    the suffix PADDED to a page multiple; t_suf the real length), attend
+    the gathered cached context + suffix through one offset spec, scatter
+    the suffix K/V into suf_ids, and point the slot's table row at
+    [ctx_ids | suf_ids].  Shares the per-layer body (_absorb_prompt) with
+    the full prefill."""
+    b, t_pad = tokens.shape
     nkv, d_head = cfg.n_kv_heads, cfg.d_head
-    pos = t_pre + jnp.broadcast_to(jnp.arange(t_suf, dtype=jnp.int32)[None],
-                                   (b, t_suf))
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    k_pools, v_pools = [], []
-    for p, kp, vp in zip(params["layers"], state.k_pages, state.v_pages):
-        q, k, v = _qkv_proj(p, x, pos, cfg)
+    pos = t_pre + jnp.broadcast_to(jnp.arange(t_pad, dtype=jnp.int32)[None],
+                                   (b, t_pad))
+
+    def layer_attn(li, q, k, v):
         # cached context, gathered page-contiguous: [n_ctx, Nkv, page, D]
-        # -> [1, Nkv, t_pre, D]
+        # -> [1, Nkv, t_pre, D]; pad rows/cols stay invisible through the
+        # traced q_hi / kv_hi bounds
+        kp, vp = state.k_pages[li], state.v_pages[li]
         kc = jnp.moveaxis(kp[ctx_ids], 0, 1).reshape(nkv, t_pre, d_head)[None]
         vc = jnp.moveaxis(vp[ctx_ids], 0, 1).reshape(nkv, t_pre, d_head)[None]
         k_full = jnp.concatenate(
             [kc.astype(cfg.dtype), k.astype(cfg.dtype)], axis=2)
         v_full = jnp.concatenate(
             [vc.astype(cfg.dtype), v.astype(cfg.dtype)], axis=2)
-        o = _suffix_attention(q, k_full, v_full, t_pre, window=cfg.window)
-        pad = [(0, 0), (0, 0), (0, t_pad - t_suf), (0, 0)]
-        kp2, _ = _scatter_pages(kp, jnp.pad(k, pad), suf_ids)
-        vp2, _ = _scatter_pages(vp, jnp.pad(v, pad), suf_ids)
-        k_pools.append(kp2)
-        v_pools.append(vp2)
-        x = x + _attn_out(p, o)
-        m, _ = _mlp(p, x, cfg, inference=True)
-        x = x + m
-    x = _rms_norm(x, params["final_norm"])
-    logits = jnp.einsum("bsd,vd->bsv", x[:, -1:], params["lm_head"],
+        return _suffix_attention(q, k_full, v_full, t_pre, q_hi=t_suf,
+                                 kv_hi=t_pre + t_suf, window=cfg.window)
+
+    def layer_scatter(li, kp, vp, k, v):
+        kp2, _ = _scatter_pages(kp, k, suf_ids)
+        vp2, _ = _scatter_pages(vp, v, suf_ids)
+        return kp2, None, vp2, None
+
+    x, k_pools, v_pools, _, _ = _absorb_prompt(
+        params, tokens, pos, state, cfg, layer_attn, layer_scatter)
+    x_last = lax.dynamic_slice_in_dim(x, t_suf - 1, 1, axis=1)
+    logits = jnp.einsum("bsd,vd->bsv", x_last, params["lm_head"],
                         preferred_element_type=jnp.float32)[:, 0]
-    row = jnp.concatenate([ctx_ids, suf_ids])
-    table = lax.dynamic_update_slice(
-        state.page_table,
-        jnp.pad(row, (0, state.page_table.shape[1] - row.shape[0]))[None, :],
-        (slot, jnp.int32(0)),
-    )
+    table = _write_table_row(state, slot, jnp.concatenate([ctx_ids, suf_ids]))
     lengths = state.lengths.at[slot].set(t_pre + t_suf)
     return logits, PagedState(
         tuple(k_pools), tuple(v_pools), table, lengths, None, None)

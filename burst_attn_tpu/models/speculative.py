@@ -1,0 +1,151 @@
+"""Speculative decoding (draft-verify) over the dense KV cache.
+
+A small DRAFT model proposes k tokens autoregressively; the TARGET model
+scores all k+1 positions in ONE cached forward pass (`forward_cached`
+already handles multi-token appends) and keeps the longest prefix of
+proposals that matches its own greedy choice, plus one token of its own
+(the correction at the first mismatch, or the bonus after k acceptances).
+Output is TOKEN-EXACT with plain greedy decoding of the target — the
+draft only changes how many target forward passes are needed, never what
+they produce (verified by test).
+
+Cache bookkeeping is the TPU-friendly part: `Cache.length` is the only
+rollback state — K/V written past it are invisible (the visibility mask
+keys on length) and are simply overwritten by the next append, so
+rejecting proposals costs a scalar, not a buffer copy.
+
+Greedy only (`temperature == 0`): stochastic acceptance (Leviathan-style
+p/q rejection sampling) changes the acceptance rule, not the cache
+machinery, and is left as a documented seam.
+
+Reference parity: none — the reference has no decoding stack at all.
+"""
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import Cache, forward_cached, prefill
+from .transformer import ModelConfig
+
+
+class SpecStats(NamedTuple):
+    proposed: int      # draft tokens proposed
+    accepted: int      # draft tokens accepted by the target
+    target_passes: int  # target forward passes (vs `steps` for plain decode)
+
+
+def _greedy(logits):
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _feed(params, cache: Cache, tokens, cfg: ModelConfig):
+    """Append T tokens (1-D) to the cache; returns ([T, vocab] logits,
+    cache).  Positions derive from the cache length (scalar device add —
+    no host sync).  Jitted: one program per token-count (T=1 for drafts'
+    catch-up, T=kk+1 for verification — bounded by k+1 shapes total)."""
+    t = tokens.shape[0]
+    positions = cache.length + jnp.arange(t, dtype=jnp.int32)
+    logits, cache = forward_cached(params, tokens[None], positions[None],
+                                   cache, cfg)
+    return logits[0], cache
+
+
+# cache donated in both jits: the old cache is never reused after a call,
+# and an undonated input forces XLA to copy every layer's [B,Nkv,max_seq,D]
+# buffer per call (2x peak cache memory + a full HBM round-trip per round)
+@partial(jax.jit, static_argnames=("cfg", "kk"), donate_argnums=(1,))
+def _draft_propose(params, cache: Cache, last, cfg: ModelConfig, kk: int):
+    """kk greedy draft steps as ONE compiled lax.scan — no per-token
+    dispatch or host sync.  Returns ([kk] proposed tokens, cache)."""
+
+    def body(carry, _):
+        cache, tok = carry
+        positions = cache.length[None, None]
+        logits, cache = forward_cached(params, tok[None], positions, cfg=cfg,
+                                       cache=cache)
+        nxt = _greedy(logits[0, -1:])
+        return (cache, nxt), nxt[0]
+
+    (cache, _), toks = jax.lax.scan(body, (cache, last), None, length=kk)
+    return toks, cache
+
+
+def _rollback(cache: Cache, length) -> Cache:
+    # +0 forces a FRESH buffer: both caches may be rolled back to the same
+    # traced scalar (jnp.int32 of an int32 array is a no-op returning the
+    # SAME object), and the donating jits would then delete one cache's
+    # length out from under the other
+    return cache._replace(length=jnp.asarray(length, jnp.int32) + 0)
+
+
+def speculative_generate(params_target, params_draft, prompt,
+                         cfg_target: ModelConfig, cfg_draft: ModelConfig,
+                         *, steps: int, k: int = 4, max_seq: int,
+                         return_stats: bool = False):
+    """Greedy speculative decode.  prompt [1, T] int32; returns [steps]
+    generated tokens (and SpecStats with return_stats=True).
+
+    The draft and target must share a vocabulary; everything else
+    (depth, width, GQA, attention backend) may differ.
+    """
+    if cfg_target.vocab != cfg_draft.vocab:
+        raise ValueError("draft and target must share a vocabulary")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative decode is single-sequence (B=1)")
+    if prompt.shape[1] + steps + k + 1 > max_seq:
+        raise ValueError("prompt + steps + k + 1 exceeds max_seq")
+
+    logits_t, cache_t = prefill(params_target, prompt, cfg_target, max_seq)
+    _, cache_d = prefill(params_draft, prompt, cfg_draft, max_seq)
+
+    out = [int(_greedy(logits_t[0, -1]))]
+    # invariant: each cache holds K/V for prompt + out[:-1]; out[-1] is the
+    # newest token, not yet fed to either model
+    proposed = accepted = 0
+    target_passes = 0
+    while len(out) < steps:
+        kk = min(k, steps - len(out))
+        # fresh buffer (+0): cache_t.length itself is donated away by _feed
+        base_t = cache_t.length + 0
+        # --- draft proposes kk tokens (one compiled scan, zero syncs) ---
+        last = jnp.asarray([out[-1]], jnp.int32)
+        draft_toks, cache_d = _draft_propose(params_draft, cache_d, last,
+                                             cfg_draft, kk)
+        proposed += kk
+        # --- target scores all kk+1 positions in one pass ---
+        feed = jnp.concatenate([last, draft_toks])
+        lg_t, cache_t = _feed(params_target, cache_t, feed, cfg_target)
+        target_passes += 1
+        # the round's single host sync: proposals + target choices together
+        drafts = [int(x) for x in np.asarray(draft_toks)]
+        choice = np.asarray(_greedy(lg_t))  # [kk+1] target greedy tokens
+        n_acc = 0
+        while n_acc < kk and drafts[n_acc] == int(choice[n_acc]):
+            n_acc += 1
+        accepted += n_acc
+        out += drafts[:n_acc]
+        out.append(int(choice[n_acc]))  # correction or bonus
+        # --- roll both caches back to prompt + out[:-1] ---
+        new_len = base_t + n_acc + 1
+        cache_t = _rollback(cache_t, new_len)
+        if n_acc == kk:
+            # all accepted: the draft (which fed out[-2] + drafts[:-1]) is
+            # one token BEHIND the invariant — feed the last proposal
+            _, cache_d = _feed(
+                params_draft, cache_d, jnp.asarray([drafts[-1]], jnp.int32),
+                cfg_draft)
+        else:
+            # rejected tail: the draft ran AHEAD; a scalar rollback
+            # discards it (stale K/V past length are invisible)
+            cache_d = _rollback(cache_d, new_len)
+    tokens = np.asarray(out[:steps], np.int32)
+    if return_stats:
+        return tokens, SpecStats(proposed, accepted, target_passes)
+    return tokens

@@ -1,0 +1,72 @@
+"""Speculative decoding: token-exact parity with plain greedy target
+decoding for any draft (the whole point of the scheme), acceptance
+accounting, and the all-accepted / all-rejected cache-rollback corners."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from burst_attn_tpu.models import ModelConfig, init_params
+from burst_attn_tpu.models.decode import generate
+from burst_attn_tpu.models.speculative import speculative_generate
+
+
+def _cfg(layers, d_model, seed):
+    cfg = ModelConfig(
+        vocab=97, d_model=d_model, n_layers=layers, n_heads=4, n_kv_heads=2,
+        d_head=d_model // 4, d_ff=2 * d_model, block_q=8, block_kv=8,
+        attn_backend="jnp", remat=False, dtype=jnp.float32,
+        batch_axis=None, head_axis=None,
+    )
+    return cfg, init_params(jax.random.PRNGKey(seed), cfg)
+
+
+@pytest.mark.parametrize("k,steps", [(4, 12), (1, 5), (3, 7)])
+def test_speculative_matches_plain_greedy(k, steps):
+    """A WEAK draft (different init, shallower) must still yield exactly
+    the target's greedy tokens — the draft can only change speed."""
+    cfg_t, params_t = _cfg(2, 64, seed=0)
+    cfg_d, params_d = _cfg(1, 32, seed=5)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 9), 1, 97)
+    want = np.asarray(generate(params_t, prompt, cfg_t, steps=steps,
+                               max_seq=128))[0]
+    got, stats = speculative_generate(
+        params_t, params_d, prompt, cfg_t, cfg_d, steps=steps, k=k,
+        max_seq=128, return_stats=True)
+    np.testing.assert_array_equal(got, want)
+    assert stats.proposed >= stats.accepted >= 0
+    # every target pass yields AT LEAST one token beyond the prefill one
+    # (the correction/bonus), so passes can never reach `steps`; with any
+    # acceptance it drops further (the self-draft test pins the floor)
+    assert stats.target_passes <= steps - 1
+    assert stats.target_passes >= -(-(steps - 1) // (k + 1))
+
+
+def test_speculative_self_draft_accepts_everything():
+    """draft == target: every proposal matches the target's greedy choice,
+    so acceptance is total and target passes collapse to ~steps/(k+1)."""
+    cfg, params = _cfg(2, 64, seed=1)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 7), 1, 97)
+    steps, k = 12, 3
+    want = np.asarray(generate(params, prompt, cfg, steps=steps,
+                               max_seq=128))[0]
+    got, stats = speculative_generate(
+        params, params, prompt, cfg, cfg, steps=steps, k=k, max_seq=128,
+        return_stats=True)
+    np.testing.assert_array_equal(got, want)
+    assert stats.accepted == stats.proposed           # all accepted
+    assert stats.target_passes == -(-(steps - 1) // (k + 1))
+
+
+def test_speculative_validates():
+    cfg_t, params_t = _cfg(1, 32, seed=0)
+    cfg_d = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=4,
+                        n_kv_heads=2, d_head=8, d_ff=64, attn_backend="jnp",
+                        remat=False, dtype=jnp.float32, batch_axis=None,
+                        head_axis=None)
+    params_d = init_params(jax.random.PRNGKey(1), cfg_d)
+    prompt = jnp.ones((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="share a vocabulary"):
+        speculative_generate(params_t, params_d, prompt, cfg_t, cfg_d,
+                             steps=4, k=2, max_seq=64)
