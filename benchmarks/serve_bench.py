@@ -35,8 +35,14 @@ def main(argv=None):
                          "depth) through the ServeEngine with staggered "
                          "budgets, measuring end-to-end tokens/s including "
                          "admission/retirement churn")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="churn phase: share one --context/2 token prefix "
+                         "across all requests and serve with automatic "
+                         "prefix caching (bf16 only)")
     ap.add_argument("--out", default="results/serve.jsonl")
     args = ap.parse_args(argv)
+    if args.prefix_cache and args.quantize:
+        ap.error("--prefix-cache requires bf16 pools (drop --quantize)")
 
     import jax
     import jax.numpy as jnp
@@ -134,13 +140,27 @@ def main(argv=None):
         budgets = [args.decode_steps // 2 + (i % 4) * (args.decode_steps // 4)
                    for i in range(n_req)]
         pages_per_req = -(-(args.context + max(budgets)) // args.page)
+        # prefix-cache mode needs pool headroom for the cached prefix pages
+        extra = (args.context // 2 // args.page + 2) if args.prefix_cache else 0
         eng = ServeEngine(
             params, cfg, slots=args.slots,
-            n_pages=args.slots * pages_per_req + 2, page=args.page,
-            max_pages_per_seq=pages_per_req, quantize=args.quantize)
+            n_pages=args.slots * pages_per_req + 2 + extra, page=args.page,
+            max_pages_per_seq=pages_per_req, quantize=args.quantize,
+            prefix_cache=args.prefix_cache)
         rng = np.random.RandomState(0)
+        # draw the shared prefix ONLY in prefix-cache mode: consuming RNG
+        # state unconditionally would shift plain-churn prompt streams and
+        # break comparability with previously recorded rows
+        shared = (rng.randint(1, cfg.vocab, args.context // 2)
+                  if args.prefix_cache else None)
         for i in range(n_req):
-            eng.submit(rng.randint(1, cfg.vocab, args.context), budgets[i])
+            if args.prefix_cache:
+                prompt = np.concatenate(
+                    [shared, rng.randint(1, cfg.vocab,
+                                         args.context - len(shared))])
+            else:
+                prompt = rng.randint(1, cfg.vocab, args.context)
+            eng.submit(prompt, budgets[i])
         # warm the prefill+decode compiles outside the timed region — and
         # exclude the tokens that warm step produced from the numerator
         eng.step()
@@ -152,6 +172,7 @@ def main(argv=None):
         total = sum(len(v) for v in out.values()) - warm_tokens
         record({"phase": "churn", "requests": n_req, "slots": args.slots,
                 "context": args.context, "quantize": args.quantize,
+                "prefix_cache": args.prefix_cache,
                 "total_tokens": total, "wall_s": round(wall, 2),
                 "tokens_per_s": round(total / wall, 1)})
 

@@ -80,6 +80,16 @@ def _tri_disabled():
     return os.environ.get("BURST_NO_TRI", "").strip().lower() not in ("", "0", "false")
 
 
+def _fwd_loop_default():
+    """BURST_FWD_LOOP=1 makes flash_fwd's fori_loop sub-block sweep
+    (`loop_sweep`) the default.  Exists so the cliff-break experiment
+    (sweep_blocks --fwd-loop; docs §3) can be PROMOTED for a bench run
+    without a code edit mid-tunnel-window — if the loop sweep legalizes
+    4096-wide kv blocks, rerun `BURST_FWD_LOOP=1 BURST_ALLOW_CLIFF=1
+    python bench.py` with retuned blocks before changing defaults."""
+    return os.environ.get("BURST_FWD_LOOP", "").strip().lower() not in ("", "0", "false")
+
+
 def _pick_block(seq: int, block: int) -> int:
     """Largest block <= `block` that divides seq (seq lengths are powers of
     two in practice, so this is normally min(block, seq))."""
@@ -625,6 +635,8 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     """
     if interpret is None:
         interpret = _interpret_default()
+    if not loop_sweep and _ablate is None and _fwd_loop_default():
+        loop_sweep = True  # BURST_FWD_LOOP promotion (see _fwd_loop_default)
     if _ablate is not None and loop_sweep:
         raise ValueError("_ablate has no loop_sweep variant — the ablation "
                          "would silently time the full softmax chain")

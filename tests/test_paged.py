@@ -361,3 +361,41 @@ def test_skipped_ensure_capacity_poisons_logits(model):
     lg, _ = paged_decode_step(params, jnp.zeros((2,), jnp.int32), state, cfg)
     assert np.isnan(np.asarray(lg[0])).all()      # misused slot: loud
     assert not np.isnan(np.asarray(lg[1])).any()  # empty slot: unaffected
+
+
+def test_prefix_cache_leaf_first_eviction():
+    """Chain-aware eviction: leaves go before roots (a dropped root
+    orphans every descendant — lookups stop at the first miss), parents
+    become evictable once their children are gone (the multi-pass
+    progress loop), and live-shared entries are skipped entirely."""
+    from burst_attn_tpu.models.paged_decode import PrefixCache
+
+    pool = PagePool(8)
+    cache = PrefixCache(pool)
+    h = PrefixCache.chain(np.arange(3 * 4, dtype=np.int32), 4)  # 3 pages
+    ids = pool.acquire(3)
+    cache.insert(h, ids)          # chain h0 -> h1 -> h2, cache rc=2 each
+    pool.release(ids)             # cache now holds the only refs
+    assert pool.available == 4
+
+    # evict(1) must drop the LEAF h2 (LRU-oldest is the ROOT h0)
+    assert cache.evict(1) == 1
+    assert len(cache) == 2
+    got = cache.lookup(h)         # root+middle still hit
+    assert got == ids[:2]
+    pool.release(got)
+
+    # evict(2): h1 falls first, then h0 becomes a leaf and falls too —
+    # one call, multi-pass
+    assert cache.evict(2) == 2
+    assert len(cache) == 0 and pool.available == 7
+
+    # live-shared entries are never evicted
+    ids2 = pool.acquire(2)
+    h2 = PrefixCache.chain(np.arange(2 * 4, dtype=np.int32) + 50, 4)
+    cache.insert(h2, ids2)        # rc=2 (sequence + cache)
+    assert cache.evict(5) == 0    # both shared with the "live" sequence
+    assert len(cache) == 2
+    pool.release(ids2)            # sequence retires
+    assert cache.evict(5) == 2    # now evictable, leaf-first
+    assert pool.available == 7
