@@ -14,9 +14,16 @@ rollback state — K/V written past it are invisible (the visibility mask
 keys on length) and are simply overwritten by the next append, so
 rejecting proposals costs a scalar, not a buffer copy.
 
-Greedy only (`temperature == 0`): stochastic acceptance (Leviathan-style
-p/q rejection sampling) changes the acceptance rule, not the cache
-machinery, and is left as a documented seam.
+Two acceptance rules share the cache machinery:
+
+* greedy (`temperature == 0`): accept while the proposal equals the
+  target's argmax — token-exact with plain greedy target decoding.
+* stochastic (`temperature > 0`): Leviathan-style rejection sampling —
+  accept proposal x with probability min(1, p(x)/q(x)) (p = target, q =
+  draft distribution at that position); on rejection, sample from the
+  residual normalize(max(p - q, 0)).  The OUTPUT DISTRIBUTION equals
+  sampling the target directly (`_residual_accept` is property-tested
+  against exact enumeration), for any draft.
 
 Reference parity: none — the reference has no decoding stack at all.
 """
@@ -58,21 +65,79 @@ def _feed(params, cache: Cache, tokens, cfg: ModelConfig):
 # cache donated in both jits: the old cache is never reused after a call,
 # and an undonated input forces XLA to copy every layer's [B,Nkv,max_seq,D]
 # buffer per call (2x peak cache memory + a full HBM round-trip per round)
-@partial(jax.jit, static_argnames=("cfg", "kk"), donate_argnums=(1,))
-def _draft_propose(params, cache: Cache, last, cfg: ModelConfig, kk: int):
-    """kk greedy draft steps as ONE compiled lax.scan — no per-token
-    dispatch or host sync.  Returns ([kk] proposed tokens, cache)."""
+@partial(jax.jit, static_argnames=("cfg", "kk", "temperature"),
+         donate_argnums=(1,))
+def _draft_propose(params, cache: Cache, last, key, cfg: ModelConfig,
+                   kk: int, temperature: float):
+    """kk draft steps as ONE compiled lax.scan — no per-token dispatch or
+    host sync.  temperature == 0: greedy (q output is a placeholder);
+    else: sampled, with each position's full f32 proposal distribution q
+    (the acceptance rule needs p/q ratios — q MUST be computed in f32
+    like the target side, or bf16 models bias the ratios and break the
+    distribution-exactness guarantee).  Returns (tokens [kk], q [kk, V],
+    cache, key)."""
 
     def body(carry, _):
-        cache, tok = carry
+        cache, tok, key = carry
         positions = cache.length[None, None]
         logits, cache = forward_cached(params, tok[None], positions, cfg=cfg,
                                        cache=cache)
-        nxt = _greedy(logits[0, -1:])
-        return (cache, nxt), nxt[0]
+        row = logits[0, -1].astype(jnp.float32)
+        if temperature > 0.0:
+            row = row / temperature
+            key, ks = jax.random.split(key)
+            nxt = jax.random.categorical(ks, row)[None].astype(jnp.int32)
+            q = jax.nn.softmax(row)
+        else:
+            nxt = _greedy(row[None])
+            q = row  # unused by the greedy acceptance rule
+        return (cache, nxt, key), (nxt[0], q)
 
-    (cache, _), toks = jax.lax.scan(body, (cache, last), None, length=kk)
-    return toks, cache
+    (cache, _, key), (toks, qs) = jax.lax.scan(
+        body, (cache, last, key), None, length=kk)
+    return toks, qs, cache, key
+
+
+def _temperature_probs(logits, temperature):
+    return jax.nn.softmax(logits.astype(jnp.float32) / temperature, axis=-1)
+
+
+def _residual_accept(p_rows, q_rows, drafts, key):
+    """Leviathan acceptance on the host side of the round boundary.
+
+    p_rows [kk+1, V] target probs, q_rows [kk, V] draft probs, drafts
+    [kk] proposed tokens.  Returns (n_acc, next_token, key): proposals
+    accept while u < p(x)/q(x); the first rejection samples the residual
+    normalize(max(p - q, 0)); after kk acceptances the bonus token
+    samples p_rows[kk].  Produces EXACTLY the target distribution per
+    position (the classic telescoping argument), any draft.
+
+    All randomness is drawn in ONE device call (kk+2 uniforms) and the
+    rows pulled in ONE transfer each; the per-token loop is pure numpy —
+    per-position device round-trips would cost the very latency
+    speculation amortizes."""
+    kk = len(drafts)
+    key, ku = jax.random.split(key)
+    u = np.asarray(jax.random.uniform(ku, (kk + 2,)))
+    p = np.asarray(p_rows, np.float64)
+    q = np.asarray(q_rows, np.float64)
+
+    def inv_cdf(probs, x):  # sample via one uniform, pure numpy
+        c = np.cumsum(probs)
+        return int(np.searchsorted(c, x * c[-1], side="right").clip(
+            0, len(probs) - 1))
+
+    for i in range(kk):
+        x = int(drafts[i])
+        if u[i] < p[i, x] / max(q[i, x], 1e-30):
+            continue
+        resid = np.maximum(p[i] - q[i], 0.0)
+        if resid.sum() <= 0.0:
+            # p <= q everywhere yet x rejected: numerically degenerate
+            # (p == q); fall back to sampling the target row directly
+            resid = p[i]
+        return i, inv_cdf(resid, u[kk + 1]), key
+    return kk, inv_cdf(p[kk], u[kk + 1]), key
 
 
 def _rollback(cache: Cache, length) -> Cache:
@@ -86,9 +151,13 @@ def _rollback(cache: Cache, length) -> Cache:
 def speculative_generate(params_target, params_draft, prompt,
                          cfg_target: ModelConfig, cfg_draft: ModelConfig,
                          *, steps: int, k: int = 4, max_seq: int,
+                         temperature: float = 0.0, rng=None,
                          return_stats: bool = False):
-    """Greedy speculative decode.  prompt [1, T] int32; returns [steps]
-    generated tokens (and SpecStats with return_stats=True).
+    """Speculative decode.  prompt [1, T] int32; returns [steps] generated
+    tokens (and SpecStats with return_stats=True).  temperature == 0 is
+    greedy (token-exact with generate()); temperature > 0 samples with
+    the Leviathan acceptance rule (output distribution == sampling the
+    target directly).
 
     The draft and target must share a vocabulary; everything else
     (depth, width, GQA, attention backend) may differ.
@@ -101,11 +170,17 @@ def speculative_generate(params_target, params_draft, prompt,
         raise ValueError("speculative decode is single-sequence (B=1)")
     if prompt.shape[1] + steps + k + 1 > max_seq:
         raise ValueError("prompt + steps + k + 1 exceeds max_seq")
+    sampled = temperature > 0.0
+    key = rng if rng is not None else jax.random.PRNGKey(0)
 
     logits_t, cache_t = prefill(params_target, prompt, cfg_target, max_seq)
     _, cache_d = prefill(params_draft, prompt, cfg_draft, max_seq)
 
-    out = [int(_greedy(logits_t[0, -1]))]
+    if sampled:
+        key, k0 = jax.random.split(key)
+        out = [int(jax.random.categorical(k0, logits_t[0, -1] / temperature))]
+    else:
+        out = [int(_greedy(logits_t[0, -1]))]
     # invariant: each cache holds K/V for prompt + out[:-1]; out[-1] is the
     # newest token, not yet fed to either model
     proposed = accepted = 0
@@ -116,22 +191,28 @@ def speculative_generate(params_target, params_draft, prompt,
         base_t = cache_t.length + 0
         # --- draft proposes kk tokens (one compiled scan, zero syncs) ---
         last = jnp.asarray([out[-1]], jnp.int32)
-        draft_toks, cache_d = _draft_propose(params_draft, cache_d, last,
-                                             cfg_draft, kk)
+        key, kd = jax.random.split(key)
+        draft_toks, q_rows, cache_d, _ = _draft_propose(
+            params_draft, cache_d, last, kd, cfg_draft, kk, temperature)
         proposed += kk
         # --- target scores all kk+1 positions in one pass ---
         feed = jnp.concatenate([last, draft_toks])
         lg_t, cache_t = _feed(params_target, cache_t, feed, cfg_target)
         target_passes += 1
-        # the round's single host sync: proposals + target choices together
+        # the round's single bulk host sync: proposals + target rows
         drafts = [int(x) for x in np.asarray(draft_toks)]
-        choice = np.asarray(_greedy(lg_t))  # [kk+1] target greedy tokens
-        n_acc = 0
-        while n_acc < kk and drafts[n_acc] == int(choice[n_acc]):
-            n_acc += 1
+        if sampled:
+            p_rows = _temperature_probs(lg_t, temperature)
+            n_acc, nxt, key = _residual_accept(p_rows, q_rows, drafts, key)
+        else:
+            choice = np.asarray(_greedy(lg_t))  # [kk+1] target greedy tokens
+            n_acc = 0
+            while n_acc < kk and drafts[n_acc] == int(choice[n_acc]):
+                n_acc += 1
+            nxt = int(choice[n_acc])  # correction or bonus
         accepted += n_acc
         out += drafts[:n_acc]
-        out.append(int(choice[n_acc]))  # correction or bonus
+        out.append(nxt)
         # --- roll both caches back to prompt + out[:-1] ---
         new_len = base_t + n_acc + 1
         cache_t = _rollback(cache_t, new_len)

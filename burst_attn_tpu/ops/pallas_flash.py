@@ -659,7 +659,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
             scale, spec, block_q=block_q, block_kv=block_kv,
             block_kv_compute=block_kv_compute, interpret=interpret,
             cast_p=cast_p, triangular=False, window=window, segments=segments,
-            emit_o=emit_o,
+            emit_o=emit_o, loop_sweep=loop_sweep, _ablate=_ablate,
         )
         return m2[:, :, :s_q], lse2[:, :, :s_q], acc2[:, :, :s_q]
     bq = _pick_block(s_q, block_q)

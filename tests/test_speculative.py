@@ -70,3 +70,53 @@ def test_speculative_validates():
     with pytest.raises(ValueError, match="share a vocabulary"):
         speculative_generate(params_t, params_d, prompt, cfg_t, cfg_d,
                              steps=4, k=2, max_seq=64)
+
+
+def test_residual_accept_preserves_target_distribution():
+    """Monte Carlo check of the Leviathan rule: over many rounds, the
+    first emitted token's empirical distribution equals the TARGET p,
+    regardless of a (very different) draft q."""
+    import jax
+    import jax.numpy as jnp
+
+    from burst_attn_tpu.models.speculative import _residual_accept
+
+    p = jnp.asarray([0.55, 0.25, 0.12, 0.08])
+    q = jnp.asarray([0.10, 0.60, 0.10, 0.20])
+    p_rows = jnp.stack([p, p])  # kk=1 + bonus row (also p)
+    q_rows = q[None]
+    counts = np.zeros(4)
+    key = jax.random.PRNGKey(0)
+    n = 3000
+    for _ in range(n):
+        key, kd = jax.random.split(key)
+        draft = [int(jax.random.choice(kd, 4, p=q))]
+        n_acc, nxt, key = _residual_accept(p_rows, q_rows, draft, key)
+        first = draft[0] if n_acc >= 1 else nxt
+        counts[first] += 1
+    emp = counts / n
+    np.testing.assert_allclose(emp, np.asarray(p), atol=0.03)
+
+
+def test_speculative_sampled_self_draft_accepts_everything():
+    """draft == target at temperature > 0: p == q so the acceptance ratio
+    is 1 — every proposal accepted, stochastic path exercised end-to-end."""
+    cfg, params = _cfg(2, 64, seed=1)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 7), 1, 97)
+    steps, k = 10, 3
+    got, stats = speculative_generate(
+        params, params, prompt, cfg, cfg, steps=steps, k=k, max_seq=128,
+        temperature=0.9, rng=jax.random.PRNGKey(11), return_stats=True)
+    assert len(got) == steps and np.all((got >= 0) & (got < cfg.vocab))
+    assert stats.accepted == stats.proposed
+    assert stats.target_passes == -(-(steps - 1) // (k + 1))
+
+
+def test_speculative_sampled_weak_draft_runs():
+    cfg_t, params_t = _cfg(2, 64, seed=0)
+    cfg_d, params_d = _cfg(1, 32, seed=5)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 9), 1, 97)
+    got, stats = speculative_generate(
+        params_t, params_d, prompt, cfg_t, cfg_d, steps=9, k=3, max_seq=128,
+        temperature=0.7, rng=jax.random.PRNGKey(1), return_stats=True)
+    assert len(got) == 9 and stats.proposed >= stats.accepted
