@@ -5,7 +5,8 @@ from .decode import Cache, forward_cached, generate, init_cache, prefill, sample
 from .dist_decode import DistCache, dist_generate, dist_prefill
 from .paged_decode import (
     PagePool, PagedState, PrefixCache, ensure_capacity, init_paged_state,
-    paged_decode_step, paged_prefill, provision_capacity, retire_slot,
+    paged_decode_step, paged_multi_step, paged_prefill,
+    provision_capacity, retire_slot, rollback_tokens,
 )
 from .pipeline_lm import stack_layers, unstack_layers
 from .serve import ServeEngine
@@ -41,7 +42,9 @@ __all__ = [
     "init_paged_state",
     "paged_decode_step",
     "paged_prefill",
+    "paged_multi_step",
     "provision_capacity",
+    "rollback_tokens",
     "retire_slot",
     "ServeEngine",
     "SpecStats",

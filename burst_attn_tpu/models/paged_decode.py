@@ -671,6 +671,95 @@ def paged_decode_step(params, tokens, state: PagedState, cfg: ModelConfig,
         tuple(k_scs) if quant else None, tuple(v_scs) if quant else None)
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def paged_multi_step(params, tokens, state: PagedState, cfg: ModelConfig):
+    """Append T tokens to EVERY live slot in one pass (speculative
+    verification / chunked decode): tokens [slots, T] -> ([slots, T,
+    vocab] f32 logits, state with lengths += T for live slots).
+
+    Attention dense-gathers each slot's pages (paged_decode_reference
+    style): at speculative T (~4) the model matmuls dominate and the
+    gather amortizes over T positions — the single-token hot path keeps
+    the Pallas kernel.  The new tokens' K/V scatter into the pool FIRST,
+    so the gathered context already contains them (no concat path).
+    Capacity for all T tokens must be pre-assigned (provision_capacity);
+    dead slots scatter into the sink page and emit garbage logits the
+    caller ignores.  Speculative ROLLBACK is `rollback_tokens` — a pure
+    lengths decrement, because entries past lengths are invisible.
+
+    bf16 pools only (int8 per-token quantization of partially-accepted
+    speculative tokens would leave stale scales behind rollbacks)."""
+    if state.k_scales is not None:
+        raise ValueError("paged_multi_step requires bf16 pools")
+    slots, t = tokens.shape
+    page = state.k_pages[0].shape[2]
+    max_ctx = state.page_table.shape[1] * page
+    group = cfg.n_heads // cfg.n_kv_heads
+    live = state.lengths > 0
+    base = jnp.where(live, state.lengths, 0)
+    pos = base[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [slots,T]
+    # per-token destination pages (sink for dead slots)
+    slot_ix = jnp.arange(slots)[:, None]
+    pids = state.page_table[slot_ix, pos // page]
+    # a LIVE slot mapping any position to page 0 means the caller skipped
+    # provision_capacity: poison that slot's logits (same loud-failure
+    # contract as paged_decode_step) instead of silently scattering into
+    # the sink page and attending garbage
+    boundary_unassigned = live & jnp.any(pids == 0, axis=1)
+    pids = jnp.where(live[:, None], pids, 0)
+    offs = pos % page
+    col = jnp.arange(max_ctx, dtype=jnp.int32)[None, :]           # [1, ctx]
+    x = params["embed"].astype(cfg.dtype)[tokens]                 # [S,T,dm]
+    k_pools, v_pools = [], []
+    for p, kp, vp in zip(params["layers"], state.k_pages, state.v_pages):
+        q, k, v = _qkv_proj(p, x, pos, cfg)
+        # scatter new K/V: [slots, T, Nkv, D] at ([slots,T] pages, offsets)
+        kp = kp.at[pids, :, offs].set(
+            jnp.moveaxis(k, 1, 2).astype(kp.dtype))
+        vp = vp.at[pids, :, offs].set(
+            jnp.moveaxis(v, 1, 2).astype(vp.dtype))
+        # gather each slot's full context (now including the new tokens)
+        kc = jnp.moveaxis(kp[state.page_table], 2, 1).reshape(
+            slots, cfg.n_kv_heads, max_ctx, cfg.d_head)
+        vc = jnp.moveaxis(vp[state.page_table], 2, 1).reshape(
+            slots, cfg.n_kv_heads, max_ctx, cfg.d_head)
+        qg = q.reshape(slots, cfg.n_kv_heads, group, t, cfg.d_head)
+        s = jnp.einsum("bngtd,bnjd->bngtj", qg.astype(jnp.float32),
+                       kc.astype(jnp.float32)) * cfg.d_head**-0.5
+        visible = col[:, None, :] <= pos[:, :, None]              # causal
+        if cfg.window is not None:
+            visible &= col[:, None, :] > pos[:, :, None] - cfg.window
+        s = jnp.where(visible[:, None, None, :, :], s, float("-inf"))
+        o = jnp.einsum("bngtj,bnjd->bngtd", jax.nn.softmax(s, axis=-1),
+                       vc.astype(jnp.float32))
+        o = o.reshape(slots, cfg.n_heads, t, cfg.d_head).astype(cfg.dtype)
+        x = x + _attn_out(p, o)
+        m, _ = _mlp(p, x, cfg, inference=True)
+        x = x + m
+        k_pools.append(kp)
+        v_pools.append(vp)
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("btd,vd->btv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    logits = jnp.where(boundary_unassigned[:, None, None], jnp.nan, logits)
+    lengths = state.lengths + t * live.astype(jnp.int32)
+    return logits, PagedState(tuple(k_pools), tuple(v_pools),
+                              state.page_table, lengths, None, None)
+
+
+def rollback_tokens(state: PagedState, slot: int, n: int) -> PagedState:
+    """Host-side: un-append the last n tokens of `slot` (speculative
+    rejection).  Pure lengths bookkeeping — entries past lengths are
+    invisible and the next append overwrites them; pages stay assigned."""
+    length = int(state.lengths[slot])
+    if n < 0 or n >= length:
+        # n == length would zero the slot while its table row still owns
+        # pages: retire_slot early-returns on length 0 and the pages leak
+        raise ValueError(f"cannot roll back {n} of {length} tokens "
+                         "(at least one must remain; retire_slot frees)")
+    return state._replace(lengths=state.lengths.at[slot].set(length - n))
+
+
 def ensure_capacity(state: PagedState, pool: PagePool, slot: int) -> PagedState:
     """Host-side: guarantee slot has a page for its next token, acquiring
     one if its last page is full.  Call before paged_decode_step."""

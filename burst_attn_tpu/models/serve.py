@@ -24,6 +24,13 @@ Design notes (TPU-shaped):
   * Sampling uses decode.sample_logits on-device for the whole batch;
     per-slot temperature is intentionally NOT supported (it would split
     the batch into per-slot programs).
+  * Speculative serving (`draft_params`/`draft_cfg`/`spec_k`): a draft
+    model with its own mirrored paged state proposes spec_k tokens per
+    slot per tick; ONE paged_multi_step scores every slot's k+1
+    positions, per-slot acceptance keeps the matching prefix + one
+    target token, and both states roll back with a pure lengths
+    decrement.  Greedy only; per-request output is token-exact with the
+    non-speculative engine (tested).
 
 Reference parity: none — the reference is an attention op library with no
 serving story (SURVEY.md §5); this is framework surface beyond it.
@@ -38,8 +45,8 @@ import numpy as np
 
 from .decode import sample_logits
 from .paged_decode import (
-    PrefixCache, init_paged_state, paged_decode_step, paged_prefill,
-    provision_capacity, retire_slot,
+    PrefixCache, init_paged_state, paged_decode_step, paged_multi_step,
+    paged_prefill, provision_capacity, retire_slot, rollback_tokens,
 )
 from .transformer import ModelConfig
 
@@ -60,7 +67,8 @@ class ServeEngine:
                  page: int = 128, max_pages_per_seq: int = 64,
                  quantize: bool = False, mesh=None, eos_id: Optional[int] = None,
                  temperature: float = 0.0, top_k=None, top_p=None, rng=None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False, draft_params=None,
+                 draft_cfg: Optional[ModelConfig] = None, spec_k: int = 4):
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -75,6 +83,27 @@ class ServeEngine:
         if prefix_cache and (quantize or mesh is not None):
             raise ValueError("prefix_cache requires bf16 pools and no tp mesh")
         self.cache = PrefixCache(self.pool) if prefix_cache else None
+        # speculative serving: a DRAFT model with its own paged state whose
+        # slot geometry mirrors the target's; greedy only (acceptance =
+        # target argmax match — see step()), bf16 pools only (the
+        # multi-token verify step requires them)
+        self.draft = None
+        self.spec_k = 0
+        if draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_params needs draft_cfg")
+            if quantize or mesh is not None or temperature != 0.0:
+                raise ValueError("speculative serving requires bf16 pools, "
+                                 "no tp mesh, and temperature == 0")
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError("draft and target must share a vocabulary")
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            self.draft = (draft_params, draft_cfg)
+            self.spec_k = spec_k
+            self.dstate, self.dpool = init_paged_state(
+                draft_cfg, slots=slots, n_pages=n_pages, page=page,
+                max_pages_per_seq=max_pages_per_seq)
         self.slots: List[Optional[_Request]] = [None] * slots
         self._next_tok = np.zeros((slots,), np.int32)
         self._queue: List[_Request] = []
@@ -130,7 +159,10 @@ class ServeEngine:
     # -- engine ------------------------------------------------------------
 
     def _pages_for(self, prompt_len: int, max_new: int) -> int:
-        return -(-(prompt_len + max_new) // self.page)
+        # speculative verification transiently appends spec_k + 1 tokens
+        # past the budget before rolling back — capacity must cover it
+        slack = self.spec_k + 1 if self.draft is not None else 0
+        return -(-(prompt_len + max_new + slack) // self.page)
 
     def _admit(self) -> None:
         """Move queued requests into free slots while the pool can cover
@@ -149,12 +181,27 @@ class ServeEngine:
                 self.cache.evict(need - self.pool.available)
             if need > self.pool.available:
                 break  # FIFO: don't starve the head by admitting behind it
+            if self.draft is not None and need > self.dpool.available:
+                # the draft pool duplicates pages the target may be sharing
+                # via the prefix cache; admitting on the target check alone
+                # could fail the draft prefill MID-admission and wedge the
+                # slot (target live, request lost)
+                break
             self._queue.pop(0)
+            slack = self.spec_k + 1 if self.draft is not None else 0
             logits, self.state = paged_prefill(
                 self.params, jnp.asarray(req.prompt), self.state, self.pool,
                 slot, self.cfg, mesh=self.mesh, cache=self.cache)
             self.state = provision_capacity(
-                self.state, self.pool, slot, req.max_new_tokens)
+                self.state, self.pool, slot, req.max_new_tokens + slack)
+            if self.draft is not None:
+                dp, dc = self.draft
+                _, self.dstate = paged_prefill(dp, jnp.asarray(req.prompt),
+                                               self.dstate, self.dpool, slot,
+                                               dc)
+                self.dstate = provision_capacity(
+                    self.dstate, self.dpool, slot,
+                    req.max_new_tokens + slack)
             tok = self._sample(logits[None, :])[0]
             req.tokens.append(int(tok))
             self.slots[slot] = req
@@ -175,14 +222,18 @@ class ServeEngine:
                 and req.tokens[-1] == self.eos_id
             if hit_eos or len(req.tokens) >= req.max_new_tokens:
                 self.state = retire_slot(self.state, self.pool, slot)
+                if self.draft is not None:
+                    self.dstate = retire_slot(self.dstate, self.dpool, slot)
                 self.slots[slot] = None
                 self._finished[req.rid] = req.tokens
                 done.append((req.rid, req.tokens))
         return done
 
     def step(self) -> List[Tuple[int, List[int]]]:
-        """One engine tick: retire -> admit -> one decode step for every
-        live slot.  Returns requests that finished THIS tick.
+        """One engine tick: retire -> admit -> one decode advance for every
+        live slot (a single token, or a whole speculative round when a
+        draft model is attached).  Returns requests that finished THIS
+        tick.
 
         Admit and retire alternate until stable: a freshly admitted request
         can already be complete (max_new_tokens == 1, or the prefill-sampled
@@ -199,6 +250,9 @@ class ServeEngine:
                 break
         if self.live == 0:
             return done
+        if self.draft is not None:
+            self._spec_round()
+            return done
         logits, self.state = paged_decode_step(
             self.params, jnp.asarray(self._next_tok), self.state, self.cfg,
             mesh=self.mesh)
@@ -209,3 +263,57 @@ class ServeEngine:
             req.tokens.append(int(toks[slot]))
             self._next_tok[slot] = int(toks[slot])
         return done
+
+    def _spec_round(self) -> None:
+        """One speculative round for EVERY live slot: the draft proposes
+        spec_k tokens per slot (k single paged steps on its own state);
+        the target scores all k+1 positions in ONE paged_multi_step; each
+        slot keeps its matching prefix + one target token, then both
+        states roll back to exactly the kept tokens (a lengths decrement —
+        entries past lengths are invisible).  Greedy: per-slot output is
+        token-exact with the non-speculative engine."""
+        k = self.spec_k
+        dp, dc = self.draft
+        # draft proposals stay ON DEVICE across the k steps (one transfer
+        # after the loop — per-step np.asarray would serialize each step on
+        # a host roundtrip)
+        toks_dev = []
+        cur = jnp.asarray(self._next_tok)
+        for i in range(k):
+            lg_d, self.dstate = paged_decode_step(dp, cur, self.dstate, dc)
+            cur = jnp.argmax(lg_d, axis=-1).astype(jnp.int32)
+            toks_dev.append(cur)
+        d_toks_dev = jnp.stack(toks_dev, axis=1)            # [slots, k]
+        # target verifies [last | proposals] in one multi-token pass
+        feed = jnp.concatenate(
+            [jnp.asarray(self._next_tok)[:, None], d_toks_dev], axis=1)
+        lg_t, self.state = paged_multi_step(
+            self.params, feed, self.state, self.cfg)
+        # draft catch-up: after proposing it holds [last | d0..dk-2]; one
+        # uniform step feeding dk-1 brings every slot to base + k + 1,
+        # matching the target — the vectorized rollback then trims both
+        _, self.dstate = paged_decode_step(
+            dp, d_toks_dev[:, -1], self.dstate, dc)
+        # the round's bulk host sync: proposals + target choices together
+        d_toks = np.asarray(d_toks_dev)
+        choice = np.asarray(jnp.argmax(lg_t, axis=-1))      # [slots, k+1]
+        undo = np.zeros(len(self.slots), np.int32)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            n_acc = 0
+            while n_acc < k and d_toks[slot, n_acc] == choice[slot, n_acc]:
+                n_acc += 1
+            new = list(d_toks[slot, :n_acc]) + [int(choice[slot, n_acc])]
+            # budget and EOS trims (a speculative round can overshoot both)
+            new = new[: req.max_new_tokens - len(req.tokens)]
+            if self.eos_id is not None and self.eos_id in new:
+                new = new[: new.index(self.eos_id) + 1]
+            req.tokens += new
+            self._next_tok[slot] = new[-1]
+            undo[slot] = k + 1 - len(new)  # both states appended k+1
+        # ONE vectorized lengths-subtract per state (dead slots undo 0)
+        undo_dev = jnp.asarray(undo)
+        self.state = self.state._replace(lengths=self.state.lengths - undo_dev)
+        self.dstate = self.dstate._replace(
+            lengths=self.dstate.lengths - undo_dev)

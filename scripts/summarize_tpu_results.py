@@ -69,6 +69,19 @@ def main():
                   f"{r.get('fwd_bwd_ms', '—')} | {r['fwd_tflops_per_chip']} | "
                   f"{r.get('fwd_bwd_tflops_per_chip', '—')} |")
 
+    probe = _rows("results/batch_probe.jsonl")
+    if probe:
+        print("\nBATCH PROBE (fwd, per-step arithmetic):")
+        for r in probe:
+            if "error" in r:
+                print(f"  b={r['batch']} s={r['seq']} {r['grid']}: "
+                      f"ERROR {r['error'][:80]}")
+            else:
+                print(f"  b={r['batch']} s={r['seq']} bq={r['block_q']} "
+                      f"{r['grid']}: {r['tflops']} TFLOPs/s, "
+                      f"{r['us_per_step']} us/step "
+                      f"(init/fin frac {r['initfin_frac']})")
+
     serve = _rows("results/serve.jsonl")
     if serve:
         print("\nSERVING (paged continuous batching):")

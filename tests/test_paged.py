@@ -399,3 +399,51 @@ def test_prefix_cache_leaf_first_eviction():
     pool.release(ids2)            # sequence retires
     assert cache.evict(5) == 2    # now evictable, leaf-first
     assert pool.available == 7
+
+
+def test_multi_step_matches_sequential_steps(model):
+    """paged_multi_step(T tokens) produces the same per-position logits
+    and the same end state as T sequential paged_decode_steps — the
+    contract speculative verification depends on.  Mixed live/dead slots;
+    rollback_tokens then re-append reproduces the original logits."""
+    from burst_attn_tpu.models.paged_decode import (
+        paged_multi_step, rollback_tokens,
+    )
+
+    cfg, params = model
+    prompt = jax.random.randint(jax.random.PRNGKey(40), (9,), 1, cfg.vocab)
+    toks = jax.random.randint(jax.random.PRNGKey(41), (4,), 1, cfg.vocab)
+
+    def fresh():
+        state, pool = init_paged_state(cfg, slots=2, n_pages=8, page=128,
+                                       max_pages_per_seq=3)
+        _, state = paged_prefill(params, prompt, state, pool, 0, cfg)
+        return provision_capacity(state, pool, 0, 8), pool
+
+    # sequential: T single steps (slot 1 stays dead)
+    state_a, _ = fresh()
+    seq_logits = []
+    blank = jnp.zeros((2,), jnp.int32)
+    for i in range(4):
+        lg, state_a = paged_decode_step(params, blank.at[0].set(toks[i]),
+                                        state_a, cfg)
+        seq_logits.append(np.asarray(lg[0]))
+
+    # one multi-token call
+    state_b, _ = fresh()
+    lg_all, state_b = paged_multi_step(
+        params, jnp.stack([toks, jnp.zeros_like(toks)]), state_b, cfg)
+    for i in range(4):
+        np.testing.assert_allclose(np.asarray(lg_all[0, i]), seq_logits[i],
+                                   rtol=2e-5, atol=2e-5, err_msg=f"pos {i}")
+    assert int(state_b.lengths[0]) == int(state_a.lengths[0]) == 13
+    assert int(state_b.lengths[1]) == 0  # dead slot untouched
+
+    # rollback 3 of the 4, re-append the same 3: identical logits again
+    state_b = rollback_tokens(state_b, 0, 3)
+    assert int(state_b.lengths[0]) == 10
+    lg2, state_b = paged_multi_step(
+        params, jnp.stack([toks[1:], jnp.zeros(3, jnp.int32)]), state_b, cfg)
+    for i in range(3):
+        np.testing.assert_allclose(np.asarray(lg2[0, i]), seq_logits[i + 1],
+                                   rtol=2e-5, atol=2e-5)

@@ -189,3 +189,62 @@ def test_prefix_cache_eviction_under_pressure(model):
     assert len(eng.cache) == 3
     total_live = (5 - 1) - eng.pool.available
     assert total_live == len(eng.cache)  # only cache refs remain
+
+
+def test_speculative_serving_matches_plain_engine(model):
+    """Continuous batching WITH a draft model: per-request outputs are
+    token-exact with the plain (non-speculative) engine — staggered
+    lengths, slot reuse, and budget/EOS trims included."""
+    cfg, params = model
+    cfg_d = ModelConfig(
+        vocab=cfg.vocab, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        d_head=8, d_ff=64, block_q=8, block_kv=8, attn_backend="jnp",
+        remat=False, dtype=jnp.float32, batch_axis=None, head_axis=None,
+    )
+    params_d = init_params(jax.random.PRNGKey(77), cfg_d)
+    prompts = _prompts(cfg, [9, 5, 12, 7], seed=71)
+    steps = [6, 4, 3, 7]
+
+    def run(draft):
+        kw = dict(draft_params=params_d, draft_cfg=cfg_d,
+                  spec_k=3) if draft else {}
+        eng = ServeEngine(params, cfg, slots=2, n_pages=12, page=128,
+                          max_pages_per_seq=3, **kw)
+        rids = [eng.submit(p, s) for p, s in zip(prompts, steps)]
+        out = eng.run()
+        assert eng.pool.available == 11
+        if draft:
+            assert eng.dpool.available == 11
+        return [out[r] for r in rids]
+
+    base = run(False)
+    spec = run(True)
+    assert spec == base
+
+
+def test_speculative_serving_self_draft_and_eos(model):
+    """draft == target: every proposal accepted (rounds collapse); and an
+    EOS inside an accepted block stops the request mid-round."""
+    cfg, params = model
+    (p0,) = _prompts(cfg, [9], seed=81)
+    eng = ServeEngine(params, cfg, slots=1, n_pages=8, page=128,
+                      max_pages_per_seq=3,
+                      draft_params=params, draft_cfg=cfg, spec_k=3)
+    r0 = eng.submit(p0, 9)
+    out = eng.run()
+    from burst_attn_tpu.models.decode import generate
+    want = np.asarray(generate(params, p0[None], cfg, steps=9,
+                               max_seq=256))[0]
+    np.testing.assert_array_equal(np.asarray(out[r0]), want)
+
+    # EOS mid-block: designate token #3 of the greedy stream as eos
+    eos = int(want[2])
+    eng2 = ServeEngine(params, cfg, slots=1, n_pages=8, page=128,
+                       max_pages_per_seq=3, eos_id=eos,
+                       draft_params=params, draft_cfg=cfg, spec_k=4)
+    r1 = eng2.submit(p0, 9)
+    out2 = eng2.run()
+    # stop at the FIRST occurrence of the eos VALUE in the greedy stream
+    # (which may precede position 3 if the stream repeats tokens)
+    first = int(np.where(want == eos)[0][0])
+    assert out2[r1] == list(want[: first + 1])
