@@ -76,6 +76,37 @@ def main():
         (4, 32768, 1024, False),
         (8, 16384, None, False),   # extreme: nqb=8, 4/9 steps init/fin
     ]
+
+    def run_ablate(b, s):
+        """nosoftmax ablation at batch b (discriminator: if the batch
+        regression SURVIVES with the whole VPU softmax chain stripped,
+        it is grid/DMA-side — per-step overhead, megacore, state blocks —
+        not VPU scheduling)."""
+        from burst_attn_tpu.ops.masks import round_spec
+        from burst_attn_tpu.ops.pallas_flash import flash_fwd
+        from burst_attn_tpu.ops.tile import init_state
+
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, n, s, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, n, s, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, n, s, d), jnp.bfloat16)
+        spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
+        try:
+            f = jax.jit(lambda q, k, v: jnp.sum(flash_fwd(
+                q, k, v, *init_state(b, n, s, d), d**-0.5, spec,
+                block_q=2048, block_kv=2048, block_kv_compute=1024,
+                triangular=True, _ablate="nosoftmax")[2]))
+            t = bench_fn(f, q, k, v)
+            record({"batch": b, "seq": s, "block_q": 2048, "grid": "tri",
+                    "ablate": "nosoftmax", "ms": round(t * 1e3, 2),
+                    "tflops": round(flops(b, s, n, d, "fwd", True)
+                                    / t / 1e12, 1)})
+        except Exception as e:  # noqa: BLE001
+            record({"batch": b, "seq": s, "ablate": "nosoftmax",
+                    "error": f"{type(e).__name__}: {e}"[:200]})
+
+
     for b, s, bq, no_tri in cases:
         key = jax.random.PRNGKey(0)
         kq, kk, kv = jax.random.split(key, 3)
@@ -108,6 +139,11 @@ def main():
         finally:
             if no_tri:
                 os.environ.pop("BURST_NO_TRI", None)
+
+    # ablation discriminator AFTER the anchors (a tunnel drop should cost
+    # the extras, not the baseline rows)
+    run_ablate(1, 32768)
+    run_ablate(4, 32768)
 
     if args.trace_dir:
         b, s = 4, 32768

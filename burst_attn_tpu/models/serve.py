@@ -46,7 +46,7 @@ import numpy as np
 from .decode import sample_logits
 from .paged_decode import (
     PrefixCache, init_paged_state, paged_decode_step, paged_multi_step,
-    paged_prefill, provision_capacity, retire_slot, rollback_tokens,
+    paged_prefill, provision_capacity, retire_slot,
 )
 from .transformer import ModelConfig
 
@@ -304,7 +304,8 @@ class ServeEngine:
             n_acc = 0
             while n_acc < k and d_toks[slot, n_acc] == choice[slot, n_acc]:
                 n_acc += 1
-            new = list(d_toks[slot, :n_acc]) + [int(choice[slot, n_acc])]
+            new = ([int(x) for x in d_toks[slot, :n_acc]]
+                   + [int(choice[slot, n_acc])])
             # budget and EOS trims (a speculative round can overshoot both)
             new = new[: req.max_new_tokens - len(req.tokens)]
             if self.eos_id is not None and self.eos_id in new:
@@ -312,7 +313,10 @@ class ServeEngine:
             req.tokens += new
             self._next_tok[slot] = new[-1]
             undo[slot] = k + 1 - len(new)  # both states appended k+1
-        # ONE vectorized lengths-subtract per state (dead slots undo 0)
+        # ONE vectorized lengths-subtract per state (dead slots undo 0).
+        # Intentionally NOT rollback_tokens: its per-slot n < length guard
+        # is satisfied by construction here (live slots keep >= 1 token)
+        # and per-slot calls would cost a host fetch + dispatch each
         undo_dev = jnp.asarray(undo)
         self.state = self.state._replace(lengths=self.state.lengths - undo_dev)
         self.dstate = self.dstate._replace(

@@ -73,14 +73,16 @@ def main():
     if probe:
         print("\nBATCH PROBE (fwd, per-step arithmetic):")
         for r in probe:
+            tag = " [nosoftmax]" if r.get("ablate") else ""
             if "error" in r:
-                print(f"  b={r['batch']} s={r['seq']} {r['grid']}: "
-                      f"ERROR {r['error'][:80]}")
+                print(f"  b={r['batch']} s={r['seq']} "
+                      f"{r.get('grid', '?')}{tag}: ERROR {r['error'][:80]}")
             else:
-                print(f"  b={r['batch']} s={r['seq']} bq={r['block_q']} "
-                      f"{r['grid']}: {r['tflops']} TFLOPs/s, "
-                      f"{r['us_per_step']} us/step "
-                      f"(init/fin frac {r['initfin_frac']})")
+                extra = (f", {r['us_per_step']} us/step (init/fin frac "
+                         f"{r['initfin_frac']})" if "us_per_step" in r else "")
+                print(f"  b={r['batch']} s={r['seq']} "
+                      f"bq={r.get('block_q', '?')} {r.get('grid', '?')}{tag}: "
+                      f"{r['tflops']} TFLOPs/s{extra}")
 
     serve = _rows("results/serve.jsonl")
     if serve:
