@@ -81,27 +81,17 @@ def main():
         """nosoftmax ablation at batch b (discriminator: if the batch
         regression SURVIVES with the whole VPU softmax chain stripped,
         it is grid/DMA-side — per-step overhead, megacore, state blocks —
-        not VPU scheduling)."""
-        from burst_attn_tpu.ops.masks import round_spec
-        from burst_attn_tpu.ops.pallas_flash import flash_fwd
-        from burst_attn_tpu.ops.tile import init_state
+        not VPU scheduling).  Timing scaffold shared with sweep_blocks
+        (benchmarks.benchmark.time_flash_fwd)."""
+        from benchmarks.benchmark import time_flash_fwd
 
-        key = jax.random.PRNGKey(0)
-        kq, kk, kv = jax.random.split(key, 3)
-        q = jax.random.normal(kq, (b, n, s, d), jnp.bfloat16)
-        k = jax.random.normal(kk, (b, n, s, d), jnp.bfloat16)
-        v = jax.random.normal(kv, (b, n, s, d), jnp.bfloat16)
-        spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
         try:
-            f = jax.jit(lambda q, k, v: jnp.sum(flash_fwd(
-                q, k, v, *init_state(b, n, s, d), d**-0.5, spec,
-                block_q=2048, block_kv=2048, block_kv_compute=1024,
-                triangular=True, _ablate="nosoftmax")[2]))
-            t = bench_fn(f, q, k, v)
+            t, tf = time_flash_fwd(b, n, s, d, block_q=2048, block_kv=2048,
+                                   block_kv_compute=1024,
+                                   _ablate="nosoftmax")
             record({"batch": b, "seq": s, "block_q": 2048, "grid": "tri",
                     "ablate": "nosoftmax", "ms": round(t * 1e3, 2),
-                    "tflops": round(flops(b, s, n, d, "fwd", True)
-                                    / t / 1e12, 1)})
+                    "tflops": round(tf, 1)})
         except Exception as e:  # noqa: BLE001
             record({"batch": b, "seq": s, "ablate": "nosoftmax",
                     "error": f"{type(e).__name__}: {e}"[:200]})

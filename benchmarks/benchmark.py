@@ -55,6 +55,32 @@ def bench_fn(fn, *args, warmup=3, iters=10, reps=3):
     return float(np.min(ts))
 
 
+def time_flash_fwd(b, n, s, d, *, block_q, block_kv, block_kv_compute=None,
+                   n_kv=None, triangular=True, **fwd_kw):
+    """Time ONE raw flash_fwd config on fresh bf16 inputs — the
+    kernel-sweep scaffold shared by sweep_blocks (--fwd-loop/--ablate-fwd)
+    and batch_probe (nosoftmax rows), so the two probes cannot silently
+    drift apart.  Returns (seconds, fwd TFLOPs/s).  fwd_kw passes through
+    to flash_fwd (loop_sweep=True, _ablate="nosoftmax", ...)."""
+    from burst_attn_tpu.ops.masks import round_spec
+    from burst_attn_tpu.ops.pallas_flash import flash_fwd
+    from burst_attn_tpu.ops.tile import init_state
+
+    n_kv = n_kv or n
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, n, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, n_kv, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, n_kv, s, d), jnp.bfloat16)
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
+    f = jax.jit(lambda q, k, v: jnp.sum(flash_fwd(
+        q, k, v, *init_state(b, n, s, d), d**-0.5, spec,
+        block_q=block_q, block_kv=block_kv,
+        block_kv_compute=block_kv_compute, triangular=triangular,
+        **fwd_kw)[2]))
+    t = bench_fn(f, q, k, v)
+    return t, flops(b, s, n, d, "fwd", True) / t / 1e12
+
+
 def _scalar_grads(grads):
     return sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
 

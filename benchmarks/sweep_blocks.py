@@ -89,29 +89,20 @@ def main():
                     "error": f"{type(e).__name__}: {e}"[:200]})
 
     def bench_flash_fwd(pass_name, cfgs, **fwd_kw):
-        """Shared scaffold for the raw-flash_fwd timing modes (loop /
-        ablation variants): one jit per BQxBKV[xBKC] config, rows appended
-        with the common shape fields."""
-        from burst_attn_tpu.ops.masks import round_spec
-        from burst_attn_tpu.ops.pallas_flash import flash_fwd
-        from burst_attn_tpu.ops.tile import init_state
+        """Raw-flash_fwd timing modes (loop / ablation variants): one row
+        per BQxBKV[xBKC] config via the scaffold shared with batch_probe
+        (benchmarks.benchmark.time_flash_fwd)."""
+        from benchmarks.benchmark import time_flash_fwd
 
-        spec = round_spec(jnp.int32(0), jnp.int32(0), seq, seq, True, "contig")
         for cfg in cfgs:
             bq, bkv = cfg[0], cfg[1]
             bkc = cfg[2] if len(cfg) > 2 else None
             row = {"pass": pass_name, "bq": bq, "bkv": bkv, "bkc": bkc}
             try:
-                f = jax.jit(lambda q, k, v, bq=bq, bkv=bkv, bkc=bkc:
-                            jnp.sum(flash_fwd(
-                                q, k, v, *init_state(b, n, seq, d), d**-0.5,
-                                spec, block_q=bq, block_kv=bkv,
-                                block_kv_compute=bkc, triangular=True,
-                                **fwd_kw)[2]))
-                t = bench_fn(f, q, k, v)
-                row.update(ms=round(t * 1e3, 2),
-                           tflops=round(flops(b, seq, n, d, "fwd", True)
-                                        / t / 1e12, 1))
+                t, tf = time_flash_fwd(b, n, seq, d, n_kv=nkv, block_q=bq,
+                                       block_kv=bkv, block_kv_compute=bkc,
+                                       **fwd_kw)
+                row.update(ms=round(t * 1e3, 2), tflops=round(tf, 1))
             except Exception as e:  # noqa: BLE001
                 row.update(error=f"{type(e).__name__}: {e}"[:200])
             record(row)

@@ -354,6 +354,25 @@ def _suffix_attention(q, k, v, t_pre, q_hi, kv_hi, window=None,
     return jnp.einsum("bnij,bnjd->bnid", p, vf).astype(q.dtype)
 
 
+def _suffix_attention_dispatch(q, k, v, t_pre, q_hi, kv_hi, cfg, mesh):
+    """Head-sharded suffix attention under a tp mesh — same rationale as
+    _prompt_attention_dispatch: the Pallas flash call cannot be split by
+    GSPMD.  The traced q_hi/kv_hi bounds ride in replicated."""
+    if _check_tp_mesh(cfg, mesh) == 1:
+        return _suffix_attention(q, k, v, t_pre, q_hi=q_hi, kv_hi=kv_hi,
+                                 window=cfg.window)
+    spec = P(None, cfg.head_axis, None, None)
+    fn = jax.shard_map(
+        lambda q_, k_, v_, qh, kh: _suffix_attention(
+            q_, k_, v_, t_pre, q_hi=qh, kv_hi=kh, window=cfg.window),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P(), P()),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, q_hi, kv_hi)
+
+
 def init_paged_state(cfg: ModelConfig, *, slots: int, n_pages: int,
                      page: int = 128, max_pages_per_seq: int = 64,
                      quantize: bool = False) -> Tuple[PagedState, PagePool]:
@@ -406,7 +425,7 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
     row.  Returns (last-token logits [vocab] fp32, new PagedState); the
     acquired page ids are recorded in the returned state's table.
 
-    `cache` (PrefixCache, bf16/unsharded serving only): full pages whose
+    `cache` (PrefixCache, bf16 pools only): full pages whose
     token prefix is cached are REUSED — their K/V is never recomputed, the
     suffix runs a shorter prefill attending the cached context through an
     offset spec (_suffix_attention) — and this prompt's own full pages are
@@ -431,9 +450,6 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
         if state.k_scales is not None:
             raise ValueError("prefix caching with int8 pools is not "
                              "supported (dequant scales are per-request)")
-        if mesh is not None:
-            raise ValueError("prefix caching with a tp mesh is not "
-                             "supported yet; pass cache=None")
         hashes = PrefixCache.chain(tokens, page)
         # always leave >= 1 suffix token: the caller needs last-token logits
         hits = cache.lookup(hashes[: (t - 1) // page])
@@ -455,7 +471,7 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
                     params, suffix[None, :], state,
                     jnp.asarray(hits, jnp.int32),
                     jnp.asarray(ids, jnp.int32), jnp.int32(slot),
-                    jnp.int32(t_suf), cfg, t_pre)
+                    jnp.int32(t_suf), cfg, t_pre, mesh)
             except Exception:
                 pool.release(ids + hits)  # hits carry our lookup refs
                 raise
@@ -552,10 +568,11 @@ def _paged_prefill_jit(params, tokens, state: PagedState, page_ids,
 # compile key: (cached-page count, suffix-page count) — the caller pads the
 # suffix tokens to a page multiple and passes the true length as a TRACED
 # scalar, so naturally varying prompt tails share one program
-@partial(jax.jit, static_argnames=("cfg", "t_pre"), donate_argnums=(2,))
+@partial(jax.jit, static_argnames=("cfg", "t_pre", "mesh"),
+         donate_argnums=(2,))
 def _paged_prefill_suffix_jit(params, tokens, state: PagedState, ctx_ids,
                               suf_ids, slot, t_suf, cfg: ModelConfig,
-                              t_pre: int):
+                              t_pre: int, mesh=None):
     """Prefill of a prompt whose first t_pre tokens' K/V already sit in
     cached pages (ctx_ids): compute q/k/v for the SUFFIX only (tokens is
     the suffix PADDED to a page multiple; t_suf the real length), attend
@@ -579,8 +596,9 @@ def _paged_prefill_suffix_jit(params, tokens, state: PagedState, ctx_ids,
             [kc.astype(cfg.dtype), k.astype(cfg.dtype)], axis=2)
         v_full = jnp.concatenate(
             [vc.astype(cfg.dtype), v.astype(cfg.dtype)], axis=2)
-        return _suffix_attention(q, k_full, v_full, t_pre, q_hi=t_suf,
-                                 kv_hi=t_pre + t_suf, window=cfg.window)
+        return _suffix_attention_dispatch(q, k_full, v_full, t_pre,
+                                          q_hi=t_suf, kv_hi=t_pre + t_suf,
+                                          cfg=cfg, mesh=mesh)
 
     def layer_scatter(li, kp, vp, k, v):
         kp2, _ = _scatter_pages(kp, k, suf_ids)

@@ -248,3 +248,31 @@ def test_speculative_serving_self_draft_and_eos(model):
     # (which may precede position 3 if the stream repeats tokens)
     first = int(np.where(want == eos)[0][0])
     assert out2[r1] == list(want[: first + 1])
+
+
+def test_prefix_cache_tp_matches_unsharded(model):
+    """Prefix-cached prefill under a tp mesh (head-sharded suffix
+    attention through _suffix_attention_dispatch) reproduces the
+    unsharded cached engine exactly."""
+    import dataclasses
+
+    from burst_attn_tpu.models.train import make_mesh
+
+    cfg, params = model
+    cfgt = dataclasses.replace(cfg, head_axis="tp")
+    mesh = make_mesh({"tp": 2})
+    rng = np.random.RandomState(23)
+    prefix = rng.randint(1, cfg.vocab, 256)
+    prompts = [np.concatenate([prefix, rng.randint(1, cfg.vocab, 9 + i)])
+               for i in range(3)]
+
+    def run(mesh_arg, c):
+        eng = ServeEngine(params, c, slots=2, n_pages=16, page=128,
+                          max_pages_per_seq=4, mesh=mesh_arg,
+                          prefix_cache=True)
+        rids = [eng.submit(p, 4) for p in prompts]
+        out = eng.run()
+        assert len(eng.cache) >= 2  # the shared prefix registered
+        return [out[r] for r in rids]
+
+    assert run(None, cfg) == run(mesh, cfgt)
