@@ -38,11 +38,9 @@ def main(argv=None):
     ap.add_argument("--prefix-cache", action="store_true",
                     help="churn phase: share one --context/2 token prefix "
                          "across all requests and serve with automatic "
-                         "prefix caching (bf16 only)")
+                         "prefix caching")
     ap.add_argument("--out", default="results/serve.jsonl")
     args = ap.parse_args(argv)
-    if args.prefix_cache and args.quantize:
-        ap.error("--prefix-cache requires bf16 pools (drop --quantize)")
 
     import jax
     import jax.numpy as jnp

@@ -425,7 +425,8 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
     row.  Returns (last-token logits [vocab] fp32, new PagedState); the
     acquired page ids are recorded in the returned state's table.
 
-    `cache` (PrefixCache, bf16 pools only): full pages whose
+    `cache` (PrefixCache; bf16 or int8 pools — shared pages' dequant
+    scales are pool state shared exactly like the K/V bytes): full pages whose
     token prefix is cached are REUSED — their K/V is never recomputed, the
     suffix runs a shorter prefill attending the cached context through an
     offset spec (_suffix_attention) — and this prompt's own full pages are
@@ -447,9 +448,6 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
             f"slot {slot} is still live (len {int(state.lengths[slot])}); "
             "retire_slot first or its pages leak")
     if cache is not None:
-        if state.k_scales is not None:
-            raise ValueError("prefix caching with int8 pools is not "
-                             "supported (dequant scales are per-request)")
         hashes = PrefixCache.chain(tokens, page)
         # always leave >= 1 suffix token: the caller needs last-token logits
         hits = cache.lookup(hashes[: (t - 1) // page])
@@ -582,16 +580,26 @@ def _paged_prefill_suffix_jit(params, tokens, state: PagedState, ctx_ids,
     the full prefill."""
     b, t_pad = tokens.shape
     nkv, d_head = cfg.n_kv_heads, cfg.d_head
+    quant = state.k_scales is not None
     pos = t_pre + jnp.broadcast_to(jnp.arange(t_pad, dtype=jnp.int32)[None],
                                    (b, t_pad))
 
+    def _gather_ctx(pages, scales):
+        """[n_ctx, Nkv, page, D] pages -> [1, Nkv, t_pre, D] context,
+        dequantized with the gathered per-token scales when int8 (shared
+        pages' scales are pool state, deterministic from token content —
+        safe to share across requests exactly like the K/V bytes)."""
+        g = pages[ctx_ids]
+        if scales is not None:
+            g = g.astype(jnp.float32) * scales[ctx_ids][..., None]
+        return jnp.moveaxis(g, 0, 1).reshape(nkv, t_pre, d_head)[None]
+
     def layer_attn(li, q, k, v):
-        # cached context, gathered page-contiguous: [n_ctx, Nkv, page, D]
-        # -> [1, Nkv, t_pre, D]; pad rows/cols stay invisible through the
-        # traced q_hi / kv_hi bounds
-        kp, vp = state.k_pages[li], state.v_pages[li]
-        kc = jnp.moveaxis(kp[ctx_ids], 0, 1).reshape(nkv, t_pre, d_head)[None]
-        vc = jnp.moveaxis(vp[ctx_ids], 0, 1).reshape(nkv, t_pre, d_head)[None]
+        # pad rows/cols stay invisible through the traced q_hi/kv_hi bounds
+        kc = _gather_ctx(state.k_pages[li],
+                         state.k_scales[li] if quant else None)
+        vc = _gather_ctx(state.v_pages[li],
+                         state.v_scales[li] if quant else None)
         k_full = jnp.concatenate(
             [kc.astype(cfg.dtype), k.astype(cfg.dtype)], axis=2)
         v_full = jnp.concatenate(
@@ -601,11 +609,13 @@ def _paged_prefill_suffix_jit(params, tokens, state: PagedState, ctx_ids,
                                           cfg=cfg, mesh=mesh)
 
     def layer_scatter(li, kp, vp, k, v):
-        kp2, _ = _scatter_pages(kp, k, suf_ids)
-        vp2, _ = _scatter_pages(vp, v, suf_ids)
-        return kp2, None, vp2, None
+        kp2, ks2 = _scatter_pages(
+            kp, k, suf_ids, state.k_scales[li] if quant else None)
+        vp2, vs2 = _scatter_pages(
+            vp, v, suf_ids, state.v_scales[li] if quant else None)
+        return kp2, ks2, vp2, vs2
 
-    x, k_pools, v_pools, _, _ = _absorb_prompt(
+    x, k_pools, v_pools, k_scs, v_scs = _absorb_prompt(
         params, tokens, pos, state, cfg, layer_attn, layer_scatter)
     x_last = lax.dynamic_slice_in_dim(x, t_suf - 1, 1, axis=1)
     logits = jnp.einsum("bsd,vd->bsv", x_last, params["lm_head"],
@@ -613,7 +623,8 @@ def _paged_prefill_suffix_jit(params, tokens, state: PagedState, ctx_ids,
     table = _write_table_row(state, slot, jnp.concatenate([ctx_ids, suf_ids]))
     lengths = state.lengths.at[slot].set(t_pre + t_suf)
     return logits, PagedState(
-        tuple(k_pools), tuple(v_pools), table, lengths, None, None)
+        tuple(k_pools), tuple(v_pools), table, lengths,
+        tuple(k_scs) if quant else None, tuple(v_scs) if quant else None)
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))

@@ -80,8 +80,6 @@ class ServeEngine:
         self.state, self.pool = init_paged_state(
             cfg, slots=slots, n_pages=n_pages, page=page,
             max_pages_per_seq=max_pages_per_seq, quantize=quantize)
-        if prefix_cache and quantize:
-            raise ValueError("prefix_cache requires bf16 pools")
         self.cache = PrefixCache(self.pool) if prefix_cache else None
         # speculative serving: a DRAFT model with its own paged state whose
         # slot geometry mirrors the target's; greedy only (acceptance =

@@ -276,3 +276,58 @@ def test_prefix_cache_tp_matches_unsharded(model):
         return [out[r] for r in rids]
 
     assert run(None, cfg) == run(mesh, cfgt)
+
+
+def test_prefix_cache_int8_tracks_uncached(model):
+    """int8 pools + prefix caching: shared pages' dequant scales are pool
+    state shared exactly like the K/V bytes.  Greedy tokens track the
+    uncached int8 engine (the cached prefix context is read dequantized
+    where the uncached prefill saw full precision — quantization noise is
+    far below this tiny model's logit margins, same argument as
+    test_quantized_generate_tracks_dense)."""
+    cfg, params = model
+    rng = np.random.RandomState(29)
+    prefix = rng.randint(1, cfg.vocab, 256)
+    prompts = [np.concatenate([prefix, rng.randint(1, cfg.vocab, 7 + i)])
+               for i in range(3)]
+
+    def run(cache):
+        eng = ServeEngine(params, cfg, slots=2, n_pages=16, page=128,
+                          max_pages_per_seq=4, quantize=True,
+                          prefix_cache=cache)
+        rids = [eng.submit(p, 4) for p in prompts]
+        out = eng.run()
+        if cache:
+            assert len(eng.cache) == 2
+        return [out[r] for r in rids]
+
+    assert run(True) == run(False)
+
+
+def test_prefix_cache_int8_tp_full_cross_product(model):
+    """The full combination — int8 pools x tp mesh x prefix cache — in one
+    engine: scale-aware gather feeding the head-sharded suffix attention
+    plus scale scatter under GSPMD in the donated jit.  Tracks the
+    unsharded int8 cached engine exactly (same pools, same dequant)."""
+    import dataclasses
+
+    from burst_attn_tpu.models.train import make_mesh
+
+    cfg, params = model
+    cfgt = dataclasses.replace(cfg, head_axis="tp")
+    mesh = make_mesh({"tp": 2})
+    rng = np.random.RandomState(31)
+    prefix = rng.randint(1, cfg.vocab, 128)
+    prompts = [np.concatenate([prefix, rng.randint(1, cfg.vocab, 6 + i)])
+               for i in range(3)]
+
+    def run(mesh_arg, c):
+        eng = ServeEngine(params, c, slots=2, n_pages=12, page=128,
+                          max_pages_per_seq=3, quantize=True, mesh=mesh_arg,
+                          prefix_cache=True)
+        rids = [eng.submit(p, 4) for p in prompts]
+        out = eng.run()
+        assert len(eng.cache) == 1
+        return [out[r] for r in rids]
+
+    assert run(None, cfg) == run(mesh, cfgt)
