@@ -397,6 +397,18 @@ def init_paged_state(cfg: ModelConfig, *, slots: int, n_pages: int,
             PagePool(n_pages))
 
 
+def _gather_dequant_pages(pages, scales, idx, n_kv, d_head):
+    """Gather pool pages page-contiguously, dequantizing when int8:
+    idx [..., n] -> [..., n_kv, n*page, d_head].  The ONE place the
+    dequant-gather convention lives (suffix prefill + multi-step read
+    through it; a dtype/layout change lands in both or neither)."""
+    g = pages[idx]
+    if scales is not None:
+        g = g.astype(jnp.float32) * scales[idx][..., None]
+    g = jnp.moveaxis(g, -3, -4)
+    return g.reshape(*g.shape[:-4], n_kv, g.shape[-3] * g.shape[-2], d_head)
+
+
 def _scatter_pages(pages, new, page_ids, scales=None):
     """Write [1, Nkv, T, D] rope'd K/V into pool pages `page_ids` (device
     scatter; T padded to a whole number of pages by the caller).  With
@@ -584,22 +596,17 @@ def _paged_prefill_suffix_jit(params, tokens, state: PagedState, ctx_ids,
     pos = t_pre + jnp.broadcast_to(jnp.arange(t_pad, dtype=jnp.int32)[None],
                                    (b, t_pad))
 
-    def _gather_ctx(pages, scales):
-        """[n_ctx, Nkv, page, D] pages -> [1, Nkv, t_pre, D] context,
-        dequantized with the gathered per-token scales when int8 (shared
-        pages' scales are pool state, deterministic from token content —
-        safe to share across requests exactly like the K/V bytes)."""
-        g = pages[ctx_ids]
-        if scales is not None:
-            g = g.astype(jnp.float32) * scales[ctx_ids][..., None]
-        return jnp.moveaxis(g, 0, 1).reshape(nkv, t_pre, d_head)[None]
-
     def layer_attn(li, q, k, v):
-        # pad rows/cols stay invisible through the traced q_hi/kv_hi bounds
-        kc = _gather_ctx(state.k_pages[li],
-                         state.k_scales[li] if quant else None)
-        vc = _gather_ctx(state.v_pages[li],
-                         state.v_scales[li] if quant else None)
+        # context dequantized through the shared gather (int8 shared pages'
+        # scales are pool state, deterministic from token content — safe to
+        # share across requests exactly like the K/V bytes); pad rows/cols
+        # stay invisible through the traced q_hi/kv_hi bounds
+        kc = _gather_dequant_pages(
+            state.k_pages[li], state.k_scales[li] if quant else None,
+            ctx_ids, nkv, d_head)[None]
+        vc = _gather_dequant_pages(
+            state.v_pages[li], state.v_scales[li] if quant else None,
+            ctx_ids, nkv, d_head)[None]
         k_full = jnp.concatenate(
             [kc.astype(cfg.dtype), k.astype(cfg.dtype)], axis=2)
         v_full = jnp.concatenate(
@@ -714,12 +721,10 @@ def paged_multi_step(params, tokens, state: PagedState, cfg: ModelConfig):
     Capacity for all T tokens must be pre-assigned (provision_capacity);
     dead slots scatter into the sink page and emit garbage logits the
     caller ignores.  Speculative ROLLBACK is `rollback_tokens` — a pure
-    lengths decrement, because entries past lengths are invisible.
-
-    bf16 pools only (int8 per-token quantization of partially-accepted
-    speculative tokens would leave stale scales behind rollbacks)."""
-    if state.k_scales is not None:
-        raise ValueError("paged_multi_step requires bf16 pools")
+    lengths decrement, because entries past lengths are invisible; with
+    int8 pools the rolled-back tokens' stale SCALES are equally invisible
+    and the next append overwrites values and scales together."""
+    quant = state.k_scales is not None
     slots, t = tokens.shape
     page = state.k_pages[0].shape[2]
     max_ctx = state.page_table.shape[1] * page
@@ -739,19 +744,30 @@ def paged_multi_step(params, tokens, state: PagedState, cfg: ModelConfig):
     offs = pos % page
     col = jnp.arange(max_ctx, dtype=jnp.int32)[None, :]           # [1, ctx]
     x = params["embed"].astype(cfg.dtype)[tokens]                 # [S,T,dm]
-    k_pools, v_pools = [], []
-    for p, kp, vp in zip(params["layers"], state.k_pages, state.v_pages):
+    k_pools, v_pools, k_scs, v_scs = [], [], [], []
+    for li, (p, kp, vp) in enumerate(zip(params["layers"], state.k_pages,
+                                         state.v_pages)):
         q, k, v = _qkv_proj(p, x, pos, cfg)
         # scatter new K/V: [slots, T, Nkv, D] at ([slots,T] pages, offsets)
-        kp = kp.at[pids, :, offs].set(
-            jnp.moveaxis(k, 1, 2).astype(kp.dtype))
-        vp = vp.at[pids, :, offs].set(
-            jnp.moveaxis(v, 1, 2).astype(vp.dtype))
+        k_rows = jnp.moveaxis(k, 1, 2)
+        v_rows = jnp.moveaxis(v, 1, 2)
+        ks = vs = None
+        if quant:
+            k8, k_s = quantize_tokens(k_rows)
+            v8, v_s = quantize_tokens(v_rows)
+            kp = kp.at[pids, :, offs].set(k8)
+            vp = vp.at[pids, :, offs].set(v8)
+            ks = state.k_scales[li].at[pids, :, offs].set(k_s)
+            vs = state.v_scales[li].at[pids, :, offs].set(v_s)
+        else:
+            kp = kp.at[pids, :, offs].set(k_rows.astype(kp.dtype))
+            vp = vp.at[pids, :, offs].set(v_rows.astype(vp.dtype))
+
         # gather each slot's full context (now including the new tokens)
-        kc = jnp.moveaxis(kp[state.page_table], 2, 1).reshape(
-            slots, cfg.n_kv_heads, max_ctx, cfg.d_head)
-        vc = jnp.moveaxis(vp[state.page_table], 2, 1).reshape(
-            slots, cfg.n_kv_heads, max_ctx, cfg.d_head)
+        kc = _gather_dequant_pages(kp, ks, state.page_table,
+                                   cfg.n_kv_heads, cfg.d_head)
+        vc = _gather_dequant_pages(vp, vs, state.page_table,
+                                   cfg.n_kv_heads, cfg.d_head)
         qg = q.reshape(slots, cfg.n_kv_heads, group, t, cfg.d_head)
         s = jnp.einsum("bngtd,bnjd->bngtj", qg.astype(jnp.float32),
                        kc.astype(jnp.float32)) * cfg.d_head**-0.5
@@ -767,13 +783,16 @@ def paged_multi_step(params, tokens, state: PagedState, cfg: ModelConfig):
         x = x + m
         k_pools.append(kp)
         v_pools.append(vp)
+        k_scs.append(ks)
+        v_scs.append(vs)
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("btd,vd->btv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
     logits = jnp.where(boundary_unassigned[:, None, None], jnp.nan, logits)
     lengths = state.lengths + t * live.astype(jnp.int32)
-    return logits, PagedState(tuple(k_pools), tuple(v_pools),
-                              state.page_table, lengths, None, None)
+    return logits, PagedState(
+        tuple(k_pools), tuple(v_pools), state.page_table, lengths,
+        tuple(k_scs) if quant else None, tuple(v_scs) if quant else None)
 
 
 def rollback_tokens(state: PagedState, slot: int, n: int) -> PagedState:

@@ -83,16 +83,16 @@ class ServeEngine:
         self.cache = PrefixCache(self.pool) if prefix_cache else None
         # speculative serving: a DRAFT model with its own paged state whose
         # slot geometry mirrors the target's; greedy only (acceptance =
-        # target argmax match — see step()), bf16 pools only (the
-        # multi-token verify step requires them)
+        # target argmax match — see step()); int8 pools compose (rolled-
+        # back tokens' stale scales are as invisible as their K/V)
         self.draft = None
         self.spec_k = 0
         if draft_params is not None:
             if draft_cfg is None:
                 raise ValueError("draft_params needs draft_cfg")
-            if quantize or mesh is not None or temperature != 0.0:
-                raise ValueError("speculative serving requires bf16 pools, "
-                                 "no tp mesh, and temperature == 0")
+            if mesh is not None or temperature != 0.0:
+                raise ValueError("speculative serving requires no tp mesh "
+                                 "and temperature == 0")
             if draft_cfg.vocab != cfg.vocab:
                 raise ValueError("draft and target must share a vocabulary")
             if spec_k < 1:
@@ -101,7 +101,7 @@ class ServeEngine:
             self.spec_k = spec_k
             self.dstate, self.dpool = init_paged_state(
                 draft_cfg, slots=slots, n_pages=n_pages, page=page,
-                max_pages_per_seq=max_pages_per_seq)
+                max_pages_per_seq=max_pages_per_seq, quantize=quantize)
         self.slots: List[Optional[_Request]] = [None] * slots
         self._next_tok = np.zeros((slots,), np.int32)
         self._queue: List[_Request] = []

@@ -331,3 +331,28 @@ def test_prefix_cache_int8_tp_full_cross_product(model):
         return [out[r] for r in rids]
 
     assert run(None, cfg) == run(mesh, cfgt)
+
+
+def test_speculative_serving_int8_matches_plain_int8(model):
+    """Speculative continuous batching on int8 pools: token-exact with
+    the plain int8 engine (both sides read identical quantized context;
+    the draft's own pools are int8 too)."""
+    cfg, params = model
+    cfg_d = ModelConfig(
+        vocab=cfg.vocab, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        d_head=8, d_ff=64, block_q=8, block_kv=8, attn_backend="jnp",
+        remat=False, dtype=jnp.float32, batch_axis=None, head_axis=None,
+    )
+    params_d = init_params(jax.random.PRNGKey(91), cfg_d)
+    prompts = _prompts(cfg, [9, 6, 11], seed=93)
+
+    def run(draft):
+        kw = dict(draft_params=params_d, draft_cfg=cfg_d,
+                  spec_k=3) if draft else {}
+        eng = ServeEngine(params, cfg, slots=2, n_pages=12, page=128,
+                          max_pages_per_seq=3, quantize=True, **kw)
+        rids = [eng.submit(p, 5) for p in prompts]
+        out = eng.run()
+        return [out[r] for r in rids]
+
+    assert run(True) == run(False)
