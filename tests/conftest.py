@@ -75,6 +75,8 @@ _SLOW = {
     ("test_schedule.py", "test_schedule_matches_host_expectation"),
     ("test_ulysses.py", "test_ulysses_fwd_grad"),
     ("test_window.py", "test_burst_ring_window_grad"),
+    ("test_window.py", "test_window_double_ring_matches_dense"),
+    ("test_window.py", "test_ring_truncation_matches_dense"),
     ("test_window.py", "test_decode_window_matches_forward"),
     ("test_window.py", "test_model_trains_with_window"),
 }
