@@ -159,7 +159,7 @@ def prefill(params, tokens, cfg: ModelConfig, max_seq: int):
 
 
 def sample_logits(logits, key, *, temperature: float = 0.0, top_k=None,
-                  top_p=None):
+                  top_p=None, nan_sentinel: bool = False):
     """[B, V] logits -> [B] sampled token ids.
 
     temperature == 0 is greedy (top_k/top_p ignored).  Otherwise softmax
@@ -167,9 +167,23 @@ def sample_logits(logits, key, *, temperature: float = 0.0, top_k=None,
     top-p (nucleus) truncation — the kept set is the smallest prefix of
     the sorted distribution whose probability reaches top_p.  All
     selection is done by masking to -inf so the op stays one fused
-    [B, V]-wide program (no gathers of dynamic width)."""
+    [B, V]-wide program (no gathers of dynamic width).
+
+    nan_sentinel=True makes rows containing NaN sample -1 instead of a
+    silent argmax-of-NaN 0: the paged decode steps poison a slot's logits
+    with NaN when a live slot was stepped without capacity
+    (models/paged_decode.py loud-failure contract), and the sentinel
+    survives the host fetch so ServeEngine can raise without transferring
+    the [B, V] logits a second time.  It is OPT-IN because callers that
+    feed the sample straight back as the next input token (generate()'s
+    scan, dist_decode) would embed-gather index -1 instead."""
+    bad = jnp.any(jnp.isnan(logits), axis=-1) if nan_sentinel else None
     if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1)
+        tok = jnp.argmax(logits, axis=-1)
+        return tok if bad is None else jnp.where(bad, -1, tok)
+    if bad is not None:
+        # keep categorical's input finite for the poisoned rows
+        logits = jnp.where(bad[:, None], 0.0, logits)
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None:
         k_eff = min(int(top_k), logits.shape[-1])  # top_k > vocab = keep all
@@ -183,7 +197,8 @@ def sample_logits(logits, key, *, temperature: float = 0.0, top_k=None,
         thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
                          keepdims=True)
         logits = jnp.where(logits < thresh, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1)
+    tok = jax.random.categorical(key, logits, axis=-1)
+    return tok if bad is None else jnp.where(bad, -1, tok)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "max_seq", "temperature",

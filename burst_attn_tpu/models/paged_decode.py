@@ -31,6 +31,7 @@ same direction as models/decode.py but with pool semantics.  Kernel design
 notes in ops/paged_attention.py.
 """
 
+from collections import OrderedDict
 from functools import partial
 from typing import List, NamedTuple, Optional, Tuple
 
@@ -219,7 +220,9 @@ class PrefixCache:
     def __init__(self, pool: PagePool):
         self._pool = pool
         self._pages: "dict[bytes, int]" = {}   # prefix hash -> page id
-        self._lru: List[bytes] = []            # least recent first
+        # least recent first; OrderedDict keys give O(1) touch/remove
+        # (a plain list made every lookup hit O(n) and evictions O(n^2))
+        self._lru: "OrderedDict[bytes, None]" = OrderedDict()
         # chain structure: a lookup stops at the first miss, so an entry
         # whose PARENT is gone can never hit again — eviction must go
         # leaf-first or it orphans reachable descendants
@@ -245,8 +248,7 @@ class PrefixCache:
         return len(self._pages)
 
     def _touch(self, h: bytes):
-        self._lru.remove(h)
-        self._lru.append(h)
+        self._lru.move_to_end(h)
 
     def lookup(self, hashes: List[bytes]) -> List[int]:
         """Longest cached prefix of `hashes`; bumps the pool refcount of
@@ -274,7 +276,7 @@ class PrefixCache:
             else:
                 self._pool.share([int(pid)])
                 self._pages[h] = int(pid)
-                self._lru.append(h)
+                self._lru[h] = None
                 self._parent[h] = prev
                 self._nkids[h] = 0
                 if prev is not None:
@@ -299,7 +301,7 @@ class PrefixCache:
                     continue  # not a leaf
                 if self._pool.refcount(self._pages[h]) > 1:
                     continue  # shared with a live sequence
-                self._lru.remove(h)
+                del self._lru[h]
                 self._pool.release([self._pages.pop(h)])
                 parent = self._parent.pop(h)
                 self._nkids.pop(h, None)
@@ -331,10 +333,11 @@ def _suffix_attention(q, k, v, t_pre, q_hi, kv_hi, window=None,
         use_flash = jax.default_backend() == "tpu"
     if use_flash:
         from ..ops.pallas_flash import flash_fwd
-        from ..ops.tile import finalize, init_state
+        from ..ops.tile import finalize
 
-        st = init_state(b, n, t_suf, d)
-        m, lse, acc = flash_fwd(q, k, v, *st, d**-0.5, spec, window=window)
+        # None carry: statically-empty initial state (no zeros round trip)
+        m, lse, acc = flash_fwd(q, k, v, None, None, None, d**-0.5, spec,
+                                window=window)
         return finalize(m, lse, acc, q.dtype)
     # CPU/tests: dense masked softmax (GQA via repeat; small shapes); the
     # visibility mask comes from the shared oracle (ops/masks.dense_mask)

@@ -185,7 +185,6 @@ class ServeEngine:
                 # could fail the draft prefill MID-admission and wedge the
                 # slot (target live, request lost)
                 break
-            self._queue.pop(0)
             slack = self.spec_k + 1 if self.draft is not None else 0
             logits, self.state = paged_prefill(
                 self.params, jnp.asarray(req.prompt), self.state, self.pool,
@@ -201,15 +200,34 @@ class ServeEngine:
                     self.dstate, self.dpool, slot,
                     req.max_new_tokens + slack)
             tok = self._sample(logits[None, :])[0]
+            if tok < 0:  # sample_logits NaN-poison sentinel
+                # roll the half-admitted slot back BEFORE raising: the
+                # prefill + provision above already allocated pages for a
+                # slot that slots[slot] will never point at — without the
+                # retire they would be unreachable by _retire_finished and
+                # leak on every failed admission attempt
+                self.state = retire_slot(self.state, self.pool, slot)
+                if self.draft is not None:
+                    self.dstate = retire_slot(self.dstate, self.dpool, slot)
+                raise RuntimeError(
+                    f"slot {slot} (rid {req.rid}) prefill logits are "
+                    "NaN-poisoned")
+            # dequeue only once every prefill + provision + the sample's
+            # poison check succeeded: a runtime failure above leaves the
+            # request at the queue head (with its pages rolled back)
+            # instead of silently dropping it
+            self._queue.pop(0)
             req.tokens.append(int(tok))
             self.slots[slot] = req
             self._next_tok[slot] = int(tok)
 
     def _sample(self, logits):
         self._rng, key = jax.random.split(self._rng)
+        # nan_sentinel: poisoned rows (paged loud-failure contract) come
+        # back as -1 so the engine can raise without a second logits fetch
         return np.asarray(sample_logits(
             logits, key, temperature=self.temperature, top_k=self.top_k,
-            top_p=self.top_p))
+            top_p=self.top_p, nan_sentinel=True))
 
     def _retire_finished(self) -> List[Tuple[int, List[int]]]:
         done = []
@@ -258,6 +276,10 @@ class ServeEngine:
         for slot, req in enumerate(self.slots):
             if req is None:
                 continue
+            if toks[slot] < 0:  # sample_logits NaN-poison sentinel
+                raise RuntimeError(
+                    f"slot {slot} (rid {req.rid}) logits are NaN-poisoned: "
+                    "a live slot was stepped without provisioned capacity")
             req.tokens.append(int(toks[slot]))
             self._next_tok[slot] = int(toks[slot])
         return done
@@ -277,8 +299,12 @@ class ServeEngine:
         # a host roundtrip)
         toks_dev = []
         cur = jnp.asarray(self._next_tok)
+        # draft-side poison accumulator: stays on device across the k steps
+        # (a per-step host check would serialize the loop on round trips)
+        bad_d = jnp.zeros(len(self.slots), bool)
         for i in range(k):
             lg_d, self.dstate = paged_decode_step(dp, cur, self.dstate, dc)
+            bad_d = bad_d | jnp.any(jnp.isnan(lg_d), axis=-1)
             cur = jnp.argmax(lg_d, axis=-1).astype(jnp.int32)
             toks_dev.append(cur)
         d_toks_dev = jnp.stack(toks_dev, axis=1)            # [slots, k]
@@ -295,10 +321,18 @@ class ServeEngine:
         # the round's bulk host sync: proposals + target choices together
         d_toks = np.asarray(d_toks_dev)
         choice = np.asarray(jnp.argmax(lg_t, axis=-1))      # [slots, k+1]
+        # loud-failure contract: paged_multi_step / the draft's decode steps
+        # NaN-poison a live slot stepped past its provisioned pages; argmax
+        # would silently read 0 (draft-side: 0-acceptance forever)
+        bad = np.asarray(jnp.any(jnp.isnan(lg_t), axis=(1, 2)) | bad_d)
         undo = np.zeros(len(self.slots), np.int32)
         for slot, req in enumerate(self.slots):
             if req is None:
                 continue
+            if bad[slot]:
+                raise RuntimeError(
+                    f"slot {slot} (rid {req.rid}) speculative logits are "
+                    "NaN-poisoned: stepped without provisioned capacity")
             n_acc = 0
             while n_acc < k and d_toks[slot, n_acc] == choice[slot, n_acc]:
                 n_acc += 1

@@ -112,13 +112,14 @@ def _residual_accept(p_rows, q_rows, drafts, key):
     samples p_rows[kk].  Produces EXACTLY the target distribution per
     position (the classic telescoping argument), any draft.
 
-    All randomness is drawn in ONE device call (kk+2 uniforms) and the
-    rows pulled in ONE transfer each; the per-token loop is pure numpy —
+    All randomness is drawn in ONE device call (kk+1 uniforms: one per
+    accept test plus one for the residual/bonus sample) and the rows
+    pulled in ONE transfer each; the per-token loop is pure numpy —
     per-position device round-trips would cost the very latency
     speculation amortizes."""
     kk = len(drafts)
     key, ku = jax.random.split(key)
-    u = np.asarray(jax.random.uniform(ku, (kk + 2,)))
+    u = np.asarray(jax.random.uniform(ku, (kk + 1,)))
     p = np.asarray(p_rows, np.float64)
     q = np.asarray(q_rows, np.float64)
 
@@ -136,8 +137,8 @@ def _residual_accept(p_rows, q_rows, drafts, key):
             # p <= q everywhere yet x rejected: numerically degenerate
             # (p == q); fall back to sampling the target row directly
             resid = p[i]
-        return i, inv_cdf(resid, u[kk + 1]), key
-    return kk, inv_cdf(p[kk], u[kk + 1]), key
+        return i, inv_cdf(resid, u[kk]), key
+    return kk, inv_cdf(p[kk], u[kk]), key
 
 
 def _rollback(cache: Cache, length) -> Cache:

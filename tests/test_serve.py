@@ -356,3 +356,42 @@ def test_speculative_serving_int8_matches_plain_int8(model):
         return [out[r] for r in rids]
 
     assert run(True) == run(False)
+
+
+def test_sample_logits_nan_sentinel():
+    """With nan_sentinel=True (the ServeEngine mode), rows containing NaN
+    sample -1 instead of argmax-of-NaN silently yielding token 0 — greedy
+    and sampled paths both.  Default mode keeps the old behavior (callers
+    like generate() feed samples back as input tokens)."""
+    from burst_attn_tpu.models.decode import sample_logits
+
+    lg = jnp.stack([jnp.full((7,), jnp.nan),
+                    jnp.arange(7, dtype=jnp.float32)])
+    greedy = np.asarray(sample_logits(lg, jax.random.PRNGKey(0),
+                                      nan_sentinel=True))
+    assert greedy[0] == -1 and greedy[1] == 6
+    samp = np.asarray(sample_logits(lg, jax.random.PRNGKey(0),
+                                    temperature=1.0, top_k=3, top_p=0.9,
+                                    nan_sentinel=True))
+    assert samp[0] == -1 and 0 <= samp[1] < 7
+    # default: no sentinel (legacy argmax semantics for feedback loops)
+    assert np.asarray(sample_logits(lg, jax.random.PRNGKey(0)))[0] == 0
+
+
+def test_engine_raises_on_poisoned_logits(model):
+    """The kernel-level NaN poison (a live slot stepped at a page
+    boundary whose next page is unassigned) must surface as a
+    RuntimeError from the engine tick, not as a silent token 0."""
+    cfg, params = model
+    (p,) = _prompts(cfg, [128], seed=61)  # prompt exactly fills page 0
+    eng = ServeEngine(params, cfg, slots=1, n_pages=6, page=128,
+                      max_pages_per_seq=3)
+    eng.submit(p, 8)
+    eng.step()  # admit: prefill + provision assign table column 1
+    # sabotage: strip the provisioned pages so the next decode scatters
+    # at the boundary with table column 1 == 0 (the reserved sink page)
+    pt = np.asarray(eng.state.page_table).copy()
+    pt[0, 1:] = 0
+    eng.state = eng.state._replace(page_table=jnp.asarray(pt))
+    with pytest.raises(RuntimeError, match="NaN-poisoned"):
+        eng.step()
