@@ -350,42 +350,63 @@ def bwd_band_nb(bq, bkv, window):
     return best
 
 
-def _tri_coords(nqb):
-    """Wrapped-diagonal coordinates for the static-causal triangular grid.
+def _tri_coords(nqb, r):
+    """Wrapped-diagonal coordinates for the static-causal triangular grid,
+    generalized to TALL q blocks: block_q = r * block_kv.
 
-    Grid dims (b, h, p, j') with p in [0, nqb/2), j' in [0, nqb+1): row pair
-    p covers q-block p (kv-blocks 0..p, segment A = j' <= p) then q-block
-    nqb-1-p (kv-blocks 0..nqb-1-p, segment B) — (p+1) + (nqb-p) = nqb+1
-    steps, ALL live.  The rectangular grid spends ~half its steps on
-    clamped/dead causal blocks (~1.9us each of pure grid overhead on v5e at
-    seq=64K, where causal fwd measured 150 TFLOPs/s vs 172 non-causal —
-    the all-live grid closes most of that gap; the measured value is
-    recorded in README.md's performance section and sweep_blocks output).
-    Requires block_q == block_kv and an even q-block count."""
+    Grid dims (b, h, p, j') with p in [0, nqb/2), j' in [0, (nqb+1)*r):
+    row pair p covers q-block p (kv-blocks 0..(p+1)*r-1, segment A =
+    j' < (p+1)*r) then q-block nqb-1-p (kv-blocks 0..(nqb-p)*r-1,
+    segment B) — (p+1)*r + (nqb-p)*r = (nqb+1)*r steps, ALL live.  The
+    rectangular grid spends ~half its steps on clamped/dead causal blocks
+    (~1.9us each of pure grid overhead on v5e at seq=64K, where causal fwd
+    measured 150 TFLOPs/s vs 172 non-causal — the all-live grid closes
+    most of that gap; measured values in README.md's performance section
+    and sweep_blocks output).
+
+    Why tall blocks: at fixed block AREA (the measured VMEM cliff bound,
+    docs/design.md §3) the kernel's K/V streaming traffic scales as
+    1/block_q — each kv block fetched serves more query rows — while the
+    grid STEP COUNT, the diagonal's masked fraction (2/(nqb+1)), and the
+    pipeline's scoped-VMEM demand are all r-invariant.  At seq=64K the
+    2048x2048 forward moves ~16.9 GB of K/V (HBM-bound at ~819 GB/s);
+    4096x1024 moves half that for the same step count.
+
+    Per segment the last r steps overlap the diagonal and take the masked
+    path (the `masked` return); every earlier step is statically full
+    under offset 0/-1.  Requires block_q % block_kv == 0 and an even
+    q-block count."""
     p_ = pl.program_id(2)
     j_ = pl.program_id(3)
-    segb = j_ > p_
+    lena = (p_ + 1) * r
+    segb = j_ >= lena
     i = jnp.where(segb, nqb - 1 - p_, p_)
-    j = jnp.where(segb, j_ - p_ - 1, j_)
-    is_init = (j_ == 0) | (j_ == p_ + 1)
-    is_fin = (j_ == p_) | (j_ == nqb)
-    return i, j, is_init, is_fin
+    jrel = jnp.where(segb, j_ - lena, j_)
+    seg_len = jnp.where(segb, (nqb - p_) * r, lena)
+    is_init = (j_ == 0) | (j_ == lena)
+    is_fin = (j_ == lena - 1) | (j_ == (nqb + 1) * r - 1)
+    masked = jrel >= seg_len - r
+    return i, jrel, is_init, is_fin, masked
 
 
 def _fwd_kernel(
     spec_ref,
-    q_ref, k_ref, v_ref, m_in_ref, lse_in_ref, acc_in_ref,
+    q_ref, k_ref, v_ref,
     *rest,
     scale, bq, bkv, bkv_compute, lp, n_kv_blocks, cast_p, tri, wnd=None,
     seg=False, emit_o=False, loop=False, ablate=None, band_nb=None,
+    carry=True, tri_r=1,
 ):
+    if carry:
+        m_in_ref, lse_in_ref, acc_in_ref = rest[:3]
+        rest = rest[3:]
     if seg:
         qseg_ref, kvseg_ref = rest[0], rest[1]
         rest = rest[2:]
     m_out_ref, lse_out_ref, acc_out_ref, m_scr, l_scr, acc_scr = rest
     if tri:
-        nqb = n_kv_blocks  # square: bq == bkv, s_q == s_kv
-        i, j, is_init, is_fin = _tri_coords(nqb)
+        nqb = n_kv_blocks // tri_r  # s_q == s_kv; bq == tri_r * bkv
+        i, j, is_init, is_fin, tri_masked = _tri_coords(nqb, tri_r)
     elif band_nb is not None:
         # band grid (see flash_fwd): dim 3 walks only the <=band_nb kv
         # blocks that can intersect q-block i's sliding-window band, instead
@@ -406,19 +427,30 @@ def _fwd_kernel(
 
     @pl.when(is_init)
     def _init():
-        m0 = _read_rows(m_in_ref, i, bq, lp)
-        lse0 = _read_rows(lse_in_ref, i, bq, lp)
-        # scratch m is kept in the base-2 scaled domain (see LOG2E note)
-        m_scr[:] = m0 * LOG2E
-        # linear-scale running sum relative to m: l = exp(lse - m); 0 if empty
-        l_scr[:] = jnp.where(m0 == NEG_INF, 0.0, jnp.exp(lse0 - m0))
-        acc_scr[:] = acc_in_ref[0, 0, :, :]
+        if carry:
+            m0 = _read_rows(m_in_ref, i, bq, lp)
+            lse0 = _read_rows(lse_in_ref, i, bq, lp)
+            # scratch m is kept in the base-2 scaled domain (see LOG2E note)
+            m_scr[:] = m0 * LOG2E
+            # linear-scale running sum relative to m: l = exp(lse - m);
+            # 0 if empty
+            l_scr[:] = jnp.where(m0 == NEG_INF, 0.0, jnp.exp(lse0 - m0))
+            acc_scr[:] = acc_in_ref[0, 0, :, :]
+        else:
+            # statically-empty carry (single-device / first ring round):
+            # the empty state is a constant, so the [bq, d] f32 acc-in DMA
+            # per row visit — and XLA's materialization of the whole
+            # [B, N, S, D] zeros input — never happen (measured-relevant:
+            # that is ~2 GB of dead HBM traffic per 64K-seq forward)
+            m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
 
     if tri:
-        # every tri step is live; only the diagonal (segment-end) block is
-        # partially masked
-        fast_cond = ~is_fin
-        masked_cond = is_fin
+        # every tri step is live; only the r diagonal-overlap blocks at each
+        # segment's end are partially masked (r = 1: exactly the final step)
+        fast_cond = ~tri_masked
+        masked_cond = tri_masked
     else:
         live = _block_has_work(spec_ref, r0, c0, bq, bkv, wnd) & (
             j <= _kv_jmax(spec_ref, i, bq, bkv, n_kv_blocks)
@@ -616,6 +648,13 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     """One online-softmax ring round on TPU.  Same contract as
     ops/tile.py:tile_fwd: returns updated (m, lse, acc).
 
+    m = lse = acc = None declares a STATICALLY EMPTY carry (the state a
+    fresh init_state would hold): the kernel skips the three state inputs
+    entirely and seeds its scratch from constants, eliminating both XLA's
+    materialization of the [B,N,S,D] f32 zeros accumulator and the
+    per-row-visit acc-in DMA — ~2 GB of dead HBM traffic per 64K-seq
+    single-device forward.
+
     q [B,N,S,D]; k, v [B,Nk,Skv,D] (GQA when Nk < N); m, lse [B,N,S] f32;
     acc [B,N,S,D] f32.  `spec` scalars may be traced values.
     `block_kv_compute` (<= block_kv) sets the in-kernel compute sub-block
@@ -640,6 +679,9 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     if _ablate is not None and loop_sweep:
         raise ValueError("_ablate has no loop_sweep variant — the ablation "
                          "would silently time the full softmax chain")
+    carry = m is not None
+    assert (lse is None) == (acc is None) == (not carry), \
+        "m, lse, acc must be all None (empty carry) or all present"
     b, n, s_q, d = q.shape
     n_kv, s_kv = k.shape[1], k.shape[2]
     group = _gqa_group(n, n_kv)
@@ -654,8 +696,9 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
                         _pad_seg(segments[1], skv_pad, -2))
         m2, lse2, acc2 = flash_fwd(
             _pad_seq(q, sq_pad), _pad_seq(k, skv_pad), _pad_seq(v, skv_pad),
-            _pad_seq(m, sq_pad, float("-inf")),
-            _pad_seq(lse, sq_pad, float("-inf")), _pad_seq(acc, sq_pad),
+            _pad_seq(m, sq_pad, float("-inf")) if carry else None,
+            (_pad_seq(lse, sq_pad, float("-inf")) if carry else None),
+            _pad_seq(acc, sq_pad) if carry else None,
             scale, spec, block_q=block_q, block_kv=block_kv,
             block_kv_compute=block_kv_compute, interpret=interpret,
             cast_p=cast_p, triangular=False, window=window, segments=segments,
@@ -671,7 +714,8 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     nqb = s_q // bq
     nkb = s_kv // bkv
     tri = (bool(triangular) and window is None and not _tri_disabled()
-           and bq == bkv and s_q == s_kv and nqb % 2 == 0 and nqb >= 2)
+           and bq % bkv == 0 and s_q == s_kv and nqb % 2 == 0 and nqb >= 2)
+    tri_r = bq // bkv if tri else 1  # tall-q aspect (see _tri_coords)
     # band grid: the window analogue of the tri grid.  A q-block's band can
     # intersect at most band_nb kv blocks (exact max over the reachable
     # alignments r0 = i*bq and offsets {0,-1}), so the kv grid dim shrinks
@@ -688,15 +732,16 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
             band_nb = nb
     if tri:
         def q_map(b_, h, p, jp, sp):
-            return (b_, h, jnp.where(jp > p, nqb - 1 - p, p), 0)
+            return (b_, h, jnp.where(jp >= (p + 1) * tri_r, nqb - 1 - p, p), 0)
 
         def kv_map(b_, h, p, jp, sp):
-            return (b_, h // group, jnp.where(jp > p, jp - p - 1, jp), 0)
+            lena = (p + 1) * tri_r
+            return (b_, h // group, jnp.where(jp >= lena, jp - lena, jp), 0)
 
         def state_map(b_, h, p, jp, sp):
             return (b_, h, 0, 0)
 
-        grid = (b, n, nqb // 2, nqb + 1)
+        grid = (b, n, nqb // 2, (nqb + 1) * tri_r)
     elif band_nb is not None:
         def q_map(b_, h, i, c, sp):
             return (b_, h, i, 0)
@@ -718,18 +763,19 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
         _fwd_kernel, scale=scale, bq=bq, bkv=bkv, bkv_compute=bkc, lp=lp,
         n_kv_blocks=nkb, cast_p=cast_p, tri=tri, wnd=window,
         seg=segments is not None, emit_o=emit_o, loop=loop_sweep,
-        ablate=_ablate, band_nb=band_nb,
+        ablate=_ablate, band_nb=band_nb, carry=carry, tri_r=tri_r,
     )
     state_block = pl.BlockSpec((1, 1, s_q // lp, lp), state_map)
     in_specs = [
         pl.BlockSpec((1, 1, bq, d), q_map),
         pl.BlockSpec((1, 1, bkv, d), kv_map),
         pl.BlockSpec((1, 1, bkv, d), kv_map),
-        state_block,
-        state_block,
-        pl.BlockSpec((1, 1, bq, d), q_map),
     ]
-    inputs = [_spec_array(spec), q, k, v, _pack(m, lp), _pack(lse, lp), acc]
+    inputs = [_spec_array(spec), q, k, v]
+    if carry:
+        in_specs += [state_block, state_block,
+                     pl.BlockSpec((1, 1, bq, d), q_map)]
+        inputs += [_pack(m, lp), _pack(lse, lp), acc]
     if segments is not None:
         q_seg, kv_seg = segments
         # ids as [B, S, 1] (q rows along sublanes) / [B, 1, S] (kv along
@@ -1793,7 +1839,6 @@ def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
                               block_kv_compute=None, window=None,
                               segment_ids=None):
     from .masks import round_spec
-    from .tile import init_state
 
     b, n, s, d = q.shape
     if scale is None:
@@ -1805,10 +1850,11 @@ def _flash_attention_fwd_impl(q, k, v, scale, causal, block_q, block_kv,
     # single-device: the windowed spec is the plain causal spec (delta = 0);
     # the static `window` is what narrows the band
     spec = round_spec(jnp.int32(0), jnp.int32(0), s, k.shape[2], causal, "contig")
-    m0, lse0, acc0 = init_state(b, n, s, d)
     segs = None if segment_ids is None else (segment_ids, segment_ids)
+    # m = lse = acc = None: statically-empty carry — no zeros materialization,
+    # no acc-in DMA (see flash_fwd docstring)
     _, lse, o = flash_fwd(
-        q, k, v, m0, lse0, acc0, scale, spec, block_q=block_q, block_kv=block_kv,
+        q, k, v, None, None, None, scale, spec, block_q=block_q, block_kv=block_kv,
         block_kv_compute=block_kv_compute,
         # the spec here is statically known to be plain full-window causal,
         # exactly the triangular grid's precondition (tri declines windows;

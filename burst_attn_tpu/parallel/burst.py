@@ -134,6 +134,11 @@ def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec, triangular=False,
             block_q=bq, block_kv=bkv, triangular=triangular,
             window=cfg.window, segments=segments,
         )
+    if m is None:
+        # jnp oracle has no None-carry fast path; materialize the empty
+        # state it stands for (CPU-only — XLA folds the constants anyway)
+        b, n, s, d = q.shape
+        m, lse, acc = jnp_tile.init_state(b, n, s, d)
     return jnp_tile.tile_fwd(q, k, v, m, lse, acc, scale, spec,
                              window=cfg.window, segments=segments)
 
@@ -188,8 +193,6 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
     scale = cfg.scale if cfg.scale is not None else d**-0.5
     n_inter, n_intra = _sizes(cfg)
     part_me = my_partition(cfg.intra_axis, cfg.inter_axis)
-
-    state = jnp_tile.init_state(b, n, s, d)
 
     def compute(st, kv_c, r):
         kv_part = partition_at_round(r, cfg.intra_axis, cfg.inter_axis)
@@ -269,12 +272,37 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
 
     kv = (k, v) if seg is None else (k, v, seg)
     kv_base = kv
+
+    # Round 0 is ALWAYS the self round (partition_at_round(0) == part_me:
+    # c = s = 0 in ring.py:81-94), so it is peeled out of the scan with a
+    # STATICALLY EMPTY carry: the kernel seeds its state from constants
+    # (flash_fwd m=lse=acc=None) instead of reading a materialized
+    # init_state — the [B,N,S,D] f32 zeros accumulator never exists in
+    # HBM.  Self-rounds are also exactly the full-window-causal specs the
+    # triangular / band grids require, so every layout's round 0 gets the
+    # all-live grid, including contig (whose later rounds are
+    # offset-shifted and stay rectangular).
+    segs0 = None if seg is None else (seg, seg)
+    spec0 = round_spec(part_me, part_me, s, k.shape[2], cfg.causal,
+                       cfg.layout, window=cfg.window)
+    tri0 = cfg.causal and k.shape[2] == s
+    state = _tile_fwd(cfg, q, k, v, None, None, None, scale, spec0,
+                      triangular=tri0, segments=segs0)
+
     for c in range(n_inter):
         if c < n_inter - 1:
             # prefetch next cycle's base one full intra-cycle early
             # (reference: comm.py:229-237); consumed at the cycle boundary.
             kv_base_next = ppermute_next(kv_base, cfg.inter_axis)
-        if r_live > 1:
+        start = 1 if c == 0 else 0  # cycle 0's round 0 was peeled above
+        if c == 0 and r_live == 1:
+            # the peel was cycle 0's only live round; no intra permutes
+            if c < n_inter - 1:
+                kv = kv_base = kv_base_next
+            continue
+        if c == 0:
+            kv = ppermute_next(kv, cfg.intra_axis)  # round-0 send
+        if r_live - 1 > start:
 
             def body(carry, s_idx, c=c):
                 kv_c, st = carry
@@ -282,7 +310,8 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
                 st = compute(st, kv_c, c * n_intra + s_idx)
                 return (kv_next, st), None
 
-            (kv, state), _ = lax.scan(body, (kv, state), jnp.arange(r_live - 1))
+            (kv, state), _ = lax.scan(body, (kv, state),
+                                      jnp.arange(start, r_live - 1))
         # last round of the cycle: no intra send (reference comm.py:238-251)
         state = compute(state, kv, jnp.int32(c * n_intra + r_live - 1))
         if c < n_inter - 1:

@@ -307,3 +307,123 @@ def test_single_device_flash_attention(qkv, causal):
     )(q, k, v)
     for name, x, y in zip(("dq", "dk", "dv"), g_ref, g):
         np.testing.assert_allclose(y, x, rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("layout,qp,kp,causal", CASES)
+def test_empty_carry_matches_explicit_init_state(qkv, layout, qp, kp, causal):
+    """m = lse = acc = None (the statically-empty carry that skips the
+    three state inputs and their DMAs entirely) is bit-equivalent to
+    passing a fresh init_state explicitly."""
+    q, k, v, _ = qkv
+    spec = round_spec(jnp.int32(qp), jnp.int32(kp), S, S, causal, layout)
+    ref = pallas_flash.flash_fwd(
+        q, k, v, *tile.init_state(B, N, S, D), SCALE, spec,
+        block_q=16, block_kv=16, interpret=True, cast_p=False)
+    got = pallas_flash.flash_fwd(
+        q, k, v, None, None, None, SCALE, spec,
+        block_q=16, block_kv=16, interpret=True, cast_p=False)
+    for name, x, y in zip(("m", "lse", "acc"), ref, got):
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x),
+                                      err_msg=name)
+
+
+def test_empty_carry_emit_o_and_ragged(qkv):
+    """The None-carry path composes with emit_o (the fused finalize the
+    single-device forward uses) and with ragged pad-and-mask recursion."""
+    q, k, v, _ = qkv
+    spec = round_spec(jnp.int32(0), jnp.int32(0), S, S, True, "contig")
+    st = tile.init_state(B, N, S, D)
+    m, lse, acc = tile.tile_fwd(q, k, v, *st, SCALE, spec)
+    want = tile.finalize(m, lse, acc, q.dtype)
+    _, _, o = pallas_flash.flash_fwd(
+        q, k, v, None, None, None, SCALE, spec,
+        block_q=16, block_kv=16, interpret=True, cast_p=False, emit_o=True)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+    # ragged: S not a block multiple forces the pad-run-slice recursion
+    s_r = S - 10
+    qr, kr, vr = q[:, :, :s_r], k[:, :, :s_r], v[:, :, :s_r]
+    spec_r = round_spec(jnp.int32(0), jnp.int32(0), s_r, s_r, True, "contig")
+    str_ = tile.init_state(B, N, s_r, D)
+    ref_r = tile.tile_fwd(qr, kr, vr, *str_, SCALE, spec_r)
+    got_r = pallas_flash.flash_fwd(
+        qr, kr, vr, None, None, None, SCALE, spec_r,
+        block_q=16, block_kv=16, interpret=True, cast_p=False)
+    for name, x, y in zip(("m", "lse", "acc"), ref_r, got_r):
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("block_q,block_kv,bkc",
+                         [(32, 16, None), (32, 8, 8), (16, 8, None),
+                          (32, 16, 8)])
+@pytest.mark.parametrize("offset", [0, -1])
+def test_triangular_tall_q_matches_tile(qkv, block_q, block_kv, bkc, offset):
+    """The tall-q generalization of the wrapped-diagonal grid (block_q =
+    r * block_kv — same step count, 1/r the K/V streaming traffic) must
+    match the oracle at both offsets the ring layouts produce."""
+    from burst_attn_tpu.ops.masks import MaskSpec
+
+    q, k, v, _ = qkv
+    spec = MaskSpec(jnp.int32(0), jnp.int32(S), jnp.int32(S), jnp.int32(1),
+                    jnp.int32(offset))
+    st = tile.init_state(B, N, S, D)
+    ref = tile.tile_fwd(q, k, v, *st, SCALE, spec)
+    got = pallas_flash.flash_fwd(
+        q, k, v, *st, SCALE, spec, block_q=block_q, block_kv=block_kv,
+        block_kv_compute=bkc, interpret=True, cast_p=False, triangular=True,
+    )
+    for name, x, y in zip(("m", "lse", "acc"), ref, got):
+        np.testing.assert_allclose(y, x, rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_triangular_tall_q_empty_carry_emit_o(qkv):
+    """Tall-q tri grid composed with the single-device fast path flags
+    (None carry + fused finalize) — the exact headline-bench configuration
+    shape."""
+    q, k, v, _ = qkv
+    spec = round_spec(jnp.int32(0), jnp.int32(0), S, S, True, "contig")
+    st = tile.init_state(B, N, S, D)
+    m, lse, acc = tile.tile_fwd(q, k, v, *st, SCALE, spec)
+    want = tile.finalize(m, lse, acc, q.dtype)
+    _, _, o = pallas_flash.flash_fwd(
+        q, k, v, None, None, None, SCALE, spec, block_q=32, block_kv=8,
+        interpret=True, cast_p=False, triangular=True, emit_o=True,
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_triangular_tall_q_segments(qkv):
+    """Packed segments through the tall-q tri grid: the seg_ok fast-path
+    narrowing must compose with the r-wide masked diagonal."""
+    q, k, v, _ = qkv
+    seg = jnp.concatenate([jnp.zeros((B, S // 4), jnp.int32),
+                           jnp.ones((B, S // 4), jnp.int32),
+                           jnp.full((B, S // 2), 2, jnp.int32)], axis=1)
+    spec = round_spec(jnp.int32(0), jnp.int32(0), S, S, True, "contig")
+    st = tile.init_state(B, N, S, D)
+    ref = tile.tile_fwd(q, k, v, *st, SCALE, spec, segments=(seg, seg))
+    got = pallas_flash.flash_fwd(
+        q, k, v, *st, SCALE, spec, block_q=32, block_kv=16, interpret=True,
+        cast_p=False, triangular=True, segments=(seg, seg),
+    )
+    for name, x, y in zip(("m", "lse", "acc"), ref, got):
+        np.testing.assert_allclose(y, x, rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_triangular_tall_q_loop_sweep(qkv):
+    """fori_loop sweep variant through the tall-q tri grid — identical to
+    the unrolled pipeline."""
+    q, k, v, _ = qkv
+    spec = round_spec(jnp.int32(0), jnp.int32(0), S, S, True, "contig")
+    st = tile.init_state(B, N, S, D)
+    kw = dict(block_q=32, block_kv=8, block_kv_compute=8, interpret=True,
+              cast_p=False, triangular=True)
+    base = pallas_flash.flash_fwd(q, k, v, *st, SCALE, spec, **kw)
+    got = pallas_flash.flash_fwd(q, k, v, *st, SCALE, spec,
+                                 loop_sweep=True, **kw)
+    for name, x, y in zip(("m", "lse", "acc"), base, got):
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6,
+                                   atol=1e-6, err_msg=name)
