@@ -56,12 +56,16 @@ def bench_fn(fn, *args, warmup=3, iters=10, reps=3):
 
 
 def time_flash_fwd(b, n, s, d, *, block_q, block_kv, block_kv_compute=None,
-                   n_kv=None, triangular=True, **fwd_kw):
+                   n_kv=None, triangular=True, empty_carry=False, **fwd_kw):
     """Time ONE raw flash_fwd config on fresh bf16 inputs — the
     kernel-sweep scaffold shared by sweep_blocks (--fwd-loop/--ablate-fwd)
     and batch_probe (nosoftmax rows), so the two probes cannot silently
     drift apart.  Returns (seconds, fwd TFLOPs/s).  fwd_kw passes through
-    to flash_fwd (loop_sweep=True, _ablate="nosoftmax", ...)."""
+    to flash_fwd (loop_sweep=True, _ablate="nosoftmax", ...).
+
+    empty_carry=True times the None-carry fast path (what the single-device
+    flash_attention forward runs); the default times a carried state, which
+    is what every ring round after the first pays."""
     from burst_attn_tpu.ops.masks import round_spec
     from burst_attn_tpu.ops.pallas_flash import flash_fwd
     from burst_attn_tpu.ops.tile import init_state
@@ -72,8 +76,9 @@ def time_flash_fwd(b, n, s, d, *, block_q, block_kv, block_kv_compute=None,
     k = jax.random.normal(kk, (b, n_kv, s, d), jnp.bfloat16)
     v = jax.random.normal(kv, (b, n_kv, s, d), jnp.bfloat16)
     spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
+    st = (None, None, None) if empty_carry else init_state(b, n, s, d)
     f = jax.jit(lambda q, k, v: jnp.sum(flash_fwd(
-        q, k, v, *init_state(b, n, s, d), d**-0.5, spec,
+        q, k, v, *st, d**-0.5, spec,
         block_q=block_q, block_kv=block_kv,
         block_kv_compute=block_kv_compute, triangular=triangular,
         **fwd_kw)[2]))

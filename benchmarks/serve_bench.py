@@ -39,6 +39,11 @@ def main(argv=None):
                     help="churn phase: share one --context/2 token prefix "
                          "across all requests and serve with automatic "
                          "prefix caching")
+    ap.add_argument("--dense-baseline", action="store_true",
+                    help="extra phase: dense KV-cache decode at the same "
+                         "(slots, context) — the paged path's comparison "
+                         "point (dense cost ∝ max_seq, paged ∝ live "
+                         "context)")
     ap.add_argument("--out", default="results/serve.jsonl")
     args = ap.parse_args(argv)
 
@@ -123,6 +128,34 @@ def main(argv=None):
             "quantize": args.quantize,
             "step_ms": round(dt * 1e3, 2),
             "tokens_per_s": round(args.slots / dt, 1)})
+
+    if args.dense_baseline:
+        # dense KV-cache decode (models/decode.py): batch = slots, cache
+        # sized context + decode budget.  Same timed-loop discipline as the
+        # paged decode phase (async dispatches, one final block).
+        from burst_attn_tpu.models.decode import forward_cached, prefill
+
+        max_seq = args.context + args.decode_steps + 1
+        d_logits, cache = prefill(params, prompts, cfg, max_seq)
+        jax.block_until_ready(d_logits)
+        # donate the cache like generate()'s scan carry does — an undonated
+        # dense cache would add a full copy per step and unfairly slow the
+        # baseline
+        step = jax.jit(lambda p, t, pos, c: forward_cached(p, t, pos, c, cfg),
+                       donate_argnums=(3,))
+        tok1 = jnp.ones((args.slots, 1), jnp.int32)
+        pos = jnp.full((args.slots, 1), args.context, jnp.int32)
+        lg, cache = step(params, tok1, pos, cache)  # compile
+        jax.block_until_ready(lg)
+        t0 = time.perf_counter()
+        for i in range(args.decode_steps):
+            lg, cache = step(params, tok1, pos + 1 + i, cache)
+        jax.block_until_ready(lg)
+        dt = (time.perf_counter() - t0) / args.decode_steps
+        record({"phase": "decode-dense", "context": args.context,
+                "slots": args.slots, "max_seq": max_seq,
+                "step_ms": round(dt * 1e3, 2),
+                "tokens_per_s": round(args.slots / dt, 1)})
 
     if args.churn > 0:
         # end-to-end engine throughput WITH request turnover: staggered

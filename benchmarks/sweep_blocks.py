@@ -37,6 +37,13 @@ def main():
                         "sub-block sweep (loop_sweep=True): buffers reuse "
                         "per iteration, probing whether the VMEM area cliff "
                         "is unrolled-stage liveness")
+    p.add_argument("--fwd-raw-empty", default="",
+                   help="comma list of BQxBKV[xBKC] timed through the RAW "
+                        "flash_fwd scaffold with the None-carry fast path "
+                        "(empty_carry=True) — isolates the carry-state DMA "
+                        "cost vs the carried rows the same scaffold times "
+                        "by default (--fwd already times the None-carry "
+                        "path end-to-end through flash_attention)")
     args = p.parse_args()
 
     import os
@@ -110,6 +117,8 @@ def main():
     bench_flash_fwd("fwd-loop", parse(args.fwd_loop), loop_sweep=True)
     bench_flash_fwd("fwd-ablate-nosoftmax", parse(args.ablate_fwd),
                     _ablate="nosoftmax")
+    bench_flash_fwd("fwd-raw-empty", parse(args.fwd_raw_empty),
+                    empty_carry=True)
 
     bwd_cfgs = [c for c in args.bwd.split(",") if c]
     if bwd_cfgs:

@@ -19,6 +19,16 @@ stage_head_tests() {  # on-chip validation of the HEAD kernels
     python -m pytest tests/test_fused_bwd.py tests/test_pallas.py -q
 }
 
+stage_tallq() {  # tall-q tri grid + empty-carry fast path (round-4 kernel work):
+  # fwd K/V streaming traffic scales 1/bq at fixed cliff-legal area (4096x1024
+  # halves it vs 2048x2048 at the same step count); bwd q-side traffic scales
+  # 1/bkv (512x4096xtri is area-legal, the tri bwd already takes bq != bkv)
+  run_stage tallq 14400 python -m benchmarks.sweep_blocks \
+    --fwd "2048x2048,4096x1024,4096x1024x512,4096x512,8192x512,8192x512x256,8192x1024" \
+    --bwd "1024x2048xtri,512x4096xtri,512x4096,256x4096xtri,512x8192xtri" \
+    --out /root/repo/results/sweep_tallq.jsonl
+}
+
 stage_loop_sweep() {  # fori_loop cliff-break experiment (VERDICT r2 #1)
   run_stage loop-sweep 10800 python -m benchmarks.sweep_blocks \
     --fwd "" --bwd "" \
@@ -30,8 +40,8 @@ stage_bench() {  # driver headline metric (also refreshes results/headline.json)
   run_stage bench 3600 python bench.py
 }
 
-stage_serve_bf16() {  # first hardware serving number
-  run_stage serve-bf16 7200 python -m benchmarks.serve_bench \
+stage_serve_bf16() {  # first hardware serving number (+ dense-decode baseline)
+  run_stage serve-bf16 7200 python -m benchmarks.serve_bench --dense-baseline \
     --out /root/repo/results/serve.jsonl
 }
 
@@ -46,7 +56,49 @@ stage_seq256k() {  # 256K evidence point, fwd-only (bwd residuals OOM one chip)
     --out /root/repo/results/scaling_long.jsonl
 }
 
-DEFAULT_STAGES="head_tests loop_sweep bench serve_bf16 serve_int8 seq256k"
+stage_batch_probe() {  # batch-scaling regression discriminator (VERDICT r3 #3)
+  run_stage batch-probe 7200 python -m benchmarks.batch_probe \
+    --out /root/repo/results/batch_probe.jsonl
+}
+
+stage_serve_churn() {  # engine throughput under request turnover
+  run_stage serve-churn 7200 python -m benchmarks.serve_bench --churn 32 \
+    --out /root/repo/results/serve.jsonl
+}
+
+stage_serve_prefix() {  # prefix-cache hit-path throughput
+  run_stage serve-prefix 7200 python -m benchmarks.serve_bench --prefix-cache \
+    --out /root/repo/results/serve.jsonl
+}
+
+stage_window() {  # round-3 band grids on chip (old number: 53 band-TFLOPs/s)
+  run_stage window 7200 python -m benchmarks.window_bench \
+    --out /root/repo/results/results_window.jsonl
+}
+
+stage_bwd128k() {  # 128K bwd block sweep (VERDICT r3 #5: 0.92x at 128K)
+  run_stage bwd128k 10800 python -m benchmarks.sweep_blocks --seq 131072 \
+    --fwd "" --bwd "1024x2048,1024x4096,2048x2048,512x2048,1024x1024" \
+    --out /root/repo/results/sweep_128k.jsonl
+}
+
+stage_scaling() {  # refresh the scaling row set at current defaults
+  run_stage scaling 10800 python -m benchmarks.benchmark \
+    --methods flash --seqs 32768,65536,131072 --causal --mesh 1 \
+    --out /root/repo/results/results_scaling.jsonl
+}
+
+stage_ring_trace() {  # single-chip two-round carry-in overlap trace
+  run_stage ring-trace 3600 python -m benchmarks.ring_rounds_trace \
+    --trace-dir /root/repo/results/trace_rounds
+}
+
+stage_train_smoke() {  # end-to-end trainer MFU (defaults OOM one v5e chip)
+  run_stage train-smoke 7200 python -m benchmarks.train_smoke \
+    --n-layers 8 --vocab 8192 --out /root/repo/results/results_smoke.jsonl
+}
+
+DEFAULT_STAGES="head_tests bench tallq loop_sweep batch_probe serve_bf16 serve_int8 serve_churn serve_prefix window bwd128k seq256k scaling ring_trace train_smoke"
 STAGES=${*:-$DEFAULT_STAGES}
 
 echo "=== [$(date -u +%F' '%T)] tpu_run: queue = $STAGES ==="
