@@ -65,7 +65,7 @@ def main():
             f.write(json.dumps(row) + "\n")
         print(json.dumps(row), flush=True)
 
-    # (batch, seq, block_q, no_tri)
+    # (batch, seq, block_q or (block_q, block_kv), no_tri)
     cases = [
         (1, 65536, None, False),   # round-2 anchor: 158.4
         (1, 32768, None, False),   # NEW: batch-free seq term
@@ -75,6 +75,12 @@ def main():
         (1, 32768, 1024, False),   # nqb=32 at 32K: 64K's init/fin fraction
         (4, 32768, 1024, False),
         (8, 16384, None, False),   # extreme: nqb=8, 4/9 steps init/fin
+        # tall-q tri grid (round 4): same area/step count, init/fin events
+        # drop to 4/((nqb+1)r) of steps and K/V bytes to 1/r — the fix
+        # candidate for the regression if the init/fin term is convicted
+        (4, 32768, (4096, 1024), False),
+        (1, 65536, (4096, 1024), False),
+        (8, 16384, (4096, 1024), False),
     ]
 
     def run_ablate(b, s):
@@ -105,25 +111,29 @@ def main():
         v = jax.random.normal(kv, (b, n, s, d), jnp.bfloat16)
         if no_tri:
             os.environ["BURST_NO_TRI"] = "1"
+        bq_eff, bkv_eff = (bq if isinstance(bq, tuple) else (bq or 2048,
+                                                             bq or 2048))
         try:
-            f = jax.jit(lambda q, k, v, bq=bq: jnp.sum(
-                flash_attention(q, k, v, None, True, bq, bq)
+            f = jax.jit(lambda q, k, v, bq=bq_eff, bkv=bkv_eff: jnp.sum(
+                flash_attention(q, k, v, None, True, bq, bkv)
                 .astype(jnp.float32)))
             t = bench_fn(f, q, k, v)
             fl = flops(b, s, n, d, "fwd", True)
-            bq_eff = bq or 2048
-            # tri-grid step count: b*n * (nqb/2) * (nqb+1)
+            # tri-grid step count: b*n * (nqb/2) * (nqb+1)*r, r = bq/bkv
             nqb = s // bq_eff
-            steps = b * n * (nqb // 2) * (nqb + 1) if not no_tri else (
-                b * n * nqb * nqb)
+            r = bq_eff // bkv_eff
+            steps = b * n * (nqb // 2) * (nqb + 1) * r if not no_tri else (
+                b * n * nqb * nqb * r)
             record({"batch": b, "seq": s, "block_q": bq_eff,
+                    "block_kv": bkv_eff,
                     "grid": "rect" if no_tri else "tri",
                     "ms": round(t * 1e3, 2),
                     "tflops": round(fl / t / 1e12, 1),
                     "us_per_step": round(t * 1e6 / steps, 2),
-                    "initfin_frac": round(4 / (nqb + 1), 3)})
+                    "initfin_frac": round(4 / ((nqb + 1) * r), 3)})
         except Exception as e:  # noqa: BLE001 — record and continue
-            record({"batch": b, "seq": s, "block_q": bq or 2048,
+            record({"batch": b, "seq": s, "block_q": bq_eff,
+                    "block_kv": bkv_eff,
                     "grid": "rect" if no_tri else "tri",
                     "error": f"{type(e).__name__}: {e}"[:200]})
         finally:

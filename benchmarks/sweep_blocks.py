@@ -25,7 +25,9 @@ def main():
     p.add_argument("--bwd", default="1024x2048,1024x4096,2048x2048,512x4096",
                    help="comma list of BQxBKV (bwd-only, fused kernel), "
                         "BQxBKVxsplit (split dq / dkdv kernels), or "
-                        "BQxBKVxtri (wrapped-diagonal causal grid); empty to skip")
+                        "BQxBKVxtri (wrapped-diagonal causal grid; optional "
+                        "xBKC sub-block and xloop for the fori_loop sweep, "
+                        "e.g. 1024x4096xtrix1024xloop); empty to skip")
     p.add_argument("--fwd-compute", default="",
                    help="comma list of BQxBKVxBKC (fwd with compute sub-block)")
     p.add_argument("--ablate-fwd", default="",
@@ -153,24 +155,42 @@ def main():
                 continue
             fused = len(parts) <= 2 or parts[2] == "tri"
             tri = len(parts) > 2 and parts[2] == "tri"
-            # optional 4th token: tri compute sub-block, e.g. 2048x2048xtrix1024
-            bkc = int(parts[3]) if len(parts) > 3 else None
+            # optional trailing tokens (tri only, any order-tolerant mix):
+            # a numeric compute sub-block and/or the literal 'loop' for the
+            # fori_loop sweep, e.g. 1024x4096xtrix1024xloop.  Anything else
+            # is an error ROW, not a sweep abort (a malformed token must
+            # not cost the remaining multi-hour configs), and a misspelled
+            # 'loop' must not silently time the unrolled kernel.
+            bkc, loop, bad = None, False, None
+            for tok in parts[3:]:
+                if tok == "loop":
+                    loop = True
+                elif tok.isdigit():
+                    bkc = int(tok)
+                else:
+                    bad = tok
+            if bad is not None:
+                record({"pass": "bwd", "error": f"bad config {c!r}: "
+                        f"unknown token {bad!r} (want a number or 'loop')"})
+                continue
             # record which kernel actually runs: flash_bwd silently falls
             # back to the rectangular fused kernel when the tri gate fails
+            # (which also ignores loop_sweep — record the EFFECTIVE flags)
             tri_eff = tri and tri_bwd_supported(
                 seq, seq, n, nkv, d, block_q=bqb, block_kv=bkvb,
                 block_kv_compute=bkc)
             row = {"pass": "bwd", "bq_bwd": bqb, "bkv_bwd": bkvb,
-                   "fused": fused, "tri": tri_eff, "bkc_bwd": bkc}
+                   "fused": fused, "tri": tri_eff, "bkc_bwd": bkc,
+                   "loop": loop and tri_eff}
             if tri and not tri_eff:
                 row["tri_requested_fell_back"] = True
             try:
                 f = jax.jit(lambda q, k, v, do, delta, lse, bqb=bqb, bkvb=bkvb,
-                            fused=fused, tri=tri, bkc=bkc: sum(
+                            fused=fused, tri=tri, bkc=bkc, loop=loop: sum(
                     jnp.sum(g.astype(jnp.float32)) for g in flash_bwd(
                         do, q, k, v, delta, lse, scale, spec,
                         block_q=bqb, block_kv=bkvb, fused=fused, triangular=tri,
-                        block_kv_compute=bkc)))
+                        block_kv_compute=bkc, loop_sweep=loop)))
                 t = bench_fn(f, q, k, v, do, delta, lse)
                 row.update(ms=round(t * 1e3, 2),
                            tflops=round(flops(b, seq, n, d, "bwd", True) / t / 1e12, 1))

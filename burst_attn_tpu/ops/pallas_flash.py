@@ -90,6 +90,15 @@ def _fwd_loop_default():
     return os.environ.get("BURST_FWD_LOOP", "").strip().lower() not in ("", "0", "false")
 
 
+def _bwd_loop_default():
+    """BURST_BWD_LOOP=1 makes the tri backward's fori_loop sub-block sweep
+    the default — same promotion mechanism as BURST_FWD_LOOP (see
+    _fwd_loop_default): if the loop body's buffer reuse moves the bwd VMEM
+    cliff (sweep_blocks --bwd ...xtrix1024 with it set), rerun bench with
+    retuned bwd blocks before changing ops/tuning.py defaults."""
+    return os.environ.get("BURST_BWD_LOOP", "").strip().lower() not in ("", "0", "false")
+
+
 def _pick_block(seq: int, block: int) -> int:
     """Largest block <= `block` that divides seq (seq lengths are powers of
     two in practice, so this is normally min(block, seq))."""
@@ -1261,11 +1270,93 @@ def _bwd_accum_tile_sub(
     pend_flag[1] = prev[0]
 
 
+def _bwd_accum_tile_sub_loop(
+    do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
+    dv_scr, dk_scr, ds_pend, q_pend, pend_flag,
+    iq, masked, mask_of, *, scale, bq, bkvc, n_sub, lp, dq_update,
+):
+    """lax.fori_loop variant of _bwd_accum_tile_sub — the backward analogue
+    of _fwd_kernel._sweep_loop.
+
+    Why this exists: the unrolled sub-block loop's intermediates are
+    allocated SSA-style, so scoped-VMEM demand grows with n_sub·bq·bkvc =
+    bq·bkv — the measured backward cliff at 1024x2048 area, which
+    sub-blocking alone did NOT move (docs/design.md §3's negative result:
+    the round-2 `_bwd_accum_tile_sub` experiment).  A fori_loop body
+    reuses its buffers per iteration, capping demand at ~2 stages
+    independent of bkv — the experiment that could admit 4096-wide kv
+    blocks and halve the backward's grid-step count.  Selected by
+    flash_bwd's loop_sweep flag (BURST_BWD_LOOP promotes it).
+
+    Scheduling: same dk deferral as the unrolled variant — dk(u-1) rides
+    the loop CARRY (its ds tile, cast to the matmul dtype) and issues at
+    the top of iteration u, ahead of u's VPU chain; the final sub-block's
+    dk crosses the grid step through the ds_pend/q_pend scratch stash
+    exactly like the unrolled path (_flush_dk_sub reads pend_flag[1]).
+    `mask_of` here must accept a TRACED u (the tri kernel passes its
+    iota-based builder, not the static-slice closure)."""
+    q = q_ref[0, 0, :, :]
+    do = do_ref[0, 0, :, :]
+    lse_row = _read_rows(lse_ref, iq, bq, lp)
+    lse_row = jnp.where(lse_row == NEG_INF, BIG_LSE, lse_row * LOG2E)
+    delta_row = _read_rows(delta_ref, iq, bq, lp)
+    qs = q * (scale * LOG2E)
+
+    def one(u, prev_ds, dq_acc, fold_prev):
+        rows = pl.ds(u * bkvc, bkvc)
+        k_u = k_ref[0, 0, rows, :]
+        v_u = v_ref[0, 0, rows, :]
+        s = jax.lax.dot_general(
+            qs, k_u, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_u, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if fold_prev:
+            # the carried pend's dk: independent of this iteration's VPU
+            # chain, queues right behind s/dp
+            prows = pl.ds((u - 1) * bkvc, bkvc)
+            dk_scr[prows, :] = dk_scr[prows, :] + jax.lax.dot_general(
+                prev_ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        p = jnp.exp2(s - lse_row)
+        if masked:
+            p = jnp.where(mask_of(u), p, 0.0)
+        dv_scr[rows, :] = dv_scr[rows, :] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_row)
+        dq_acc = dq_acc + jax.lax.dot_general(
+            ds.astype(k_u.dtype), k_u, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return ds.astype(q.dtype), dq_acc
+
+    dq0 = jnp.zeros((bq, q_ref.shape[-1]), jnp.float32)
+    ds_last, dq_acc = one(0, None, dq0, False)
+    if n_sub > 1:
+        def body(u, carry):
+            prev_ds, dq_c = carry
+            return one(u, prev_ds, dq_c, True)
+
+        ds_last, dq_acc = jax.lax.fori_loop(
+            1, n_sub, body, (ds_last, dq_acc))
+    dq_update(dq_acc)
+    ds_pend[:] = ds_last
+    q_pend[:] = q
+    pend_flag[0] = 1
+    pend_flag[1] = n_sub - 1
+
+
 def _bwd_fused_tri_kernel(
     spec_ref,
     do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
     *rest,
-    scale, bq, bkv, bkvc, lp, nqb, nkb, ratio, seg=False,
+    scale, bq, bkv, bkvc, lp, nqb, nkb, ratio, seg=False, loop=False,
 ):
     """Wrapped-diagonal causal backward (static full-window causal with
     offset 0 or -1 — see the flash_fwd docstring's triangular contract —
@@ -1345,11 +1436,22 @@ def _bwd_fused_tri_kernel(
         seg_u = (qs_tile, ks_tile[:, u * bkvc:(u + 1) * bkvc]) if seg else None
         return _block_mask(spec_ref, r0, c0 + u * bkvc, bq, bkvc, seg=seg_u)
 
+    def _mask_of_dyn(u):
+        # traced u (the fori_loop sweep): same shared predicate —
+        # _block_mask takes traced r0/c0 everywhere already; only the seg
+        # tile needs a dynamic slice instead of the unrolled static one
+        seg_u = None
+        if seg:
+            seg_u = (qs_tile,
+                     jax.lax.dynamic_slice(ks_tile, (0, u * bkvc), (1, bkvc)))
+        return _block_mask(spec_ref, r0, c0 + u * bkvc, bq, bkvc, seg=seg_u)
+
     def _accum(masked):
-        _bwd_accum_tile_sub(
+        accum = _bwd_accum_tile_sub_loop if loop else _bwd_accum_tile_sub
+        accum(
             do_ref, q_ref, k_ref, v_ref, delta_ref, lse_ref,
             dv_scr, dk_scr, ds_pend, q_pend, pend_flag,
-            iq, masked, _mask_of,
+            iq, masked, _mask_of_dyn if loop else _mask_of,
             scale=scale, bq=bq, bkvc=bkvc, n_sub=bkv // bkvc, lp=lp,
             dq_update=_dq_update,
         )
@@ -1365,7 +1467,7 @@ def _bwd_fused_tri_kernel(
 
 def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
                          block_q, block_kv, interpret, block_kv_compute=None,
-                         segments=None):
+                         segments=None, loop_sweep=False):
     b, n, s_q, d = q.shape
     s_kv = k.shape[2]
     bq = _pick_block(s_q, block_q)
@@ -1428,6 +1530,7 @@ def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
         functools.partial(
             _bwd_fused_tri_kernel, scale=scale, bq=bq, bkv=bkv, bkvc=bkvc,
             lp=lp, nqb=nqb, nkb=nkb, ratio=ratio, seg=segments is not None,
+            loop=loop_sweep,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -1603,7 +1706,7 @@ def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv,
 def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
               block_q=1024, block_kv=1024, interpret=None, fused=None,
               triangular=False, window=None, segments=None,
-              block_kv_compute=None):
+              block_kv_compute=None, loop_sweep=False):
     """One backward ring round on TPU.  Same contract as ops/tile.py:tile_bwd:
     returns (dq [B,N,S,D], dk [B,Nk,Skv,D], dv [B,Nk,Skv,D]) in float32.
 
@@ -1622,6 +1725,8 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
     """
     if interpret is None:
         interpret = _interpret_default()
+    if not loop_sweep and _bwd_loop_default():
+        loop_sweep = True  # BURST_BWD_LOOP promotion (see _bwd_loop_default)
     b, n, s_q, d = q.shape
     n_kv, s_kv = k.shape[1], k.shape[2]
     group = _gqa_group(n, n_kv)
@@ -1667,6 +1772,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
             do, q, k, v, delta, lse, scale, spec,
             block_q=block_q, block_kv=block_kv, interpret=interpret,
             block_kv_compute=block_kv_compute, segments=segments,
+            loop_sweep=loop_sweep,
         )
     if fused:
         return _flash_bwd_fused(

@@ -29,10 +29,13 @@ stage_tallq() {  # tall-q tri grid + empty-carry fast path (round-4 kernel work)
     --out /root/repo/results/sweep_tallq.jsonl
 }
 
-stage_loop_sweep() {  # fori_loop cliff-break experiment (VERDICT r2 #1)
-  run_stage loop-sweep 10800 python -m benchmarks.sweep_blocks \
-    --fwd "" --bwd "" \
-    --fwd-loop "2048x2048x1024,2048x4096x1024,4096x4096x1024,4096x4096x2048" \
+stage_loop_sweep() {  # fori_loop cliff-break experiments, fwd AND bwd
+  # (VERDICT r2 #1 / r4: per-iteration buffer reuse vs unrolled SSA
+  # liveness; if 4096-wide kv legalizes, step counts halve in both passes)
+  run_stage loop-sweep 14400 python -m benchmarks.sweep_blocks \
+    --fwd "" \
+    --fwd-loop "2048x2048x1024,2048x4096x1024,4096x4096x1024,4096x2048x1024" \
+    --bwd "1024x2048xtrix1024xloop,1024x4096xtrix1024xloop,2048x2048xtrix1024xloop,1024x8192xtrix1024xloop" \
     --out /root/repo/results/sweep_loop.jsonl
 }
 

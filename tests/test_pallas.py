@@ -427,3 +427,35 @@ def test_triangular_tall_q_loop_sweep(qkv):
     for name, x, y in zip(("m", "lse", "acc"), base, got):
         np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6,
                                    atol=1e-6, err_msg=name)
+
+
+@pytest.mark.parametrize("block_q,block_kv,bkc,segs",
+                         [(16, 16, 8, False), (16, 32, 16, False),
+                          (16, 32, 8, True), (8, 32, 16, False)])
+def test_tri_bwd_loop_sweep_matches_unrolled(qkv, block_q, block_kv, bkc,
+                                             segs):
+    """The tri backward's fori_loop sub-block sweep (loop_sweep=True — the
+    bwd VMEM-cliff probe) is numerically identical to the unrolled
+    pipeline, including the traced-u mask builder and segments, at square
+    and wide-kv (ratio > 1) tilings."""
+    q, k, v, do = qkv
+    q1, do1 = q[:, :2], do[:, :2]  # tri bwd: group=1
+    spec = round_spec(jnp.int32(0), jnp.int32(0), S, S, True, "contig")
+    st = tile.init_state(B, NK, S, D)
+    m, lse, acc = tile.tile_fwd(q1, k, v, *st, SCALE, spec)
+    o = tile.finalize(m, lse, acc, q1.dtype)
+    delta = jnp.sum(o * do1, axis=-1)
+    seg = None
+    if segs:
+        ids = jnp.concatenate([jnp.zeros((B, S // 2 - 6), jnp.int32),
+                               jnp.ones((B, S // 2 + 6), jnp.int32)], axis=1)
+        seg = (ids, ids)
+    kw = dict(block_q=block_q, block_kv=block_kv, block_kv_compute=bkc,
+              interpret=True, triangular=True, fused=True, segments=seg)
+    base = pallas_flash.flash_bwd(do1, q1, k, v, delta, lse, SCALE, spec,
+                                  **kw)
+    got = pallas_flash.flash_bwd(do1, q1, k, v, delta, lse, SCALE, spec,
+                                 loop_sweep=True, **kw)
+    for name, x, y in zip(("dq", "dk", "dv"), base, got):
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6,
+                                   atol=1e-6, err_msg=name)
