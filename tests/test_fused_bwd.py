@@ -233,3 +233,66 @@ def test_tri_segments_bwd_matches_split():
     for name, a, b_ in zip(("dq", "dk", "dv"), split, tri):
         err = float(jnp.max(jnp.abs(a - b_)))
         assert err < 1e-3, f"{name} max abs err {err}"
+
+
+def test_tall_q_and_empty_carry_on_tpu():
+    """Round-4 fwd paths on real Mosaic: the tall-q tri grid (block_q =
+    r*block_kv) and the statically-empty carry (no state inputs at all)
+    against the square carried grid.  Interpret mode cannot validate the
+    dropped-input block plumbing or the r-wide diagonal's revisit
+    residency at real tile sizes."""
+    b, n, s, d = 1, 4, 4096, 128
+    ks = jax.random.split(jax.random.PRNGKey(21), 3)
+    dt = jnp.bfloat16
+    q = jax.random.normal(ks[0], (b, n, s, d), dt)
+    k = jax.random.normal(ks[1], (b, n, s, d), dt)
+    v = jax.random.normal(ks[2], (b, n, s, d), dt)
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
+    scale = d**-0.5
+
+    m0, lse0, acc0 = T.init_state(b, n, s, d)
+    base = pf.flash_fwd(q, k, v, m0, lse0, acc0, scale, spec,
+                        block_q=512, block_kv=512, triangular=True)
+    tall = pf.flash_fwd(q, k, v, m0, lse0, acc0, scale, spec,
+                        block_q=1024, block_kv=256, triangular=True)
+    empty = pf.flash_fwd(q, k, v, None, None, None, scale, spec,
+                         block_q=1024, block_kv=256, triangular=True)
+    for name, a, b_ in zip(("m", "lse", "acc"), base, tall):
+        err = float(jnp.max(jnp.abs(a - b_)))
+        assert err < 1e-3, f"tall {name} max abs err {err}"
+    for name, a, b_ in zip(("m", "lse", "acc"), base, empty):
+        err = float(jnp.max(jnp.abs(a - b_)))
+        assert err < 1e-3, f"empty-carry {name} max abs err {err}"
+
+
+def test_bwd_loop_sweep_on_tpu():
+    """The tri backward's fori_loop sweep on real Mosaic: its dynamic-offset
+    scratch stores (dv_scr/dk_scr at traced sub-block rows) have no
+    interpret-mode legality analogue — this is the compile-and-numerics
+    gate the multi-hour loop sweep depends on."""
+    b, n, s, d = 1, 2, 4096, 128
+    ks = jax.random.split(jax.random.PRNGKey(23), 4)
+    dt = jnp.bfloat16
+    q = jax.random.normal(ks[0], (b, n, s, d), dt)
+    k = jax.random.normal(ks[1], (b, n, s, d), dt)
+    v = jax.random.normal(ks[2], (b, n, s, d), dt)
+    do = jax.random.normal(ks[3], (b, n, s, d), dt)
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
+    scale = d**-0.5
+    m0, lse0, acc0 = T.init_state(b, n, s, d)
+    m, lse, acc = pf.flash_fwd(q, k, v, m0, lse0, acc0, scale, spec,
+                               block_q=512, block_kv=512)
+    o = T.finalize(m, lse, acc, q.dtype)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    args = (do, q, k, v, delta, lse, scale, spec)
+    # the gate must hold or both sides silently compile the rectangular
+    # kernel (which ignores loop_sweep) and the A/B is vacuous
+    assert pf.tri_bwd_supported(s, s, n, n, d, block_q=512, block_kv=1024,
+                                block_kv_compute=512)
+    kw = dict(block_q=512, block_kv=1024, block_kv_compute=512,
+              triangular=True, fused=True)
+    base = pf.flash_bwd(*args, **kw)
+    loop = pf.flash_bwd(*args, loop_sweep=True, **kw)
+    for name, a, b_ in zip(("dq", "dk", "dv"), base, loop):
+        err = float(jnp.max(jnp.abs(a - b_)))
+        assert err < 1e-3, f"loop {name} max abs err {err}"
