@@ -107,6 +107,14 @@ class ServeEngine:
         self._queue: List[_Request] = []
         self._next_id = 0
         self._finished: Dict[int, List[int]] = {}
+        # speculative accounting (draft mode only): proposed counts every
+        # draft token scored by the target; accepted counts those MATCHED
+        # by the target's argmax (before budget/EOS trims — trims are a
+        # serving artifact, not a draft-quality signal).  accepted/proposed
+        # is THE quantity a deployed draft is tuned on (Leviathan's alpha)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rounds = 0
 
     # -- client surface ----------------------------------------------------
 
@@ -145,6 +153,19 @@ class ServeEngine:
 
     def results(self) -> Dict[int, List[int]]:
         return dict(self._finished)
+
+    @property
+    def acceptance_rate(self) -> Optional[float]:
+        """Speculative acceptance rate: fraction of proposed draft tokens
+        the target's argmax MATCHED (pre-trim — see the counter comment in
+        __init__), over the engine's lifetime; None before any speculative
+        round.  ~0 means the draft is useless (every round pays k draft
+        steps + one multi-token target pass for one kept token); a
+        deployed draft is tuned until k*rate > the draft's relative
+        cost."""
+        if self.spec_proposed == 0:
+            return None
+        return self.spec_accepted / self.spec_proposed
 
     def run(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Drive step() until every submitted request finishes."""
@@ -318,6 +339,7 @@ class ServeEngine:
         # matching the target — the vectorized rollback then trims both
         _, self.dstate = paged_decode_step(
             dp, d_toks_dev[:, -1], self.dstate, dc)
+        self.spec_rounds += 1
         # the round's bulk host sync: proposals + target choices together
         d_toks = np.asarray(d_toks_dev)
         choice = np.asarray(jnp.argmax(lg_t, axis=-1))      # [slots, k+1]
@@ -336,6 +358,8 @@ class ServeEngine:
             n_acc = 0
             while n_acc < k and d_toks[slot, n_acc] == choice[slot, n_acc]:
                 n_acc += 1
+            self.spec_proposed += k
+            self.spec_accepted += n_acc
             new = ([int(x) for x in d_toks[slot, :n_acc]]
                    + [int(choice[slot, n_acc])])
             # budget and EOS trims (a speculative round can overshoot both)

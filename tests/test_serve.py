@@ -395,3 +395,25 @@ def test_engine_raises_on_poisoned_logits(model):
     eng.state = eng.state._replace(page_table=jnp.asarray(pt))
     with pytest.raises(RuntimeError, match="NaN-poisoned"):
         eng.step()
+
+
+def test_engine_acceptance_rate_accounting(model):
+    """Speculative engines report acceptance_rate = accepted/proposed.
+    Self-draft (draft == target) must accept every proposal (rate 1.0);
+    the plain engine reports None."""
+    cfg, params = model
+    prompts = _prompts(cfg, [9, 6], seed=71)
+
+    eng0 = ServeEngine(params, cfg, slots=2, n_pages=12, page=128,
+                       max_pages_per_seq=3)
+    [eng0.submit(p, 5) for p in prompts]
+    eng0.run()
+    assert eng0.acceptance_rate is None
+
+    eng = ServeEngine(params, cfg, slots=2, n_pages=12, page=128,
+                      max_pages_per_seq=3, draft_params=params,
+                      draft_cfg=cfg, spec_k=3)
+    [eng.submit(p, 5) for p in prompts]
+    eng.run()
+    assert eng.spec_rounds > 0 and eng.spec_proposed > 0
+    assert eng.acceptance_rate == 1.0  # self-draft: greedy always matches
