@@ -52,7 +52,9 @@ def main():
         if b.get("tri_fallback"):
             print("  !! tri_fallback set — triangular kernels failed on-chip")
 
-    sweep = _rows("results/sweep_r2.jsonl") + _rows("results/sweep_loop.jsonl")
+    sweep = (_rows("results/sweep_r2.jsonl") + _rows("results/sweep_loop.jsonl")
+             + _rows("results/sweep_tallq.jsonl")
+             + _rows("results/sweep_128k.jsonl"))
     if sweep:
         print("\nSWEEP (per config):")
         for r in sweep:
@@ -97,6 +99,16 @@ def main():
                       f"{' int8' if r.get('quantize') else ' bf16'}: "
                       f"{r['ms_per_prompt']} ms/prompt "
                       f"({r['prefill_tokens_per_s']} tok/s)")
+            elif r.get("phase") == "decode-dense":
+                print(f"  DENSE baseline slots={r['slots']} "
+                      f"ctx={r['context']}: {r['step_ms']} ms/step, "
+                      f"{r['tokens_per_s']} tok/s")
+            elif r.get("phase") == "churn":
+                print(f"  churn {r['requests']} reqs slots={r['slots']}"
+                      f"{' int8' if r.get('quantize') else ' bf16'}"
+                      f"{' +prefix' if r.get('prefix_cache') else ''}: "
+                      f"{r['total_tokens']} tok in {r['wall_s']} s = "
+                      f"{r['tokens_per_s']} tok/s end-to-end")
 
     smoke = _rows("results/results_smoke.jsonl")
     if smoke:
