@@ -23,8 +23,10 @@ if not os.environ.get("BURST_TESTS_TPU"):
 
 
 # ---------------------------------------------------------------------------
-# fast/slow split: tests measured >= ~19 s under contention (the top-60 of a
-# full-suite --durations run, 2026-07-31, total 4591 s) are marked slow here
+# fast/slow split: tests measured >= ~19 s under contention (full-suite
+# --durations runs, latest 2026-08-01, 4090 s / 343 tests; ~12-19 s
+# borderliners keep their marker across runs — hysteresis, not churn)
+# are marked slow here
 # in ONE place rather than as decorators in 15 files, so the list can be
 # regenerated mechanically from any fresh --durations log.
 # `pytest -m "not slow"` = the fast lane (~10 min); full suite for releases.
@@ -53,14 +55,10 @@ _SLOW = {
     ("test_model.py", "test_moe_forward_matches_dense_expert_compute"),
     ("test_model.py", "test_moe_model_trains"),
     ("test_model.py", "test_moe_model_trains_with_remat"),
-    ("test_model.py", "test_train_step_decreases_loss"),
-    ("test_moe.py", "test_ep_sharded_matches_dense"),
     ("test_moe.py", "test_grads_flow"),
     ("test_packed_training.py", "test_packed_doc_isolated_from_prefix"),
     ("test_packed_training.py", "test_packed_pp_matches_no_pp"),
     ("test_packed_training.py", "test_packed_train_step_runs"),
-    ("test_pallas.py", "test_single_device_flash_attention"),
-    ("test_pipeline.py", "test_pipeline_grads_match"),
     ("test_pp_model.py", "test_pp_double_ring_parity"),
     ("test_pp_model.py", "test_pp_dp_sp_train_step"),
     ("test_pp_model.py", "test_pp_loss_and_grad_parity"),
@@ -73,12 +71,14 @@ _SLOW = {
     ("test_runner.py", "test_grad_accum_exact_with_uneven_masking"),
     ("test_runner.py", "test_grad_accum_matches_full_batch"),
     ("test_schedule.py", "test_schedule_matches_host_expectation"),
+    ("test_serve.py", "test_speculative_serving_matches_plain_engine"),
     ("test_ulysses.py", "test_ulysses_fwd_grad"),
+    ("test_window.py", "test_burst_ring_contig_window"),
     ("test_window.py", "test_burst_ring_window_grad"),
-    ("test_window.py", "test_window_double_ring_matches_dense"),
-    ("test_window.py", "test_ring_truncation_matches_dense"),
     ("test_window.py", "test_decode_window_matches_forward"),
     ("test_window.py", "test_model_trains_with_window"),
+    ("test_window.py", "test_ring_truncation_matches_dense"),
+    ("test_window.py", "test_window_double_ring_matches_dense"),
 }
 
 
