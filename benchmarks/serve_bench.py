@@ -39,6 +39,18 @@ def main(argv=None):
                     help="churn phase: share one --context/2 token prefix "
                          "across all requests and serve with automatic "
                          "prefix caching")
+    ap.add_argument("--spec-layers", type=int, default=0,
+                    help="N > 0: speculative churn phase with an early-exit "
+                         "self-draft (the target's first N layers, weights "
+                         "shared — LayerSkip-style, no separate draft "
+                         "training).  Records acceptance rate and tokens/s "
+                         "against the plain engine on the same workload; "
+                         "with an UNTRAINED target the acceptance (and so "
+                         "the speedup) is expected to be poor — the row is "
+                         "the harness evidence + the honest number, not a "
+                         "claim")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens per speculative round")
     ap.add_argument("--dense-baseline", action="store_true",
                     help="extra phase: dense KV-cache decode at the same "
                          "(slots, context) — the paged path's comparison "
@@ -46,6 +58,10 @@ def main(argv=None):
                          "context)")
     ap.add_argument("--out", default="results/serve.jsonl")
     args = ap.parse_args(argv)
+    if args.spec_layers >= args.n_layers:
+        # validate BEFORE any phase runs — failing after minutes of TPU
+        # prefill/decode benchmarking would waste the whole invocation
+        raise SystemExit("--spec-layers must be < --n-layers")
 
     import jax
     import jax.numpy as jnp
@@ -157,6 +173,19 @@ def main(argv=None):
                 "step_ms": round(dt * 1e3, 2),
                 "tokens_per_s": round(args.slots / dt, 1)})
 
+    def timed_engine_run(eng):
+        """Warm one step outside the timed region (compiles + its tokens),
+        then time eng.run(); returns (tokens_emitted, wall_s).  The ONE
+        accounting used by every engine-level phase (churn, spec) so the
+        warm-token methodology cannot drift between them."""
+        eng.step()
+        warm = (sum(len(r.tokens) for r in eng.slots if r is not None)
+                + sum(len(v) for v in eng.results().values()))
+        t0 = time.perf_counter()
+        out = eng.run()
+        wall = time.perf_counter() - t0
+        return sum(len(v) for v in out.values()) - warm, wall
+
     if args.churn > 0:
         # end-to-end engine throughput WITH request turnover: staggered
         # budgets force continuous retirement + admission, the regime a
@@ -166,7 +195,7 @@ def main(argv=None):
 
         from burst_attn_tpu.models.serve import ServeEngine
 
-        del state  # free the phase-1/2 pools before allocating the engine's
+        state = None  # free the phase-1/2 pools before allocating the engine's
         n_req = args.churn
         budgets = [args.decode_steps // 2 + (i % 4) * (args.decode_steps // 4)
                    for i in range(n_req)]
@@ -192,20 +221,60 @@ def main(argv=None):
             else:
                 prompt = rng.randint(1, cfg.vocab, args.context)
             eng.submit(prompt, budgets[i])
-        # warm the prefill+decode compiles outside the timed region — and
-        # exclude the tokens that warm step produced from the numerator
-        eng.step()
-        warm_tokens = (sum(len(r.tokens) for r in eng.slots if r is not None)
-                       + sum(len(v) for v in eng.results().values()))
-        t0 = time.perf_counter()
-        out = eng.run()
-        wall = time.perf_counter() - t0
-        total = sum(len(v) for v in out.values()) - warm_tokens
+        total, wall = timed_engine_run(eng)
         record({"phase": "churn", "requests": n_req, "slots": args.slots,
                 "context": args.context, "quantize": args.quantize,
                 "prefix_cache": args.prefix_cache,
                 "total_tokens": total, "wall_s": round(wall, 2),
                 "tokens_per_s": round(total / wall, 1)})
+
+    if args.spec_layers > 0:
+        # speculative vs plain on the SAME workload, early-exit self-draft
+        # (target's first N layers, weights shared).  tokens/s + acceptance
+        # are recorded as measured; the break-even note makes the row
+        # interpretable either way (an untrained target's early-exit
+        # acceptance is expected to be low — the harness and the accounting
+        # are the deliverable, the speedup needs a trained model).
+        import dataclasses
+
+        import numpy as np
+
+        from burst_attn_tpu.models.serve import ServeEngine
+
+        state = None  # free the phase-1/2 pools (if churn didn't already)
+        dcfg = dataclasses.replace(cfg, n_layers=args.spec_layers)
+        dparams = dict(params, layers=params["layers"][: args.spec_layers])
+        n_req = 2 * args.slots
+        pages_per_req = -(-(args.context + args.decode_steps
+                            + args.spec_k + 1) // args.page)
+        n_pages = args.slots * pages_per_req + 2
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, cfg.vocab, args.context)
+                   for _ in range(n_req)]
+
+        def run_engine(spec):
+            kw = dict(draft_params=dparams, draft_cfg=dcfg,
+                      spec_k=args.spec_k) if spec else {}
+            eng = ServeEngine(params, cfg, slots=args.slots, n_pages=n_pages,
+                              page=args.page, max_pages_per_seq=pages_per_req,
+                              quantize=args.quantize, **kw)
+            for p in prompts:
+                eng.submit(p, args.decode_steps)
+            toks, wall = timed_engine_run(eng)
+            return toks / wall, eng
+
+        plain_tps, plain_eng = run_engine(False)
+        del plain_eng  # free its pools before the spec target+draft pair
+        spec_tps, eng = run_engine(True)
+        record({"phase": "spec", "slots": args.slots,
+                "context": args.context, "quantize": args.quantize,
+                "spec_k": args.spec_k,
+                "draft_layers": args.spec_layers, "n_layers": args.n_layers,
+                "acceptance_rate": round(eng.acceptance_rate or 0.0, 3),
+                "spec_rounds": eng.spec_rounds,
+                "plain_tokens_per_s": round(plain_tps, 1),
+                "spec_tokens_per_s": round(spec_tps, 1),
+                "speedup": round(spec_tps / plain_tps, 3)})
 
 
 if __name__ == "__main__":

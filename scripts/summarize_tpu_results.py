@@ -103,6 +103,14 @@ def main():
                 print(f"  DENSE baseline slots={r['slots']} "
                       f"ctx={r['context']}: {r['step_ms']} ms/step, "
                       f"{r['tokens_per_s']} tok/s")
+            elif r.get("phase") == "spec":
+                print(f"  SPEC early-exit {r['draft_layers']}/{r['n_layers']}"
+                      f" layers k={r['spec_k']}"
+                      f"{' int8' if r.get('quantize') else ' bf16'}: "
+                      f"acceptance {r['acceptance_rate']}, "
+                      f"{r['spec_tokens_per_s']} vs "
+                      f"{r['plain_tokens_per_s']} plain tok/s "
+                      f"(speedup {r['speedup']})")
             elif r.get("phase") == "churn":
                 print(f"  churn {r['requests']} reqs slots={r['slots']}"
                       f"{' int8' if r.get('quantize') else ' bf16'}"

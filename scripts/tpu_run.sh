@@ -74,6 +74,11 @@ stage_serve_prefix() {  # prefix-cache hit-path throughput
     --out /root/repo/results/serve.jsonl
 }
 
+stage_serve_spec() {  # speculative vs plain, early-exit self-draft (honest row)
+  run_stage serve-spec 7200 python -m benchmarks.serve_bench --spec-layers 4 \
+    --churn 0 --out /root/repo/results/serve.jsonl
+}
+
 stage_window() {  # round-3 band grids on chip (old number: 53 band-TFLOPs/s)
   run_stage window 7200 python -m benchmarks.window_bench \
     --out /root/repo/results/results_window.jsonl
@@ -101,7 +106,7 @@ stage_train_smoke() {  # end-to-end trainer MFU (defaults OOM one v5e chip)
     --n-layers 8 --vocab 8192 --out /root/repo/results/results_smoke.jsonl
 }
 
-DEFAULT_STAGES="head_tests bench tallq loop_sweep batch_probe serve_bf16 serve_int8 serve_churn serve_prefix window bwd128k seq256k scaling ring_trace train_smoke"
+DEFAULT_STAGES="head_tests bench tallq loop_sweep batch_probe serve_bf16 serve_int8 serve_churn serve_prefix serve_spec window bwd128k seq256k scaling ring_trace train_smoke"
 STAGES=${*:-$DEFAULT_STAGES}
 
 echo "=== [$(date -u +%F' '%T)] tpu_run: queue = $STAGES ==="
