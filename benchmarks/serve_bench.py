@@ -227,6 +227,7 @@ def main(argv=None):
                 "prefix_cache": args.prefix_cache,
                 "total_tokens": total, "wall_s": round(wall, 2),
                 "tokens_per_s": round(total / wall, 1)})
+        eng = None  # free the churn pools before any spec-phase engines
 
     if args.spec_layers > 0:
         # speculative vs plain on the SAME workload, early-exit self-draft

@@ -331,8 +331,11 @@ def _lcm(a, b):
 
 def fwd_band_nb(bq, bkv, window):
     """Exact max kv-block count a q-row-block's sliding-window band can
-    intersect, over the alignments the triangular contract can produce
-    (r0 = i*bq, offset in {0, -1}).  A closed-form upper bound
+    intersect, over the alignments the band contract can produce
+    (r0 = i*bq, offset in {0, -1} — and, shift-invariantly, any offset
+    ≡ 0 (mod bkv): the windowed contig ring's live rounds pass offset
+    r*s with bkv | s, which lands on the off=0 alignment class; keep the
+    enumeration in residues, never absolute offsets).  A closed-form upper bound
     ((bq+window-2)//bkv + 2) overcounts by one at every aligned config —
     e.g. window=4K, bq=bkv=2048 intersects at most 3 blocks, not 4 — and a
     permanently-dead extra grid step per row is exactly the overhead the
@@ -678,6 +681,12 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
     j <= q-block i with only the diagonal block partial, which is what the
     grid assumes; the diagonal's mask itself uses the real spec scalars, so
     both offsets compute correctly — the striped ring rounds rely on this).
+    With `window` set, triangular=True instead selects the BAND grid, whose
+    precondition is wider: offset in {0, -1} OR any offset ≡ 0 (mod bkv) —
+    the windowed contig ring's live rounds have offset r*s with bkv | s,
+    and the band width enumeration is shift-invariant at block-aligned
+    offsets (the kernel's _kv_jmin/_kv_jmax read the traced offset; see
+    fwd_band_nb).  Do NOT tighten either grid to absolute offsets.
     Falls back to the rectangular grid when the square-tiling preconditions
     don't hold.
     """

@@ -253,11 +253,20 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
         if cfg.layout == "contig" and cfg.causal:
             # contig-causal rings have provably dead rounds (futures; with a
             # window also everything beyond the band's reach): skip the
-            # whole kernel launch, not just its blocks (ops/masks.spec_live)
+            # whole kernel launch, not just its blocks (ops/masks.spec_live).
+            # Windowed LIVE rounds also take the BAND grid: every live
+            # round's offset is a nonneg multiple of the chunk length
+            # (delta = r*s, and blocks divide s or flash_fwd's ragged path
+            # declines the grid), so delta ≡ 0 (mod bkv) and the band
+            # alignment enumeration behind fwd_band_nb applies round-
+            # independently — the kernel's _kv_jmin/_kv_jmax read the
+            # traced offset.  The bwd side has been banded since round 3
+            # (bwd_band_nbq in the rect fused sweep).
+            band = cfg.window is not None
             return lax.cond(
                 spec_live(spec, cfg.window),
                 lambda st_: _tile_fwd(cfg, q, k_c, v_c, *st_, scale, spec,
-                                      segments=segs),
+                                      triangular=band, segments=segs),
                 lambda st_: st_,
                 st)
         return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec, segments=segs)
