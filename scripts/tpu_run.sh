@@ -19,6 +19,16 @@ stage_head_tests() {  # on-chip validation of the HEAD kernels
     python -m pytest tests/test_fused_bwd.py tests/test_pallas.py -q
 }
 
+stage_paged_tests() {  # on-chip paged kernel incl. int8 (never run on Mosaic;
+  # kernel-level tests only — the engine tests would compile dozens of tiny
+  # jits through the tunnel for no kernel coverage)
+  run_stage paged-tests 7200 env BURST_TESTS_TPU=1 \
+    python -m pytest "tests/test_paged.py::test_kernel_matches_reference_ragged" \
+    "tests/test_paged.py::test_kernel_window_matches_reference" \
+    "tests/test_paged.py::test_kernel_page_identity_is_position_free" \
+    "tests/test_paged.py::test_kernel_int8_matches_dequantized_reference" -q
+}
+
 stage_tallq() {  # tall-q tri grid + empty-carry fast path (round-4 kernel work):
   # fwd K/V streaming traffic scales 1/bq at fixed cliff-legal area (4096x1024
   # halves it vs 2048x2048 at the same step count); bwd q-side traffic scales
@@ -106,7 +116,7 @@ stage_train_smoke() {  # end-to-end trainer MFU (defaults OOM one v5e chip)
     --n-layers 8 --vocab 8192 --out /root/repo/results/results_smoke.jsonl
 }
 
-DEFAULT_STAGES="head_tests bench tallq loop_sweep batch_probe serve_bf16 serve_int8 serve_churn serve_prefix serve_spec window bwd128k seq256k scaling ring_trace train_smoke"
+DEFAULT_STAGES="head_tests paged_tests bench tallq loop_sweep batch_probe serve_bf16 serve_int8 serve_churn serve_prefix serve_spec window bwd128k seq256k scaling ring_trace train_smoke"
 STAGES=${*:-$DEFAULT_STAGES}
 
 echo "=== [$(date -u +%F' '%T)] tpu_run: queue = $STAGES ==="
