@@ -74,6 +74,14 @@ stage_batch_probe() {  # batch-scaling regression discriminator (VERDICT r3 #3)
     --out /root/repo/results/batch_probe.jsonl
 }
 
+stage_step_probe() {  # fixed-vs-bandwidth decomposition of the ~5us/step gap
+  run_stage step-probe 7200 python -m benchmarks.step_probe \
+    --out /root/repo/results/step_probe.jsonl
+  run_stage step-probe-dma 3600 python -m benchmarks.step_probe --no-matmul \
+    --kv-blocks "1024,2048" --steps "2048,8192" \
+    --out /root/repo/results/step_probe.jsonl
+}
+
 stage_serve_churn() {  # engine throughput under request turnover
   run_stage serve-churn 7200 python -m benchmarks.serve_bench --churn 32 \
     --out /root/repo/results/serve.jsonl
@@ -116,7 +124,7 @@ stage_train_smoke() {  # end-to-end trainer MFU (defaults OOM one v5e chip)
     --n-layers 8 --vocab 8192 --out /root/repo/results/results_smoke.jsonl
 }
 
-DEFAULT_STAGES="head_tests paged_tests bench tallq loop_sweep batch_probe serve_bf16 serve_int8 serve_churn serve_prefix serve_spec window bwd128k seq256k scaling ring_trace train_smoke"
+DEFAULT_STAGES="head_tests paged_tests bench tallq loop_sweep batch_probe step_probe serve_bf16 serve_int8 serve_churn serve_prefix serve_spec window bwd128k seq256k scaling ring_trace train_smoke"
 STAGES=${*:-$DEFAULT_STAGES}
 
 echo "=== [$(date -u +%F' '%T)] tpu_run: queue = $STAGES ==="
