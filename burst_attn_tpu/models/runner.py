@@ -188,6 +188,14 @@ def main(argv=None):
                         "attention never crosses them (segment_ids)")
     p.add_argument("--multihost", action="store_true",
                    help="call multihost.initialize() before touching jax")
+    p.add_argument("--probe-tri-bwd", action="store_true",
+                   help="before building the train step, actually COMPILE "
+                        "the wrapped-diagonal fused backward at this run's "
+                        "per-shard sequence length; if Mosaic rejects it "
+                        "(possible on generations without a measured block "
+                        "table) fall back to the rectangular kernel instead "
+                        "of crashing the full train-step compile (costs one "
+                        "extra kernel compile at startup)")
     args = p.parse_args(argv)
 
     if args.multihost:
@@ -236,6 +244,23 @@ def main(argv=None):
         layout=args.layout,
         remat=not args.no_remat,
     )
+    if args.probe_tri_bwd:
+        from ..ops.pallas_flash import probe_tri_bwd
+
+        ring = 1
+        for ax in seq_axes:
+            ring *= mesh_axes.get(ax, 1)
+        s_shard = args.seq_len // ring  # the bwd kernels see per-shard length
+        # probe the run's ACTUAL kernel variant: GQA returns False with no
+        # compile (tri is group=1 only), packed runs compile the segment
+        # variant (its extra residents can fail where plain tri passes)
+        ok = probe_tri_bwd(s_shard, cfg.d_head, n=cfg.n_heads,
+                           n_kv=cfg.n_kv_heads,
+                           segments=args.packed_eos is not None)
+        print(f"probe_tri_bwd(s={s_shard}, d={cfg.d_head}, "
+              f"gqa={cfg.n_heads != cfg.n_kv_heads}, "
+              f"packed={args.packed_eos is not None}): "
+              f"{'tri' if ok else 'RECT FALLBACK'}")
     tcfg = TrainConfig(lr=args.lr, grad_accum=args.grad_accum)
     run = RunConfig(
         data_path=args.data, steps=args.steps, batch=args.batch,

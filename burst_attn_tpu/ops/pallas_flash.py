@@ -37,6 +37,7 @@ packed back with a native lane-reducing reshape.
 """
 
 import functools
+import logging
 import os
 
 import jax
@@ -45,6 +46,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .masks import MaskSpec
+
+logger = logging.getLogger("burst_attn_tpu")
 # re-exported here for kernel users; defined in ops/tuning.py so jnp-only
 # paths (burst.py's backend fallback) can resolve blocks without importing
 # this module
@@ -1710,6 +1713,61 @@ def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv,
         and nkb % 2 == 0 and nkb >= 2
         and s_q * d * 4 <= dq_budget
     )
+
+
+def probe_tri_bwd(s, d, *, n=1, n_kv=None, segments=False, block_q=None,
+                  block_kv=None, block_kv_compute=None,
+                  loop_sweep=False) -> bool:
+    """ACTUALLY compile the wrapped-diagonal fused backward at sequence
+    length s and report whether it succeeds; returns False WITHOUT
+    compiling when production would never take the tri path (GQA — the
+    tri kernel is group=1 only — or a failed tri_bwd_supported gate).
+    The compile itself runs at b = n = 1: the whole-head dq residency
+    that decides compilability is per-(batch, head).  `segments=True`
+    compiles the packed-sequence variant (its segment-id input blocks and
+    masking add VMEM residents — a segment-free pass does not prove the
+    packed kernel compiles).  On compile failure, set BURST_NO_TRI=1 for
+    this process so every later triangular=True call takes the
+    rectangular fused kernel instead of crashing the caller's (much
+    larger) jit.
+
+    Why this exists: tri_bwd_supported is a hand model of Mosaic's VMEM
+    residency, explicitly conservative but unverified on generations
+    without a measured BlockTable row — a config that passes the gate but
+    fails Mosaic has no automatic fallback inside a traced program (a
+    pallas lowering error surfaces when the ENCLOSING jit compiles, where
+    flash_bwd can no longer catch it).  Opt-in (costs one real kernel
+    compile, minutes on a cold remote-compile cache): call it once at
+    startup — models/runner.py does under --probe-tri-bwd."""
+    from .masks import round_spec
+
+    n_kv = n if n_kv is None else n_kv
+    _, _, bq, bkv, _ = resolve_blocks(None, None, block_q, block_kv)
+    if not tri_bwd_supported(s, s, n, n_kv, d, block_q=bq, block_kv=bkv,
+                             block_kv_compute=block_kv_compute):
+        return False
+    if _interpret_default():
+        return True  # interpret mode always "compiles"
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
+    args = [jnp.zeros((1, 1, s, d), jnp.bfloat16) for _ in range(4)] + [
+        jnp.zeros((1, 1, s), jnp.float32), jnp.zeros((1, 1, s), jnp.float32)]
+    segs = (jnp.zeros((1, s), jnp.int32),) * 2 if segments else None
+    try:
+        jax.jit(lambda do, q, k, v, delta, lse: flash_bwd(
+            do, q, k, v, delta, lse, d**-0.5, spec, block_q=bq, block_kv=bkv,
+            triangular=True, fused=True, block_kv_compute=block_kv_compute,
+            loop_sweep=loop_sweep, segments=segs,
+        )).lower(*args).compile()
+        return True
+    except Exception as e:  # noqa: BLE001 — any compile failure means rect
+        logger.warning(
+            "tri bwd at s=%d blocks %dx%d%s passed the VMEM gate but FAILED "
+            "to compile (%s: %.120s); setting BURST_NO_TRI=1 — this process "
+            "falls back to the rectangular fused backward", s, bq, bkv,
+            " (packed)" if segments else "",
+            type(e).__name__, str(e))
+        os.environ["BURST_NO_TRI"] = "1"
+        return False
 
 
 def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,

@@ -3,6 +3,8 @@ the TPU build's analogue of validating lao.py's Triton kernels against the
 pure-torch tile (reference burst_utils.py:42-148); run per ring-round mask
 spec, with carry-in state, GQA, and both backward kernels."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -459,3 +461,42 @@ def test_tri_bwd_loop_sweep_matches_unrolled(qkv, block_q, block_kv, bkc,
     for name, x, y in zip(("dq", "dk", "dv"), base, got):
         np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6,
                                    atol=1e-6, err_msg=name)
+
+
+def test_probe_tri_bwd(monkeypatch):
+    """probe_tri_bwd: gate-fail returns False without compiling; interpret
+    mode returns True; a COMPILE failure (mocked) flips BURST_NO_TRI so
+    later triangular calls fall back to the rectangular kernel instead of
+    crashing the caller's jit."""
+    monkeypatch.delenv("BURST_NO_TRI", raising=False)
+    # gate-fail: odd kv-block count (nkb = 3) never reaches the compile
+    assert pallas_flash.probe_tri_bwd(96, 16, block_q=32, block_kv=32) is False
+    assert "BURST_NO_TRI" not in os.environ
+
+    # interpret mode (CPU): gate passes, probe trusts interpret
+    assert pallas_flash.probe_tri_bwd(64, 16, block_q=32, block_kv=32) is True
+
+    # mocked Mosaic rejection: non-interpret path whose jit compile raises
+    monkeypatch.setattr(pallas_flash, "_interpret_default", lambda: False)
+
+    class _Boom:
+        def lower(self, *a, **k):
+            raise RuntimeError("Mosaic: scoped vmem exceeded (mock)")
+
+    monkeypatch.setattr(jax, "jit", lambda fn: _Boom())
+    assert pallas_flash.probe_tri_bwd(64, 16, block_q=32, block_kv=32) is False
+    assert os.environ.get("BURST_NO_TRI") == "1"
+    monkeypatch.delenv("BURST_NO_TRI", raising=False)
+
+
+def test_probe_tri_bwd_gqa_declines_without_compile(monkeypatch):
+    """GQA (n != n_kv) never takes the tri path in production, so the
+    probe must return False WITHOUT burning a compile."""
+    monkeypatch.setattr(pallas_flash, "_interpret_default", lambda: False)
+
+    def boom(fn):
+        raise AssertionError("probe compiled despite GQA")
+
+    monkeypatch.setattr(jax, "jit", boom)
+    assert pallas_flash.probe_tri_bwd(64, 16, n=8, n_kv=4,
+                                      block_q=32, block_kv=32) is False
