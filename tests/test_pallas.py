@@ -500,3 +500,55 @@ def test_probe_tri_bwd_gqa_declines_without_compile(monkeypatch):
     monkeypatch.setattr(jax, "jit", boom)
     assert pallas_flash.probe_tri_bwd(64, 16, n=8, n_kv=4,
                                       block_q=32, block_kv=32) is False
+
+
+def test_fwd_random_config_property_sweep():
+    """Property sweep: 18 seeded random configurations crossing GQA x
+    window x segments x tall-q blocks x ragged lengths x carry/empty
+    against the jnp oracle — the targeted tests each pin one feature;
+    this guards the INTERACTIONS (e.g. ragged + GQA + window + segments
+    in one call)."""
+    import itertools
+    rng = np.random.RandomState(2024)
+    for trial in range(18):
+        b = int(rng.choice([1, 2]))
+        group = int(rng.choice([1, 2]))
+        nk = int(rng.choice([1, 2]))
+        n = nk * group
+        s = int(rng.choice([48, 64, 96]))
+        d = int(rng.choice([16, 32]))
+        bq = int(rng.choice([16, 32]))
+        bkv = int(rng.choice([8, 16, 32]))
+        causal = bool(rng.rand() < 0.7)
+        wnd = int(rng.choice([24, 40])) if (causal and rng.rand() < 0.4) else None
+        tri = causal and wnd is None and rng.rand() < 0.5 and bq % bkv == 0
+        empty = rng.rand() < 0.5
+        segs = None
+        if rng.rand() < 0.4:
+            cut = int(rng.randint(8, s - 8))
+            ids = jnp.concatenate([jnp.zeros((b, cut), jnp.int32),
+                                   jnp.ones((b, s - cut), jnp.int32)], axis=1)
+            segs = (ids, ids)
+        q = jax.random.normal(jax.random.PRNGKey(trial), (b, n, s, d),
+                              jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(100 + trial), (b, nk, s, d),
+                              jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(200 + trial), (b, nk, s, d),
+                              jnp.float32)
+        spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, causal, "contig",
+                          window=wnd)
+        st = tile.init_state(b, n, s, d)
+        ref = tile.tile_fwd(q, k, v, *st, d**-0.5, spec, window=wnd,
+                            segments=segs)
+        carry = (None, None, None) if empty else st
+        got = pallas_flash.flash_fwd(
+            q, k, v, *carry, d**-0.5, spec, block_q=bq, block_kv=bkv,
+            interpret=True, cast_p=False, triangular=tri, window=wnd,
+            segments=segs)
+        cfgs = f"trial={trial} b={b} n={n}/{nk} s={s} d={d} bq={bq} " \
+               f"bkv={bkv} causal={causal} wnd={wnd} tri={tri} " \
+               f"empty={empty} segs={segs is not None}"
+        for name, x, y in zip(("m", "lse", "acc"), ref, got):
+            np.testing.assert_allclose(
+                np.asarray(y), np.asarray(x), rtol=1e-4, atol=1e-4,
+                err_msg=f"{name} @ {cfgs}")
