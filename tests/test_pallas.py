@@ -503,18 +503,17 @@ def test_probe_tri_bwd_gqa_declines_without_compile(monkeypatch):
 
 
 def test_fwd_random_config_property_sweep():
-    """Property sweep: 18 seeded random configurations crossing GQA x
-    window x segments x tall-q blocks x ragged lengths x carry/empty
-    against the jnp oracle — the targeted tests each pin one feature;
-    this guards the INTERACTIONS (e.g. ragged + GQA + window + segments
-    in one call)."""
-    import itertools
+    """Property sweep vs the jnp oracle: 18 seeded random configurations
+    PLUS pinned trials for the interactions the random draws happen to
+    miss (window x segments, window x ragged, window x ragged x segments
+    x GQA, effective tall-q tri) — with a coverage assertion so a future
+    seed/trial tweak cannot silently drop a claimed pair."""
     rng = np.random.RandomState(2024)
+    configs = []
     for trial in range(18):
         b = int(rng.choice([1, 2]))
         group = int(rng.choice([1, 2]))
         nk = int(rng.choice([1, 2]))
-        n = nk * group
         s = int(rng.choice([48, 64, 96]))
         d = int(rng.choice([16, 32]))
         bq = int(rng.choice([16, 32]))
@@ -523,32 +522,62 @@ def test_fwd_random_config_property_sweep():
         wnd = int(rng.choice([24, 40])) if (causal and rng.rand() < 0.4) else None
         tri = causal and wnd is None and rng.rand() < 0.5 and bq % bkv == 0
         empty = rng.rand() < 0.5
+        seg_cut = int(rng.randint(8, s - 8)) if rng.rand() < 0.4 else None
+        configs.append(dict(b=b, group=group, nk=nk, s=s, d=d, bq=bq,
+                            bkv=bkv, causal=causal, wnd=wnd, tri=tri,
+                            empty=empty, seg_cut=seg_cut))
+    configs += [
+        # pinned: the pairs the 2024 seed never draws (verified by RNG
+        # simulation during review) — keep these regardless of seed
+        dict(b=1, group=1, nk=2, s=64, d=16, bq=16, bkv=16, causal=True,
+             wnd=24, tri=False, empty=False, seg_cut=30),   # window x segs
+        dict(b=1, group=1, nk=1, s=90, d=16, bq=16, bkv=16, causal=True,
+             wnd=24, tri=False, empty=True, seg_cut=None),  # window x ragged
+        dict(b=2, group=2, nk=1, s=90, d=16, bq=16, bkv=16, causal=True,
+             wnd=40, tri=False, empty=False, seg_cut=40),   # all four
+        dict(b=1, group=2, nk=1, s=64, d=32, bq=32, bkv=16, causal=True,
+             wnd=None, tri=True, empty=True, seg_cut=None),  # tall-q tri
+    ]
+
+    seen = {"wnd_seg": 0, "wnd_ragged": 0, "tri_eff": 0}
+    for trial, c in enumerate(configs):
+        n = c["nk"] * c["group"]
+        b, s, d = c["b"], c["s"], c["d"]
         segs = None
-        if rng.rand() < 0.4:
-            cut = int(rng.randint(8, s - 8))
-            ids = jnp.concatenate([jnp.zeros((b, cut), jnp.int32),
-                                   jnp.ones((b, s - cut), jnp.int32)], axis=1)
+        if c["seg_cut"] is not None:
+            ids = jnp.concatenate(
+                [jnp.zeros((b, c["seg_cut"]), jnp.int32),
+                 jnp.ones((b, s - c["seg_cut"]), jnp.int32)], axis=1)
             segs = (ids, ids)
+        ragged = s % c["bq"] != 0 or s % c["bkv"] != 0
+        if c["wnd"] is not None and segs is not None:
+            seen["wnd_seg"] += 1
+        if c["wnd"] is not None and ragged:
+            seen["wnd_ragged"] += 1
+        if c["tri"] and not ragged and c["bq"] % c["bkv"] == 0 \
+                and (s // c["bq"]) % 2 == 0 and s // c["bq"] >= 2:
+            seen["tri_eff"] += 1
         q = jax.random.normal(jax.random.PRNGKey(trial), (b, n, s, d),
                               jnp.float32)
-        k = jax.random.normal(jax.random.PRNGKey(100 + trial), (b, nk, s, d),
-                              jnp.float32)
-        v = jax.random.normal(jax.random.PRNGKey(200 + trial), (b, nk, s, d),
-                              jnp.float32)
-        spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, causal, "contig",
-                          window=wnd)
+        k = jax.random.normal(jax.random.PRNGKey(100 + trial),
+                              (b, c["nk"], s, d), jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(200 + trial),
+                              (b, c["nk"], s, d), jnp.float32)
+        spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, c["causal"],
+                          "contig", window=c["wnd"])
         st = tile.init_state(b, n, s, d)
-        ref = tile.tile_fwd(q, k, v, *st, d**-0.5, spec, window=wnd,
+        ref = tile.tile_fwd(q, k, v, *st, d**-0.5, spec, window=c["wnd"],
                             segments=segs)
-        carry = (None, None, None) if empty else st
+        carry = (None, None, None) if c["empty"] else st
         got = pallas_flash.flash_fwd(
-            q, k, v, *carry, d**-0.5, spec, block_q=bq, block_kv=bkv,
-            interpret=True, cast_p=False, triangular=tri, window=wnd,
-            segments=segs)
-        cfgs = f"trial={trial} b={b} n={n}/{nk} s={s} d={d} bq={bq} " \
-               f"bkv={bkv} causal={causal} wnd={wnd} tri={tri} " \
-               f"empty={empty} segs={segs is not None}"
+            q, k, v, *carry, d**-0.5, spec, block_q=c["bq"],
+            block_kv=c["bkv"], interpret=True, cast_p=False,
+            triangular=c["tri"], window=c["wnd"], segments=segs)
+        msg = f"trial={trial} {c}"
         for name, x, y in zip(("m", "lse", "acc"), ref, got):
             np.testing.assert_allclose(
                 np.asarray(y), np.asarray(x), rtol=1e-4, atol=1e-4,
-                err_msg=f"{name} @ {cfgs}")
+                err_msg=f"{name} @ {msg}")
+    # the claimed interactions must actually have been exercised
+    assert seen["wnd_seg"] >= 1 and seen["wnd_ragged"] >= 2 \
+        and seen["tri_eff"] >= 1, seen
