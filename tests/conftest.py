@@ -50,6 +50,7 @@ _SLOW = {
     ("test_decode.py", "test_generate_greedy_matches_recompute"),
     ("test_decode.py", "test_moe_decode_chunked_prefill_matches_forward"),
     ("test_dist_decode.py", "test_dist_prefill_matches_single_device"),
+    ("test_pallas.py", "test_bwd_random_config_property_sweep"),
     ("test_pallas.py", "test_fwd_random_config_property_sweep"),
     ("test_model.py", "test_double_ring_model"),
     ("test_model.py", "test_forward_matches_single_device"),
