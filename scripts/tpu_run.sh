@@ -124,7 +124,12 @@ stage_train_smoke() {  # end-to-end trainer MFU (defaults OOM one v5e chip)
     --n-layers 8 --vocab 8192 --out /root/repo/results/results_smoke.jsonl
 }
 
-DEFAULT_STAGES="head_tests paged_tests bench tallq loop_sweep batch_probe step_probe serve_bf16 serve_int8 serve_churn serve_prefix serve_spec window bwd128k seq256k scaling ring_trace train_smoke"
+# bench FIRST: if the tunnel window is short, the live BENCH capture (the
+# one artifact three rounds have gone without) must land before anything
+# else; bench runs the long-proven default path (square tri + the
+# empty-carry input drop), then head_tests validates the full round-4
+# kernel surface before the sweeps spend hours on it.
+DEFAULT_STAGES="bench head_tests paged_tests tallq loop_sweep batch_probe step_probe serve_bf16 serve_int8 serve_churn serve_prefix serve_spec window bwd128k seq256k scaling ring_trace train_smoke"
 STAGES=${*:-$DEFAULT_STAGES}
 
 echo "=== [$(date -u +%F' '%T)] tpu_run: queue = $STAGES ==="
