@@ -12,12 +12,17 @@ import jax
 import jax.numpy as jnp
 
 
-@partial(jax.jit, static_argnames=("causal",))
-def dense_attention(q, k, v, scale=None, causal=False, segment_ids=None):
+@partial(jax.jit, static_argnames=("causal", "window"))
+def dense_attention(q, k, v, scale=None, causal=False, segment_ids=None,
+                    window=None):
     """q, k, v: [B, N, S, D] (kv heads may be fewer — GQA). Returns [B, N, S, D].
-    segment_ids [B, S]: packed-sequence mask (attention stays in-segment)."""
+    segment_ids [B, S]: packed-sequence mask (attention stays in-segment).
+    window (static int, needs causal): each query sees its last `window`
+    positions inclusive — same contract as flash_attention(window=)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     from .tile import _expand_kv
 
     k = _expand_kv(k, q.shape[1])
@@ -29,6 +34,8 @@ def dense_attention(q, k, v, scale=None, causal=False, segment_ids=None):
         rows = jnp.arange(s_q)[:, None]
         cols = jnp.arange(s_kv)[None, :]
         mask = mask & (cols <= rows)
+        if window is not None:
+            mask = mask & (cols > rows - window)
     if segment_ids is not None:
         mask = mask & (segment_ids[:, None, :, None]
                        == segment_ids[:, None, None, :])

@@ -26,7 +26,7 @@ def make_mesh(shape):
 
 def run_case(mesh_shape, layout, causal, kv_heads=4, optimize_bwd_comm=True,
              seq_per_dev=16, backend="jnp", n=4, d=16, n_segments=None,
-             **burst_kw):
+             window=None, **burst_kw):
     W = int(np.prod(mesh_shape))
     b = 1
     S = seq_per_dev * W
@@ -43,10 +43,11 @@ def run_case(mesh_shape, layout, causal, kv_heads=4, optimize_bwd_comm=True,
 
     # oracle on natural token order
     def ref_loss(q, k, v):
-        return jnp.sum(dense_attention(q, k, v, causal=causal,
+        return jnp.sum(dense_attention(q, k, v, causal=causal, window=window,
                                        segment_ids=seg).astype(jnp.float32) * do)
 
-    o_ref = dense_attention(q, k, v, causal=causal, segment_ids=seg)
+    o_ref = dense_attention(q, k, v, causal=causal, window=window,
+                            segment_ids=seg)
     dq_ref, dk_ref, dv_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
 
     # burst on layout order
@@ -57,14 +58,14 @@ def run_case(mesh_shape, layout, causal, kv_heads=4, optimize_bwd_comm=True,
         o = burst_attn(
             ql, kl, vl, mesh=mesh, seq_axes=names, causal=causal, layout=layout,
             backend=backend, optimize_bwd_comm=optimize_bwd_comm,
-            segment_ids=segl, **burst_kw,
+            segment_ids=segl, window=window, **burst_kw,
         )
         return jnp.sum(o.astype(jnp.float32) * dol)
 
     o_l = burst_attn(
         ql, kl, vl, mesh=mesh, seq_axes=names, causal=causal, layout=layout,
         backend=backend, optimize_bwd_comm=optimize_bwd_comm,
-        segment_ids=segl, **burst_kw,
+        segment_ids=segl, window=window, **burst_kw,
     )
     dq_l, dk_l, dv_l = jax.grad(burst_loss, argnums=(0, 1, 2))(ql, kl, vl)
 
@@ -210,3 +211,48 @@ def test_bf16_reference_tolerance():
     )
     o = layouts.from_layout(o_l, "zigzag", W, 2)
     check_close(o, o_ref, rtol=4e-2, atol=4e-2, msg="bf16 o")
+
+
+def test_ring_random_config_property_sweep():
+    """Randomized ring-level interaction sweep: mesh topology x layout x
+    causal x GQA x window x packed segments x backend x bwd-comm mode vs
+    the dense oracle — the targeted tests each pin one dimension; this
+    guards combinations (e.g. double-ring striped GQA on the pallas
+    backend, or windowed contig with packed segments), plus pinned
+    configs for pairs the seed might miss."""
+    rng = np.random.RandomState(41)
+    cases = []
+    for _ in range(7):
+        layout = ["zigzag", "striped", "contig"][int(rng.randint(3))]
+        causal = bool(rng.rand() < 0.75)
+        wnd = (int(rng.choice([24, 48]))
+               if (layout == "contig" and causal and rng.rand() < 0.4)
+               else None)
+        cases.append(dict(
+            mesh_shape=[(8,), (2, 4), (4, 2)][int(rng.randint(3))],
+            layout=layout, causal=causal,
+            kv_heads=int(rng.choice([2, 4])),
+            optimize_bwd_comm=bool(rng.rand() < 0.5),
+            n_segments=int(rng.choice([0, 3])) or None,
+            window=wnd))
+    cases += [
+        # pinned: double-ring striped GQA on pallas-interpret; windowed
+        # contig + segments on a double ring; zigzag packed GQA no-opt-comm
+        dict(mesh_shape=(2, 4), layout="striped", causal=True, kv_heads=2,
+             backend="pallas", window=None, n_segments=None),
+        dict(mesh_shape=(2, 4), layout="contig", causal=True, kv_heads=4,
+             window=24, n_segments=3),
+        dict(mesh_shape=(8,), layout="zigzag", causal=True, kv_heads=2,
+             optimize_bwd_comm=False, n_segments=4, window=None),
+    ]
+    seen = {"wnd_seg": 0, "double_ring": 0, "gqa_striped": 0}
+    for c in cases:
+        if c.get("window") and c.get("n_segments"):
+            seen["wnd_seg"] += 1
+        if len(c["mesh_shape"]) == 2:
+            seen["double_ring"] += 1
+        if c["layout"] == "striped" and c["kv_heads"] < 4:
+            seen["gqa_striped"] += 1
+        run_case(**c)
+    assert (seen["wnd_seg"] >= 1 and seen["double_ring"] >= 2
+            and seen["gqa_striped"] >= 1), seen

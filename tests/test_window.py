@@ -1,7 +1,9 @@
 """Sliding-window (band) causal attention: mask semantics, jnp tile, Pallas
 kernels (interpret), the public flash_attention, the contig burst ring, and
-ulysses.  Beyond the reference (no window support there); oracle = dense
-banded softmax."""
+ulysses.  Beyond the UPSTREAM reference (MayDomine/Burst-Attention has no
+window support); oracle = dense banded softmax (banded_dense here, and
+ops/reference.py's dense_attention(window=) since round 4 — both exist so
+the two stay mutually checking)."""
 
 import jax
 import jax.numpy as jnp
