@@ -25,7 +25,7 @@ import numpy as np
 
 from .train import (
     TrainConfig, batch_from_host, init_train_state, make_mesh, make_train_step,
-    prefetch_batches,
+    prefetch_batches, probe_model_tri_bwd,
 )
 from .transformer import ModelConfig
 from ..data import DataLoader
@@ -188,14 +188,19 @@ def main(argv=None):
                         "attention never crosses them (segment_ids)")
     p.add_argument("--multihost", action="store_true",
                    help="call multihost.initialize() before touching jax")
-    p.add_argument("--probe-tri-bwd", action="store_true",
-                   help="before building the train step, actually COMPILE "
-                        "the wrapped-diagonal fused backward at this run's "
-                        "per-shard sequence length; if Mosaic rejects it "
-                        "(possible on generations without a measured block "
-                        "table) fall back to the rectangular kernel instead "
-                        "of crashing the full train-step compile (costs one "
-                        "extra kernel compile at startup)")
+    p.add_argument("--probe-tri-bwd", action="store_true", default=True,
+                   help="(default ON) before building the train step, "
+                        "actually COMPILE the wrapped-diagonal fused "
+                        "backward at this run's per-shard sequence length; "
+                        "if Mosaic rejects it (possible on generations "
+                        "without a measured block table) fall back to the "
+                        "rectangular kernel instead of crashing the full "
+                        "train-step compile (costs one extra kernel compile "
+                        "at startup, memoized process-wide)")
+    p.add_argument("--no-probe-tri-bwd", dest="probe_tri_bwd",
+                   action="store_false",
+                   help="skip the startup tri-backward compile probe (the "
+                        "first train step still runs it via make_train_step)")
     args = p.parse_args(argv)
 
     if args.multihost:
@@ -245,22 +250,19 @@ def main(argv=None):
         remat=not args.no_remat,
     )
     if args.probe_tri_bwd:
-        from ..ops.pallas_flash import probe_tri_bwd
-
-        ring = 1
-        for ax in seq_axes:
-            ring *= mesh_axes.get(ax, 1)
-        s_shard = args.seq_len // ring  # the bwd kernels see per-shard length
-        # probe the run's ACTUAL kernel variant: GQA returns False with no
-        # compile (tri is group=1 only), packed runs compile the segment
-        # variant (its extra residents can fail where plain tri passes)
-        ok = probe_tri_bwd(s_shard, cfg.d_head, n=cfg.n_heads,
-                           n_kv=cfg.n_kv_heads,
-                           segments=args.packed_eos is not None)
-        print(f"probe_tri_bwd(s={s_shard}, d={cfg.d_head}, "
-              f"gqa={cfg.n_heads != cfg.n_kv_heads}, "
-              f"packed={args.packed_eos is not None}): "
-              f"{'tri' if ok else 'RECT FALLBACK'}")
+        # memoized (ensure_tri_bwd): make_train_step's first-step probe
+        # then hits this result for free — running it eagerly here only
+        # moves the one compile before startup so the outcome prints.
+        # probe_model_tri_bwd owns the model-to-kernel shape mapping (ring
+        # division, packed segment variant, jnp/window/non-TPU gates) so
+        # this probes exactly the kernel the train step will take.
+        ok = probe_model_tri_bwd(cfg, mesh, seq_len=args.seq_len,
+                                 packed=args.packed_eos is not None)
+        if ok is not None:
+            print(f"probe_tri_bwd(seq={args.seq_len}, d={cfg.d_head}, "
+                  f"gqa={cfg.n_heads != cfg.n_kv_heads}, "
+                  f"packed={args.packed_eos is not None}): "
+                  f"{'tri' if ok else 'RECT FALLBACK'}")
     tcfg = TrainConfig(lr=args.lr, grad_accum=args.grad_accum)
     run = RunConfig(
         data_path=args.data, steps=args.steps, batch=args.batch,

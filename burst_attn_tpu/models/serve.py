@@ -207,19 +207,46 @@ class ServeEngine:
                 # slot (target live, request lost)
                 break
             slack = self.spec_k + 1 if self.draft is not None else 0
-            logits, self.state = paged_prefill(
-                self.params, jnp.asarray(req.prompt), self.state, self.pool,
-                slot, self.cfg, mesh=self.mesh, cache=self.cache)
-            self.state = provision_capacity(
-                self.state, self.pool, slot, req.max_new_tokens + slack)
-            if self.draft is not None:
-                dp, dc = self.draft
-                _, self.dstate = paged_prefill(dp, jnp.asarray(req.prompt),
-                                               self.dstate, self.dpool, slot,
-                                               dc)
-                self.dstate = provision_capacity(
-                    self.dstate, self.dpool, slot,
-                    req.max_new_tokens + slack)
+            try:
+                logits, self.state = paged_prefill(
+                    self.params, jnp.asarray(req.prompt), self.state,
+                    self.pool, slot, self.cfg, mesh=self.mesh,
+                    cache=self.cache)
+                self.state = provision_capacity(
+                    self.state, self.pool, slot, req.max_new_tokens + slack)
+                if self.draft is not None:
+                    dp, dc = self.draft
+                    _, self.dstate = paged_prefill(
+                        dp, jnp.asarray(req.prompt), self.dstate, self.dpool,
+                        slot, dc)
+                    self.dstate = provision_capacity(
+                        self.dstate, self.dpool, slot,
+                        req.max_new_tokens + slack)
+            except Exception:
+                # paged_prefill / provision_capacity release their own
+                # MID-CALL acquisitions, but pages committed to the table by
+                # an earlier successful call in this block (e.g. the target
+                # prefill before a draft-side raise) belong to a slot that
+                # slots[slot] will never point at — unreachable by
+                # _retire_finished, leaked on every retry.  retire_slot is a
+                # no-op on a state the failure left empty, so retire both —
+                # BEST-EFFORT: a runtime failure INSIDE a donating prefill
+                # jit deletes the very buffers retire_slot would read
+                # (donate_argnums; paged_decode.py's donation contract), and
+                # that secondary raise must not mask the original error.
+                # Host-side failures (pool exhaustion, table width — the
+                # only ones the engine can survive) roll back cleanly.
+                try:
+                    self.state = retire_slot(self.state, self.pool, slot)
+                except Exception:  # noqa: BLE001 — deleted donated buffers
+                    pass
+                if self.draft is not None:
+                    try:
+                        self.dstate = retire_slot(self.dstate, self.dpool,
+                                                  slot)
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
             tok = self._sample(logits[None, :])[0]
             if tok < 0:  # sample_logits NaN-poison sentinel
                 # roll the half-admitted slot back BEFORE raising: the

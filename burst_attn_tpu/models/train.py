@@ -192,6 +192,40 @@ def packed_fields_np(tokens, eos_id: int):
     return seg, positions, labels
 
 
+def probe_model_tri_bwd(cfg: ModelConfig, mesh: Mesh, batch=None, *,
+                        seq_len: int = None, packed: bool = None):
+    """Map a model/mesh onto the flash backward's per-shard kernel shapes
+    and run the memoized tri-backward compile probe
+    (ops/pallas_flash.ensure_tri_bwd).  Called automatically by
+    make_train_step's first step; callable eagerly with explicit
+    seq_len/packed (runner does, so the probe outcome prints before
+    training starts).
+
+    Returns None when this model can never compile the tri backward —
+    jnp backend, windowed attention (banded kernels, not tri), or a
+    non-TPU backend (interpret mode) — True/False for the probe outcome
+    otherwise."""
+    if batch is not None:
+        seq_len = int(batch["tokens"].shape[1])
+        if packed is None:
+            packed = batch.get("segment_ids") is not None
+    if cfg.attn_backend == "jnp" or cfg.window is not None or not cfg.causal:
+        return None  # tri grids are causal-only; window takes the band path
+    if jax.default_backend() != "tpu":
+        return None  # pallas runs interpreted: nothing can fail Mosaic
+    if cfg.attn_strategy == "ulysses":
+        # all-to-all re-gathers the full sequence; heads split instead
+        s_kernel = seq_len
+    else:  # burst ring: each round's kernel sees the per-shard chunk
+        ring = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))
+        s_kernel = seq_len // ring
+    from ..ops.pallas_flash import ensure_tri_bwd
+
+    return ensure_tri_bwd(
+        s_kernel, cfg.d_head, n=cfg.n_heads, n_kv=cfg.n_kv_heads,
+        segments=bool(packed), block_q=cfg.block_q, block_kv=cfg.block_kv)
+
+
 def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     """Returns jitted step((params, opt_state), batch) -> (state, metrics).
 
@@ -259,7 +293,24 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
         gnorm = optax.global_norm(grads)
         return (params, opt_state), {"loss": loss, "grad_norm": gnorm}
 
-    return jax.jit(step, donate_argnums=(0,))
+    jit_step = jax.jit(step, donate_argnums=(0,))
+    probed = []
+
+    def guarded_step(state, batch):
+        # Default tri-backward probe (round-4 verdict #8): before the first
+        # step's (much larger) jit compiles, ACTUALLY compile the
+        # wrapped-diagonal fused backward this config would take, so a
+        # Mosaic rejection on an untested TPU generation degrades to the
+        # rectangular kernel (BURST_NO_TRI_BWD, see ops/pallas_flash.
+        # probe_tri_bwd) instead of crashing the training step.  Memoized
+        # process-wide (ensure_tri_bwd) — one compile per config, shared
+        # with every other entry point.
+        if not probed:
+            probed.append(True)
+            probe_model_tri_bwd(cfg, mesh, batch)
+        return jit_step(state, batch)
+
+    return guarded_step
 
 
 def train_step(state, batch, cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):

@@ -83,6 +83,17 @@ def _tri_disabled():
     return os.environ.get("BURST_NO_TRI", "").strip().lower() not in ("", "0", "false")
 
 
+def _tri_bwd_disabled():
+    """Backward-scoped triangular disable: BURST_NO_TRI turns off every
+    wrapped-diagonal grid; BURST_NO_TRI_BWD turns off only flash_bwd's.
+    probe_tri_bwd sets the latter on a backward compile failure — the
+    forward tri/band grids have an independent (much smaller) VMEM
+    footprint, and demoting them for a bwd-only Mosaic rejection would be
+    an unrelated performance regression (round-4 advisor finding)."""
+    return _tri_disabled() or os.environ.get(
+        "BURST_NO_TRI_BWD", "").strip().lower() not in ("", "0", "false")
+
+
 def _fwd_loop_default():
     """BURST_FWD_LOOP=1 makes flash_fwd's fori_loop sub-block sweep
     (`loop_sweep`) the default.  Exists so the cliff-break experiment
@@ -1698,8 +1709,8 @@ def tri_bwd_supported(s_q, s_kv, n, n_kv, d, *, block_q, block_kv,
     The dq budget is derived from VMEM_LIMIT minus an estimate of the other
     residents, at half utilization — Mosaic's own overheads aren't modeled,
     and a config that passes this gate but fails to compile has no automatic
-    fallback inside burst_attn (only the BURST_NO_TRI env var), so the gate
-    errs conservative."""
+    fallback inside burst_attn (only the BURST_NO_TRI{,_BWD} env vars), so
+    the gate errs conservative."""
     bq = _pick_block(s_q, block_q)
     bkv = _pick_block(s_kv, block_kv)
     nkb = s_kv // bkv
@@ -1726,19 +1737,22 @@ def probe_tri_bwd(s, d, *, n=1, n_kv=None, segments=False, block_q=None,
     that decides compilability is per-(batch, head).  `segments=True`
     compiles the packed-sequence variant (its segment-id input blocks and
     masking add VMEM residents — a segment-free pass does not prove the
-    packed kernel compiles).  On compile failure, set BURST_NO_TRI=1 for
-    this process so every later triangular=True call takes the
-    rectangular fused kernel instead of crashing the caller's (much
-    larger) jit.
+    packed kernel compiles).  On compile failure, set BURST_NO_TRI_BWD=1
+    for this process so every later triangular=True BACKWARD call takes
+    the rectangular fused kernel instead of crashing the caller's (much
+    larger) jit; the forward tri/band grids (independent, smaller VMEM
+    footprint) stay enabled.
 
     Why this exists: tri_bwd_supported is a hand model of Mosaic's VMEM
     residency, explicitly conservative but unverified on generations
     without a measured BlockTable row — a config that passes the gate but
     fails Mosaic has no automatic fallback inside a traced program (a
     pallas lowering error surfaces when the ENCLOSING jit compiles, where
-    flash_bwd can no longer catch it).  Opt-in (costs one real kernel
-    compile, minutes on a cold remote-compile cache): call it once at
-    startup — models/runner.py does under --probe-tri-bwd."""
+    flash_bwd can no longer catch it).  Costs one real kernel compile
+    (minutes on a cold remote-compile cache) — production entry points run
+    it by default through the memoized ensure_tri_bwd wrapper
+    (make_train_step's first step; models/runner.py at startup, opt out
+    with --no-probe-tri-bwd)."""
     from .masks import round_spec
 
     n_kv = n if n_kv is None else n_kv
@@ -1762,12 +1776,47 @@ def probe_tri_bwd(s, d, *, n=1, n_kv=None, segments=False, block_q=None,
     except Exception as e:  # noqa: BLE001 — any compile failure means rect
         logger.warning(
             "tri bwd at s=%d blocks %dx%d%s passed the VMEM gate but FAILED "
-            "to compile (%s: %.120s); setting BURST_NO_TRI=1 — this process "
-            "falls back to the rectangular fused backward", s, bq, bkv,
+            "to compile (%s: %.120s); setting BURST_NO_TRI_BWD=1 — this "
+            "process falls back to the rectangular fused backward (forward "
+            "tri/band grids stay on)", s, bq, bkv,
             " (packed)" if segments else "",
             type(e).__name__, str(e))
-        os.environ["BURST_NO_TRI"] = "1"
+        os.environ["BURST_NO_TRI_BWD"] = "1"
         return False
+
+
+# ensure_tri_bwd memo: one real probe compile per distinct config per
+# process.  Keyed on device kind too — a process can see CPU (interpret)
+# first and TPU later (tests monkeypatching _interpret_default rely on
+# the non-interpret key being distinct).
+_TRI_BWD_PROBED: dict = {}
+
+
+def ensure_tri_bwd(s, d, *, n=1, n_kv=None, segments=False, block_q=None,
+                   block_kv=None, block_kv_compute=None,
+                   loop_sweep=False) -> bool:
+    """Memoized probe_tri_bwd — THE default startup gate for production
+    entry points (trainer first step, runner, benchmarks): call before
+    the enclosing jit compiles so a config that passes tri_bwd_supported
+    but fails Mosaic degrades to the rectangular fused backward
+    automatically instead of surfacing a raw lowering error from inside
+    the caller's (much larger) compile.  Costs at most ONE real kernel
+    compile per distinct (device kind, shape, blocks, variant) per
+    process; returns instantly once the backward tri path is already
+    disabled (a previous probe failed, or BURST_NO_TRI{,_BWD} is set)."""
+    if _tri_bwd_disabled():
+        return False
+    key = (
+        jax.devices()[0].device_kind if jax.devices() else "cpu",
+        _interpret_default(), s, d, n, n_kv, segments, block_q, block_kv,
+        block_kv_compute, loop_sweep,
+    )
+    if key not in _TRI_BWD_PROBED:
+        _TRI_BWD_PROBED[key] = probe_tri_bwd(
+            s, d, n=n, n_kv=n_kv, segments=segments, block_q=block_q,
+            block_kv=block_kv, block_kv_compute=block_kv_compute,
+            loop_sweep=loop_sweep)
+    return _TRI_BWD_PROBED[key]
 
 
 def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
@@ -1830,7 +1879,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
         fused = (not interpret
                  and bwd_band_nbq(bq, bkv, s_q // bq, window) * group >= 4)
     tri = (
-        bool(triangular) and not explicit_split and not _tri_disabled()
+        bool(triangular) and not explicit_split and not _tri_bwd_disabled()
         and tri_bwd_supported(s_q, s_kv, n, n_kv, d, block_q=bq, block_kv=bkv,
                               block_kv_compute=block_kv_compute)
     )

@@ -465,13 +465,16 @@ def test_tri_bwd_loop_sweep_matches_unrolled(qkv, block_q, block_kv, bkc,
 
 def test_probe_tri_bwd(monkeypatch):
     """probe_tri_bwd: gate-fail returns False without compiling; interpret
-    mode returns True; a COMPILE failure (mocked) flips BURST_NO_TRI so
-    later triangular calls fall back to the rectangular kernel instead of
-    crashing the caller's jit."""
+    mode returns True; a COMPILE failure (mocked) flips BURST_NO_TRI_BWD so
+    later triangular BACKWARD calls fall back to the rectangular kernel
+    instead of crashing the caller's jit — while the forward tri/band
+    grids stay enabled (round-4 advisor: a bwd-only Mosaic rejection must
+    not demote the validated forward grids)."""
     monkeypatch.delenv("BURST_NO_TRI", raising=False)
+    monkeypatch.delenv("BURST_NO_TRI_BWD", raising=False)
     # gate-fail: odd kv-block count (nkb = 3) never reaches the compile
     assert pallas_flash.probe_tri_bwd(96, 16, block_q=32, block_kv=32) is False
-    assert "BURST_NO_TRI" not in os.environ
+    assert "BURST_NO_TRI_BWD" not in os.environ
 
     # interpret mode (CPU): gate passes, probe trusts interpret
     assert pallas_flash.probe_tri_bwd(64, 16, block_q=32, block_kv=32) is True
@@ -485,8 +488,12 @@ def test_probe_tri_bwd(monkeypatch):
 
     monkeypatch.setattr(jax, "jit", lambda fn: _Boom())
     assert pallas_flash.probe_tri_bwd(64, 16, block_q=32, block_kv=32) is False
-    assert os.environ.get("BURST_NO_TRI") == "1"
-    monkeypatch.delenv("BURST_NO_TRI", raising=False)
+    assert os.environ.get("BURST_NO_TRI_BWD") == "1"
+    assert "BURST_NO_TRI" not in os.environ
+    # bwd-scoped: the backward dispatch sees the disable, the forward does not
+    assert pallas_flash._tri_bwd_disabled() is True
+    assert pallas_flash._tri_disabled() is False
+    monkeypatch.delenv("BURST_NO_TRI_BWD", raising=False)
 
 
 def test_probe_tri_bwd_gqa_declines_without_compile(monkeypatch):
@@ -686,3 +693,27 @@ def test_bwd_random_config_property_sweep():
                 err_msg=f"{name} @ {msg}")
     assert seen["wnd_seg"] >= 1 and seen["tri_eff"] >= 1 \
         and seen["split"] >= 1 and seen["ragged"] >= 1, seen
+
+
+def test_ensure_tri_bwd_memoizes_and_short_circuits(monkeypatch):
+    """ensure_tri_bwd runs the real probe once per distinct config
+    (process-wide memo shared by every entry point) and returns False
+    instantly — no probe — once the backward tri path is disabled."""
+    monkeypatch.setattr(pallas_flash, "_TRI_BWD_PROBED", {})
+    monkeypatch.delenv("BURST_NO_TRI", raising=False)
+    monkeypatch.delenv("BURST_NO_TRI_BWD", raising=False)
+
+    calls = []
+    monkeypatch.setattr(pallas_flash, "probe_tri_bwd",
+                        lambda s, d, **kw: calls.append((s, d)) or True)
+    assert pallas_flash.ensure_tri_bwd(64, 16, block_q=32, block_kv=32)
+    assert pallas_flash.ensure_tri_bwd(64, 16, block_q=32, block_kv=32)
+    assert calls == [(64, 16)]  # second call served from the memo
+    pallas_flash.ensure_tri_bwd(128, 16, block_q=32, block_kv=32)
+    assert calls == [(64, 16), (128, 16)]  # distinct config -> new probe
+
+    # once disabled (a previous probe failed, or operator override),
+    # every config answers False without probing
+    monkeypatch.setenv("BURST_NO_TRI_BWD", "1")
+    assert pallas_flash.ensure_tri_bwd(256, 16, block_q=32, block_kv=32) is False
+    assert len(calls) == 2

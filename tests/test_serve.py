@@ -417,3 +417,74 @@ def test_engine_acceptance_rate_accounting(model):
     eng.run()
     assert eng.spec_rounds > 0 and eng.spec_proposed > 0
     assert eng.acceptance_rate == 1.0  # self-draft: greedy always matches
+
+
+def test_admission_failure_rolls_back_target_pages(model, monkeypatch):
+    """A raise AFTER the target-side prefill committed pages to the table
+    (here: provision_capacity) must retire the half-admitted slot — pages
+    back in the pool, request still at the queue head — and the retry
+    must then produce the exact solo-generate tokens (round-4 advisor:
+    the old path leaked the target pages on every failed attempt)."""
+    import burst_attn_tpu.models.serve as serve_mod
+
+    cfg, params = model
+    (p0,) = _prompts(cfg, [9], seed=91)
+    eng = ServeEngine(params, cfg, slots=1, n_pages=6, page=128,
+                      max_pages_per_seq=2)
+    avail0 = eng.pool.available
+    rid = eng.submit(p0, 3)
+
+    real = serve_mod.provision_capacity
+    monkeypatch.setattr(
+        serve_mod, "provision_capacity",
+        lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("injected provision failure")))
+    with pytest.raises(RuntimeError, match="injected provision"):
+        eng.step()
+    assert eng.pool.available == avail0  # no leak
+    assert eng.pending == 1 and eng.live == 0  # request back at the head
+    assert all(s is None for s in eng.slots)
+
+    monkeypatch.setattr(serve_mod, "provision_capacity", real)
+    got = eng.run()
+    want = np.asarray(generate(params, p0[None], cfg, steps=3,
+                               max_seq=256))[0]
+    np.testing.assert_array_equal(np.asarray(got[rid]), want)
+    assert eng.pool.available == avail0
+
+
+def test_admission_draft_failure_rolls_back_both_pools(model, monkeypatch):
+    """Speculative admission where the TARGET prefill succeeds and the
+    DRAFT-side prefill raises: both pools must return to their
+    pre-admission levels (the target's committed pages were the leak) and
+    the retry completes with self-draft parity."""
+    import burst_attn_tpu.models.serve as serve_mod
+
+    cfg, params = model
+    (p0,) = _prompts(cfg, [9], seed=93)
+    eng = ServeEngine(params, cfg, slots=1, n_pages=8, page=128,
+                      max_pages_per_seq=3,
+                      draft_params=params, draft_cfg=cfg, spec_k=3)
+    avail0, davail0 = eng.pool.available, eng.dpool.available
+    rid = eng.submit(p0, 5)
+
+    real = serve_mod.paged_prefill
+
+    def draft_boom(params_, tokens, state, pool, *a, **k):
+        if pool is eng.dpool:
+            raise RuntimeError("injected draft prefill failure")
+        return real(params_, tokens, state, pool, *a, **k)
+
+    monkeypatch.setattr(serve_mod, "paged_prefill", draft_boom)
+    with pytest.raises(RuntimeError, match="injected draft"):
+        eng.step()
+    assert eng.pool.available == avail0    # target pages rolled back
+    assert eng.dpool.available == davail0  # draft pool untouched
+    assert eng.pending == 1 and eng.live == 0
+
+    monkeypatch.setattr(serve_mod, "paged_prefill", real)
+    got = eng.run()
+    want = np.asarray(generate(params, p0[None], cfg, steps=5,
+                               max_seq=256))[0]
+    np.testing.assert_array_equal(np.asarray(got[rid]), want)
+    assert eng.pool.available == avail0 and eng.dpool.available == davail0
