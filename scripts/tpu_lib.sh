@@ -4,6 +4,13 @@
 # with a timeout (an in-process hung tunnel hangs `import jax`
 # unrecoverably), and retry every stage after re-probing.
 
+# Persistent compilation cache shared by every stage: a retried stage (the
+# tunnel can die mid-attempt, burning the timeout) must not re-pay remote
+# compiles its earlier attempt already completed.  Harmless if the PJRT
+# plugin doesn't support executable serialization.
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/root/repo/results/jax_cache}
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-5}
+
 probe() {
   timeout 180 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null
 }
