@@ -21,6 +21,61 @@ import time
 HEADLINE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "results", "headline.json")
 
+# Incremental phase log (VERDICT.md round-6 "job one"): every phase
+# transition — probe attempts, compile start/end, each warmup call, each
+# rep — is appended and fsynced IMMEDIATELY, and a daemon heartbeat ticks
+# every ~15 s, so a bench stage killed by the driver's timeout still
+# leaves enough evidence to tell a hung tunnel from a slow compile from a
+# mid-rep death.
+EVENTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "bench_events.jsonl")
+
+
+class _EventLog:
+    """Append-only JSONL phase log; every write is flushed AND fsynced so
+    a SIGKILL loses at most the event in flight.  All failures are
+    swallowed — diagnostics must never kill the benchmark."""
+
+    def __init__(self, path=EVENTS_PATH):
+        self._t0 = time.time()
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._f = open(path, "a", encoding="utf-8")
+        except OSError:
+            self._f = None
+
+    def event(self, phase: str, **fields) -> None:
+        if self._f is None:
+            return
+        rec = {
+            "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "t_rel_s": round(time.time() - self._t0, 3),
+            "phase": phase,
+        }
+        rec.update(fields)
+        try:
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except (OSError, ValueError):
+            self._f = None
+
+    def start_heartbeat(self, interval_s: float = 15.0) -> None:
+        import threading
+
+        def beat():
+            n = 0
+            while True:
+                time.sleep(interval_s)
+                n += 1
+                self.event("heartbeat", n=n)
+
+        threading.Thread(target=beat, daemon=True,
+                         name="bench-heartbeat").start()
+
+
+EVENTS = _EventLog()
+
 
 def _git_commit() -> str:
     try:
@@ -62,19 +117,22 @@ def _wait_for_tpu(attempts=6, probe_timeout=120, sleep_s=45) -> bool:
     sleep, so CPU-only machines start the fallback immediately."""
     fast_fails = 0
     for i in range(attempts):
+        EVENTS.event("tpu_probe_start", attempt=i + 1, attempts=attempts)
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; assert jax.default_backend() == 'tpu'"],
                 timeout=probe_timeout, capture_output=True,
             )
+            EVENTS.event("tpu_probe_end", attempt=i + 1, rc=r.returncode)
             if r.returncode == 0:
                 return True
             fast_fails += 1
             if fast_fails >= 2:
                 return False
         except subprocess.TimeoutExpired:
-            pass
+            EVENTS.event("tpu_probe_end", attempt=i + 1, rc=None,
+                         timed_out=True)
         if i < attempts - 1:
             print(f"bench: TPU probe {i + 1}/{attempts} failed; retrying",
                   file=sys.stderr, flush=True)
@@ -82,7 +140,10 @@ def _wait_for_tpu(attempts=6, probe_timeout=120, sleep_s=45) -> bool:
     return False
 
 
+EVENTS.start_heartbeat()
+EVENTS.event("start", argv=sys.argv)
 _TPU_UP = _wait_for_tpu()
+EVENTS.event("tpu_decision", tpu_up=_TPU_UP)
 
 import jax
 
@@ -139,8 +200,9 @@ def main():
                     + dv[0, 0, 0, 0].astype(jnp.float32))
 
         fallback = False
+        EVENTS.event("bench_start", seq=seq, heads=n, dim=d, dtype="bfloat16")
         try:
-            t = _time(fwdbwd, q, k, v, do)
+            t = _time(fwdbwd, q, k, v, do, on_event=EVENTS.event)
         except Exception as e:  # noqa: BLE001
             # escape hatch: if the triangular causal grids fail to compile or
             # run on this chip/toolchain, remeasure on the rectangular grids
@@ -148,10 +210,12 @@ def main():
             print(f"bench: triangular path failed ({type(e).__name__}: "
                   f"{str(e)[:120]}); retrying with BURST_NO_TRI=1",
                   file=sys.stderr, flush=True)
+            EVENTS.event("tri_fallback", error=f"{type(e).__name__}: "
+                                               f"{str(e)[:200]}")
             os.environ["BURST_NO_TRI"] = "1"
             fallback = True
             fwdbwd = jax.jit(fwdbwd.__wrapped__)
-            t = _time(fwdbwd, q, k, v, do)
+            t = _time(fwdbwd, q, k, v, do, on_event=EVENTS.event)
         tflops = 3.5 * flops_fwd(b, seq, n, d, causal) / t / 1e12
         baseline = BASELINE_FWDBWD[seq]
         rec = {
@@ -163,6 +227,7 @@ def main():
         if fallback:
             rec["tri_fallback"] = True
         _save_headline(rec)
+        EVENTS.event("done", **rec)
         print(json.dumps(rec))
     else:
         cached = _load_headline()
@@ -178,6 +243,7 @@ def main():
             rec["cached_age_hours"] = round(age_h, 2)
             rec["cached_commit"] = cached.get("commit", "unknown")
             rec["cached_timestamp_utc"] = cached.get("timestamp_utc", "")
+            EVENTS.event("done", cached=True)
             print(json.dumps(rec))
             return
         # CPU fallback: correctness-scale run so the driver always gets a line
@@ -188,9 +254,10 @@ def main():
         key = jax.random.PRNGKey(0)
         q, k, v = (jax.random.normal(s, (b, 8, seq, 64), dtype)
                    for s in jax.random.split(key, 3))
+        EVENTS.event("bench_start", seq=seq, cpu_fallback=True)
         t = _time(
             lambda q, k, v: jnp.sum(single_device_attention(q, k, v, causal=True)),
-            q, k, v,
+            q, k, v, on_event=EVENTS.event,
         )
         tflops = flops_fwd(b, seq, 8, 64, True) / t / 1e12
         print(json.dumps({

@@ -36,22 +36,33 @@ def efficiency(flop, t):
     return flop / t / 1e12
 
 
-def bench_fn(fn, *args, warmup=3, iters=10, reps=3):
+def bench_fn(fn, *args, warmup=3, iters=10, reps=3, on_event=None):
     """fn must return a SCALAR.  All `iters` dispatches are queued
     asynchronously and synchronized by ONE host fetch of their sum — a
     per-iteration fetch would add the host<->device round trip (tens of ms
-    through the axon-relay TPU tunnel) to every measurement."""
-    for _ in range(warmup):
+    through the axon-relay TPU tunnel) to every measurement.
+
+    `on_event(phase, **fields)`: optional progress hook (bench.py's
+    incremental JSONL log) fired at compile start/end, after each warmup
+    call, and after each rep — a run killed by a stage timeout then still
+    leaves per-phase timestamps behind."""
+    ev = on_event if on_event is not None else (lambda phase, **kw: None)
+    ev("compile_start")
+    float(fn(*args))  # first call compiles (or replays the compile cache)
+    ev("compile_end")
+    for i in range(1, warmup):
         float(fn(*args))
+        ev("warmup", i=i)
     ts = []
-    for _ in range(reps):
+    for r in range(reps):
         t0 = time.perf_counter()
         acc = None
         for _ in range(iters):
-            r = fn(*args)
-            acc = r if acc is None else acc + r
+            res = fn(*args)
+            acc = res if acc is None else acc + res
         float(acc)
         ts.append((time.perf_counter() - t0) / iters)
+        ev("rep", i=r, s_per_iter=round(ts[-1], 6))
     return float(np.min(ts))
 
 

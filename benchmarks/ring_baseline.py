@@ -18,12 +18,13 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from burst_attn_tpu.parallel.ring import ppermute_next
+from burst_attn_tpu.utils.compat import axis_size, shard_map
 
 
 def _ring_scores(q, k, axis_name):
     """s[global] = q_local @ k_global^T via W ppermute rounds.
     q, k: [B, N, S_local, D] -> scores [B, N, S_local, S_global]."""
-    w = lax.axis_size(axis_name)
+    w = axis_size(axis_name)
     my = lax.axis_index(axis_name)
 
     def body(carry, r):
@@ -46,7 +47,7 @@ def _ring_scores(q, k, axis_name):
 
 def _ring_av(p, v, axis_name):
     """o = p @ v_global via W ppermute rounds.  p [B,N,S_l,S_g], v [B,N,S_l,D]."""
-    w = lax.axis_size(axis_name)
+    w = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     s_l = v.shape[2]
 
@@ -70,7 +71,7 @@ def ring_attention_shard(q, k, v, axis_name: str, scale=None, causal=False):
     Materializes the [S_l, S_global] score matrix."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    w = lax.axis_size(axis_name)
+    w = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     s_l = q.shape[2]
     s = _ring_scores(q, k, axis_name) * scale
@@ -85,7 +86,7 @@ def ring_attention_shard(q, k, v, axis_name: str, scale=None, causal=False):
 def ring_attention(q, k, v, *, mesh, axis_name="sp", scale=None, causal=False):
     """Global-array entry point: q,k,v [B,N,S,D] sharded over axis_name on S."""
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_attention_shard, axis_name=axis_name, scale=scale, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -1,0 +1,19 @@
+"""burstlint — static verification of the ring/sharding/numerics contracts.
+
+Two families of checks (docs/analysis.md):
+
+  * jaxpr-level verifiers (ringcheck, numerics): abstractly trace the
+    public attention entry points under a matrix of simulated meshes and
+    assert the structural ring invariants (single-cycle rotations, hop
+    counts against the host-side schedule oracle, dq return-home, double
+    ring prefetch distance, windowed truncation) plus fp32 accumulation
+    in the kernels.  These catch topology-dependent bugs that an 8-device
+    CPU-mesh test matrix can pass while real scale breaks.
+  * AST-level lint rules (astlint): mechanical hygiene rules over the
+    package source (silent exception swallowing, hard mesh.shape[axis]
+    indexing, host transfers / time calls / Python branches under jit).
+
+CLI: python -m burst_attn_tpu.analysis [--json]
+"""
+
+from .core import Finding, Rule, RULES, rule, run_analysis  # noqa: F401

@@ -1,0 +1,287 @@
+"""AST-level lint rules over the package source (burstlint family 2).
+
+Rules (each individually suppressible with `# burstlint: disable=RULE` on
+the offending line):
+
+  silent-except        except handler whose body is only `pass`: swallowed
+                       errors must at least log (ADVICE.md round-5: a bare
+                       pass hid a page-leaking rollback bug class).
+  mesh-shape-index     mesh.shape[axis] hard indexing: require
+                       mesh.shape.get(axis, 1) — a mesh without the axis
+                       means "not parallelized over it", and a KeyError in
+                       a best-effort guard crashes the very step the guard
+                       protects (ADVICE.md, models/train.py probe).
+  host-transfer-in-jit .item() / jax.device_get / float()/int() on traced
+                       values inside a jit-traced function: synchronous
+                       device round-trip per call, or a tracer leak.
+  time-in-jit          time.* called inside a jit-traced function: measures
+                       TRACE time once, then is constant-folded — the
+                       timestamp never updates at run time.
+  traced-bool-branch   Python `if`/`while` on a jnp/lax expression inside a
+                       jit-traced function: raises TracerBoolConversionError
+                       at trace time (or silently specializes on trace-time
+                       values under concrete transforms).
+
+"jit-traced" is a static under-approximation: functions decorated with
+jax.jit/pmap (incl. via partial), functions (or lambdas / partial targets)
+passed to jit/pmap/shard_map/lax.scan/cond/while_loop/fori_loop/grad, and —
+transitively — module-local functions they call.  Fewer false positives
+beats exhaustiveness here; the dynamic tests cover the rest.
+"""
+
+import ast
+import os
+from typing import Iterable, List, Set
+
+from .core import Finding, filter_suppressed, rule
+
+# call targets whose function-valued arguments run under trace
+_JIT_WRAPPERS = {
+    "jit", "pmap", "shard_map", "scan", "cond", "while_loop", "fori_loop",
+    "switch", "checkpoint", "remat", "grad", "value_and_grad", "vjp",
+    "linearize", "custom_vjp", "custom_jvp",
+}
+_JIT_DECORATORS = {"jit", "pmap", "custom_vjp", "custom_jvp", "checkpoint",
+                   "remat"}
+
+
+def default_paths(root: str) -> List[str]:
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def _tail_name(node) -> str:
+    """Last attribute segment of a Name/Attribute chain ('jax.jit' -> 'jit')."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _root_name(node) -> str:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _func_args_of_wrapper_call(call: ast.Call):
+    """Function-ish arguments of a jit-family call: names, lambdas, and
+    partial(...) first arguments."""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(arg, (ast.Name, ast.Lambda)):
+            yield arg
+        elif (isinstance(arg, ast.Call) and _tail_name(arg.func) == "partial"
+              and arg.args):
+            yield arg.args[0]
+
+
+class _JitContextCollector(ast.NodeVisitor):
+    """Find every function def / lambda that runs under a jax trace."""
+
+    def __init__(self):
+        self.defs = {}  # name -> [FunctionDef]
+        self.marked: Set[ast.AST] = set()
+        self._wrapper_calls: List[ast.Call] = []
+
+    def visit_FunctionDef(self, node):
+        self.defs.setdefault(node.name, []).append(node)
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            names = {_tail_name(target)}
+            if isinstance(dec, ast.Call):  # partial(jax.jit, ...) / jax.jit(...)
+                names |= {_tail_name(a) for a in dec.args}
+            if names & _JIT_DECORATORS:
+                self.marked.add(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        if _tail_name(node.func) in _JIT_WRAPPERS:
+            self._wrapper_calls.append(node)
+        self.generic_visit(node)
+
+    def resolve(self, tree) -> Set[ast.AST]:
+        self.visit(tree)
+        for call in self._wrapper_calls:
+            for fa in _func_args_of_wrapper_call(call):
+                if isinstance(fa, ast.Lambda):
+                    self.marked.add(fa)
+                elif isinstance(fa, ast.Name):
+                    for d in self.defs.get(fa.id, ()):
+                        self.marked.add(d)
+        # transitive closure over module-local calls from marked bodies
+        changed = True
+        while changed:
+            changed = False
+            for node in list(self.marked):
+                for sub in ast.walk(node):
+                    tgt = None
+                    if isinstance(sub, ast.Call):
+                        tgt = _tail_name(sub.func)
+                    elif isinstance(sub, (ast.FunctionDef, ast.Lambda)):
+                        self_nested = sub
+                        if self_nested not in self.marked and sub is not node:
+                            self.marked.add(sub)
+                            changed = True
+                        continue
+                    for d in self.defs.get(tgt, ()):
+                        if d not in self.marked:
+                            self.marked.add(d)
+                            changed = True
+        return self.marked
+
+
+def _contains_traced_expr(node) -> bool:
+    """Heuristic: expression syntactically involves jnp/lax computation."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _root_name(sub.func) in ("jnp", "lax"):
+            if _tail_name(sub.func) in ("axis_size",):  # static under shard_map
+                continue
+            return True
+    return False
+
+
+def _is_broad_handler(node: ast.ExceptHandler) -> bool:
+    """Bare `except:` or except Exception/BaseException (incl. in tuples).
+    Narrow typed handlers (ValueError, StopIteration, ...) with pass-only
+    bodies are idiomatic flow control and stay exempt."""
+    if node.type is None:
+        return True
+    types = (node.type.elts if isinstance(node.type, ast.Tuple)
+             else [node.type])
+    return any(_tail_name(t) in ("Exception", "BaseException")
+               for t in types)
+
+
+@rule("silent-except", "ast",
+      "except handler whose body is only `pass` — swallowed errors must log")
+def _check_silent_except(tree, src_lines, path):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_handler(node):
+            continue
+        body = [s for s in node.body
+                if not (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))]
+        if all(isinstance(s, ast.Pass) for s in body):
+            yield Finding(
+                rule="silent-except", file=path, line=node.lineno,
+                message="exception swallowed with bare `pass` — log it "
+                        "(logger.warning) or suppress with a justification",
+            )
+
+
+@rule("mesh-shape-index", "ast",
+      "mesh.shape[axis] hard indexing — use mesh.shape.get(axis, 1)")
+def _check_mesh_shape_index(tree, src_lines, path):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Subscript):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Attribute) and v.attr == "shape"):
+            continue
+        base = v.value
+        base_name = (base.id if isinstance(base, ast.Name)
+                     else base.attr if isinstance(base, ast.Attribute) else "")
+        if "mesh" in base_name.lower():
+            yield Finding(
+                rule="mesh-shape-index", file=path, line=node.lineno,
+                message=f"{base_name}.shape[...] hard indexing — a missing "
+                        "axis should mean size 1: use .shape.get(axis, 1)",
+            )
+
+
+def _iter_jit_bodies(tree):
+    marked = _JitContextCollector().resolve(tree)
+    seen = set()
+    for ctx in marked:
+        for sub in ast.walk(ctx):
+            if id(sub) in seen:
+                continue
+            seen.add(id(sub))
+            yield sub
+
+
+@rule("host-transfer-in-jit", "ast",
+      ".item()/device_get/float()/int() on traced values under jit")
+def _check_host_transfer(tree, src_lines, path):
+    for sub in _iter_jit_bodies(tree):
+        if not isinstance(sub, ast.Call):
+            continue
+        tail = _tail_name(sub.func)
+        if tail == "item" and isinstance(sub.func, ast.Attribute):
+            what = ".item()"
+        elif tail == "device_get":
+            what = "jax.device_get"
+        elif (isinstance(sub.func, ast.Name) and sub.func.id in ("float", "int")
+              and sub.args
+              and not isinstance(sub.args[0], (ast.Constant, ast.Name))):
+            # float("-inf") literals and float(scale)-style casts of static
+            # scalar args are host constants; flag only computed expressions
+            what = f"{sub.func.id}() on a computed value"
+        else:
+            continue
+        yield Finding(
+            rule="host-transfer-in-jit", file=path, line=sub.lineno,
+            message=f"{what} inside a jit-traced function forces a "
+                    "host sync (or leaks a tracer) — keep values on device",
+        )
+
+
+@rule("time-in-jit", "ast",
+      "time.* call under jit — constant-folded at trace time")
+def _check_time_in_jit(tree, src_lines, path):
+    for sub in _iter_jit_bodies(tree):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and _root_name(sub.func) == "time"):
+            yield Finding(
+                rule="time-in-jit", file=path, line=sub.lineno,
+                message=f"time.{sub.func.attr}() inside a jit-traced function "
+                        "is evaluated once at trace time, never at run time",
+            )
+
+
+@rule("traced-bool-branch", "ast",
+      "Python if/while on a traced (jnp/lax) expression under jit")
+def _check_traced_bool(tree, src_lines, path):
+    for sub in _iter_jit_bodies(tree):
+        if isinstance(sub, (ast.If, ast.While)) and _contains_traced_expr(sub.test):
+            yield Finding(
+                rule="traced-bool-branch", file=path, line=sub.lineno,
+                message="Python branch on a traced expression — trace-time "
+                        "TracerBoolConversionError; use lax.cond/jnp.where",
+            )
+
+
+_AST_RULES = (_check_silent_except, _check_mesh_shape_index,
+              _check_host_transfer, _check_time_in_jit, _check_traced_bool)
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", file=path, line=e.lineno or 0,
+                        message=f"syntax error: {e.msg}")]
+    src_lines = src.split("\n")
+    findings: List[Finding] = []
+    for checker in _AST_RULES:
+        findings += list(checker(tree, src_lines, path))
+    return filter_suppressed(findings, src_lines)
+
+
+def lint_paths(paths: Iterable[str]) -> List[Finding]:
+    out: List[Finding] = []
+    for p in paths:
+        out += lint_file(p)
+    return out

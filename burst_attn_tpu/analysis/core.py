@@ -1,0 +1,110 @@
+"""Rule registry, findings, and suppression handling for burstlint.
+
+A rule is a named check registered with @rule; running the analysis invokes
+every registered (non-disabled) checker and collects Findings.  Findings
+carry file:line so they are clickable in editors and greppable in CI logs.
+
+Suppression: a source line carrying `# burstlint: disable=RULE[,RULE2]`
+suppresses those rules' findings for that line (AST rules only — jaxpr
+findings are anchored to entry-point definitions, disable those via
+--disable on the CLI or the `disable` argument of run_analysis).
+"""
+
+import json
+import re
+from dataclasses import dataclass, field, asdict
+from typing import Callable, Dict, List, Optional
+
+_SUPPRESS_RE = re.compile(r"#\s*burstlint:\s*disable=([\w,\-]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    message: str
+    file: str = "<trace>"
+    line: int = 0
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Rule:
+    name: str
+    kind: str  # "ast" | "jaxpr"
+    doc: str
+    checker: Optional[Callable] = None  # astlint: per-tree; jaxpr: global
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, kind: str, doc: str):
+    """Register a rule.  AST checkers get (tree, src_lines, path, ctx) and
+    yield Findings; jaxpr checkers are invoked by their family driver."""
+
+    def deco(fn):
+        RULES[name] = Rule(name=name, kind=kind, doc=doc, checker=fn)
+        return fn
+
+    return deco
+
+
+def suppressed_rules(src_line: str) -> List[str]:
+    m = _SUPPRESS_RE.search(src_line)
+    if not m:
+        return []
+    return [r.strip() for r in m.group(1).split(",") if r.strip()]
+
+
+def filter_suppressed(findings: List[Finding], src_lines: List[str]):
+    """Drop findings whose anchoring source line disables their rule."""
+    out = []
+    for f in findings:
+        if 1 <= f.line <= len(src_lines):
+            if f.rule in suppressed_rules(src_lines[f.line - 1]):
+                continue
+        out.append(f)
+    return out
+
+
+def run_analysis(root=None, *, disable=(), ast_only=False,
+                 paths=None) -> List[Finding]:
+    """Run every registered rule; returns the surviving findings.
+
+    root: package directory to lint (default: this package).  ast_only
+    skips the jaxpr tracing family (used by fast editor hooks); `paths`
+    overrides the AST lint file set."""
+    import os
+
+    from . import astlint
+
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings: List[Finding] = []
+    findings += astlint.lint_paths(paths or astlint.default_paths(root))
+    if not ast_only:
+        from . import ringcheck, numerics
+
+        findings += ringcheck.check_all()
+        findings += numerics.check_all()
+    return [f for f in findings if f.rule not in set(disable)]
+
+
+def render(findings: List[Finding], as_json: bool) -> str:
+    if as_json:
+        return json.dumps(
+            {
+                "rules_registered": sorted(RULES),
+                "n_findings": len(findings),
+                "findings": [asdict(f) for f in findings],
+            },
+            indent=1,
+        )
+    if not findings:
+        return (f"burstlint: clean "
+                f"({len(RULES)} rules: {', '.join(sorted(RULES))})")
+    lines = [f.format() for f in findings]
+    lines.append(f"burstlint: {len(findings)} finding(s)")
+    return "\n".join(lines)

@@ -1,0 +1,113 @@
+"""Generic jaxpr walking for the burstlint verifiers.
+
+Extracts the ordered stream of collective events (ppermute / all_to_all /
+psum) from a traced program, unrolling scan bodies by their static trip
+count and flattening nested call primitives (pjit, custom_vjp, shard_map,
+pallas_call, cond branches).  Pure host-side: operates on the jaxpr data
+structure only, never executes the program.
+"""
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+
+@dataclass
+class CollEvent:
+    prim: str                 # "ppermute" | "all_to_all" | "psum"
+    axis: str
+    hops: Optional[int]       # uniform rotation offset; None if not a rotation
+    dtype: str
+    rank: int
+    perm: Optional[Tuple] = None
+    in_cond: bool = False     # event sits under a lax.cond branch
+    in_while: bool = False    # event sits under a while loop (trip unknown)
+
+
+def _axis_str(axis_name) -> str:
+    if isinstance(axis_name, (tuple, list)):
+        return str(axis_name[0]) if len(axis_name) == 1 else ",".join(
+            str(a) for a in axis_name)
+    return str(axis_name)
+
+
+def rotation_offset(perm) -> Optional[int]:
+    """Uniform rotation offset of a ppermute perm, or None when the perm is
+    not a bijective constant-offset rotation over 0..n-1."""
+    n = len(perm)
+    srcs = sorted(p[0] for p in perm)
+    dsts = sorted(p[1] for p in perm)
+    if srcs != list(range(n)) or dsts != list(range(n)):
+        return None
+    offs = {(d - s) % n for s, d in perm}
+    if len(offs) != 1:
+        return None
+    return offs.pop()
+
+
+def _subjaxprs(params):
+    """(key, jaxpr) pairs for every jaxpr-valued param of an equation."""
+    for k, v in params.items():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vals:
+            if hasattr(item, "eqns"):
+                yield k, item
+            elif hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                yield k, item.jaxpr
+
+
+def collect_collectives(jaxpr, *, _in_cond=False, _in_while=False
+                        ) -> List[CollEvent]:
+    """Flat, issue-ordered collective event stream of `jaxpr` (a Jaxpr or
+    ClosedJaxpr).  Scan bodies repeat `length` times; both cond branches
+    are walked in order (flagged in_cond) — the ring code keeps collectives
+    outside conds, and the checkers treat any conditional collective as a
+    finding in its own right."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    events: List[CollEvent] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "ppermute":
+            perm = tuple(tuple(p) for p in eqn.params["perm"])
+            aval = eqn.outvars[0].aval
+            events.append(CollEvent(
+                prim="ppermute",
+                axis=_axis_str(eqn.params["axis_name"]),
+                hops=rotation_offset(perm),
+                dtype=str(aval.dtype), rank=len(aval.shape), perm=perm,
+                in_cond=_in_cond, in_while=_in_while))
+        elif name in ("all_to_all", "psum"):
+            aval = eqn.outvars[0].aval
+            events.append(CollEvent(
+                prim=name, axis=_axis_str(eqn.params.get("axis_name")),
+                hops=None, dtype=str(aval.dtype), rank=len(aval.shape),
+                in_cond=_in_cond, in_while=_in_while))
+        elif name == "scan":
+            body = collect_collectives(
+                eqn.params["jaxpr"], _in_cond=_in_cond, _in_while=_in_while)
+            events.extend(body * int(eqn.params["length"]))
+        elif name == "cond":
+            for br in eqn.params["branches"]:
+                events.extend(collect_collectives(
+                    br, _in_cond=True, _in_while=_in_while))
+        elif name == "while":
+            for key in ("cond_jaxpr", "body_jaxpr"):
+                events.extend(collect_collectives(
+                    eqn.params[key], _in_cond=_in_cond, _in_while=True))
+        else:
+            for _k, sub in _subjaxprs(eqn.params):
+                events.extend(collect_collectives(
+                    sub, _in_cond=_in_cond, _in_while=_in_while))
+    return events
+
+
+def iter_eqns(jaxpr):
+    """Yield every equation of `jaxpr` and its nested subjaxprs (scan
+    bodies once, both cond branches) — for the numerics walkers, where
+    multiplicity doesn't matter."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for _k, sub in _subjaxprs(eqn.params):
+            yield from iter_eqns(sub)

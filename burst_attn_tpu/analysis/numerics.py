@@ -1,0 +1,115 @@
+"""Numerics verifiers (burstlint family 1, rules fp32-accum / lse-fp32).
+
+Walks the jaxprs of the attention tile oracle (ops/tile.py) and the Pallas
+flash kernels (ops/pallas_flash.py, traced through the pallas_call
+equation's inner jaxpr — no TPU needed) on bf16 inputs and asserts the
+FlashAttention numerics contract (arXiv 2205.14135; PAPER.md):
+
+  fp32-accum  every dot_general with a low-precision (bf16/f16) operand
+              accumulates in float32 (preferred_element_type) — an MXU dot
+              that keeps a bf16 accumulator loses ~8 bits of mantissa per
+              long-sequence softmax reduction.
+  lse-fp32    the running-max / log-sum-exp statistics ([B, N, S] rank-3
+              float32 tensors in every shard-level trace) are never
+              downcast below fp32 mid-ring; only the final rank-4 output
+              may cast back to the activation dtype.
+"""
+
+import inspect
+from typing import List
+
+from .core import Finding, rule
+from .jaxpr_tools import iter_eqns
+
+rule("fp32-accum", "jaxpr",
+     "dot_general on bf16/f16 operands must accumulate in float32")(None)
+rule("lse-fp32", "jaxpr",
+     "rank-3 softmax stats (m/lse/delta) must never downcast below fp32")(None)
+
+_LOW = ("bfloat16", "float16")
+
+
+def _anchor(fn):
+    try:
+        return inspect.getsourcefile(fn), inspect.getsourcelines(fn)[1]
+    except (OSError, TypeError):
+        return "<trace>", 0
+
+
+def check_trace(closed_jaxpr, *, where: str, anchor,
+                stats_rank: int = 3) -> List[Finding]:
+    """Run both numerics rules over one traced program."""
+    findings: List[Finding] = []
+    path, line = anchor
+    for eqn in iter_eqns(closed_jaxpr):
+        name = eqn.primitive.name
+        if name == "dot_general":
+            in_dtypes = {str(v.aval.dtype) for v in eqn.invars
+                         if hasattr(v.aval, "dtype")}
+            out_dtype = str(eqn.outvars[0].aval.dtype)
+            if in_dtypes & set(_LOW) and out_dtype != "float32":
+                findings.append(Finding(
+                    rule="fp32-accum", file=path, line=line,
+                    message=f"{where}: dot_general({'/'.join(sorted(in_dtypes))})"
+                            f" accumulates in {out_dtype}, not float32 — "
+                            "pass preferred_element_type=jnp.float32"))
+        elif name == "convert_element_type":
+            out = eqn.outvars[0].aval
+            src = eqn.invars[0].aval
+            if (str(getattr(src, "dtype", "")) == "float32"
+                    and str(out.dtype) in _LOW
+                    and len(getattr(src, "shape", ())) == stats_rank):
+                findings.append(Finding(
+                    rule="lse-fp32", file=path, line=line,
+                    message=f"{where}: rank-{stats_rank} float32 stat tensor "
+                            f"{tuple(src.shape)} downcast to {out.dtype} — "
+                            "m/lse/delta must stay fp32 across ring rounds"))
+    return findings
+
+
+def check_all() -> List[Finding]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import tile
+    from ..ops.masks import round_spec
+
+    findings: List[Finding] = []
+    b, n, s, d = 1, 2, 128, 64
+    S = jax.ShapeDtypeStruct
+    q4 = S((b, n, s, d), jnp.bfloat16)
+    f3 = S((b, n, s), jnp.float32)
+    f4 = S((b, n, s, d), jnp.float32)
+    spec = round_spec(jnp.int32(0), jnp.int32(0), s, s, True, "contig")
+    scale = d ** -0.5
+
+    # ---- jnp tile oracle ----
+    jx = jax.make_jaxpr(
+        lambda q, k, v, m, lse, acc: tile.tile_fwd(
+            q, k, v, m, lse, acc, scale, spec))(q4, q4, q4, f3, f3, f4)
+    findings += check_trace(jx, where="tile_fwd",
+                            anchor=_anchor(tile.tile_fwd))
+    jx = jax.make_jaxpr(
+        lambda do, q, k, v, delta, lse: tile.tile_bwd(
+            do, q, k, v, delta, lse, scale, spec))(q4, q4, q4, q4, f3, f3)
+    findings += check_trace(jx, where="tile_bwd",
+                            anchor=_anchor(tile.tile_bwd))
+
+    # ---- pallas flash kernels (inner jaxpr of the pallas_call eqn) ----
+    try:
+        from ..ops import pallas_flash
+    except ImportError:
+        return findings  # no pallas on this backend: the tile rules stand
+    jx = jax.make_jaxpr(
+        lambda q, k, v: pallas_flash.flash_fwd(
+            q, k, v, None, None, None, scale, spec,
+            block_q=64, block_kv=64))(q4, q4, q4)
+    findings += check_trace(jx, where="flash_fwd kernel",
+                            anchor=_anchor(pallas_flash.flash_fwd))
+    jx = jax.make_jaxpr(
+        lambda do, q, k, v, delta, lse: pallas_flash.flash_bwd(
+            do, q, k, v, delta, lse, scale, spec,
+            block_q=64, block_kv=64))(q4, q4, q4, q4, f3, f3)
+    findings += check_trace(jx, where="flash_bwd kernel",
+                            anchor=_anchor(pallas_flash.flash_bwd))
+    return findings

@@ -1,0 +1,238 @@
+"""Host-side schedule oracle for the jaxpr ring verifiers.
+
+Generates, for a ring topology (n_inter, n_intra, r_live), the EXPECTED
+ordered stream of collective events the burst forward / backward shard
+programs must issue, and proves — by direct simulation on host integers —
+that the expected backward stream really returns every dq contribution to
+the device owning its query partition.  The jaxpr extracted from the real
+code is then required to match the proven stream exactly, so a topology
+bug (wrong hop count, missing return-home hop, prefetch landing a cycle
+late, truncation referencing a dead round) becomes a static finding
+instead of a wrong gradient at scale.
+
+Event convention: (cls, axis, hops) with cls in {"pay", "dq", "a2a"},
+axis in {"intra", "inter"} (flat rings use "intra"), hops the rotation
+offset (always forward: rank i -> i + hops mod n).  Streams are flat and
+in issue order; scan bodies are unrolled.  Runs of identical consecutive
+events are compared run-length-encoded (see encode_runs).
+"""
+
+from typing import List, Set, Tuple
+
+import numpy as np
+
+Event = Tuple[str, str, int]
+
+
+# ---------------------------------------------------------------------------
+# schedules (mirrors parallel/ring.ring_schedule — duplicated here on
+# purpose: the analyzer must not trust the code under test)
+
+
+def ring_schedule(intra_size: int, inter_size: int = 1) -> np.ndarray:
+    """[world, rounds] array: entry (device, r) = partition id held at
+    ring round r under the (double-)ring visit order."""
+    world = inter_size * intra_size
+    out = np.empty((world, world), dtype=np.int64)
+    for dev in range(world):
+        inter_rank, intra_rank = divmod(dev, intra_size)
+        for r in range(world):
+            c, s = divmod(r, intra_size)
+            out[dev, r] = ((inter_rank - c) % inter_size) * intra_size + (
+                (intra_rank - s) % intra_size)
+    return out
+
+
+def expected_hop_totals(n_inter: int, n_intra: int, r_live=None):
+    """Per-axis per-leaf forward hop totals, derived from schedule
+    TRANSITIONS (not from the implementation's loop structure): one intra
+    hop whenever the held partition's intra rank changes between visited
+    rounds, one inter hop per cycle boundary (+ the prefetch convention
+    that the inter hop replaces the boundary intra hop)."""
+    if r_live is None:
+        r_live = n_intra if n_inter == 1 else None
+    if n_inter == 1:
+        return {"intra": r_live - 1, "inter": 0}
+    sched = ring_schedule(n_intra, n_inter)
+    intra = inter = 0
+    row = sched[0]
+    for r in range(1, len(row)):
+        prev, cur = row[r - 1], row[r]
+        if prev // n_intra != cur // n_intra:
+            inter += 1
+        else:
+            intra += 1
+    # the boundary round's intra state is re-derived from the prefetched
+    # cycle base, so each boundary also costs the intra ring its final
+    # rotation back into cycle phase 0 — burst issues n_intra-1 intra hops
+    # per cycle (the last round of a cycle never sends).
+    return {"intra": n_inter * (n_intra - 1), "inter": inter}
+
+
+# ---------------------------------------------------------------------------
+# forward stream
+
+
+def fwd_stream(n_inter: int, n_intra: int, r_live=None) -> List[Event]:
+    """Expected forward collective stream: per cycle, the inter prefetch of
+    the next cycle base is issued FIRST (one full intra cycle early), then
+    the cycle's n_intra - 1 intra rotations (round 0 of cycle 0 is peeled
+    but still sends; the last round of every cycle never sends)."""
+    if r_live is None:
+        r_live = n_intra if n_inter == 1 else n_intra
+    ev: List[Event] = []
+    for c in range(n_inter):
+        if c < n_inter - 1:
+            ev.append(("pay", "inter", 1))
+        live = r_live if n_inter == 1 else n_intra
+        ev += [("pay", "intra", 1)] * (live - 1)
+    return ev
+
+
+# ---------------------------------------------------------------------------
+# backward stream + return-home proof
+
+
+def bwd_stream(n_inter: int, n_intra: int, r_live=None) -> List[Event]:
+    """Expected backward stream (payload rotations + dq add-and-forward
+    ring + final return-home hops), mirroring the schedule semantics:
+
+      cycle c: [inter payload prefetch]  (c < n_inter - 1)
+               [inter dq fold-and-forward]  (c > 0)
+               first round (no sends), then — when more rounds are live —
+               one payload JUMP of n_intra - (r_live-1) hops over the dead
+               middle, the scan's alternating payload/dq single hops, and
+               the cycle's final dq rotation
+      coda:    one inter dq hop (double ring), one intra dq hop.
+    """
+    if r_live is None:
+        r_live = n_intra if n_inter == 1 else n_intra
+    ev: List[Event] = []
+    for c in range(n_inter):
+        if c < n_inter - 1:
+            ev.append(("pay", "inter", 1))
+        if c > 0:
+            ev.append(("dq", "inter", 1))
+        live = r_live if n_inter == 1 else n_intra
+        if live > 1:
+            start = n_intra - (live - 1)
+            ev.append(("pay", "intra", start))
+            for _ in range(start, n_intra - 1):
+                ev.append(("pay", "intra", 1))
+                ev.append(("dq", "intra", 1))
+            ev.append(("dq", "intra", 1))
+    if n_inter > 1:
+        ev.append(("dq", "inter", 1))
+    if (r_live if n_inter == 1 else n_intra) > 1:
+        ev.append(("dq", "intra", 1))
+    return ev
+
+
+def verify_dq_returns_home(n_inter: int, n_intra: int, r_live=None) -> None:
+    """Prove by simulation that bwd_stream + the compute schedule return
+    every dq contribution to the owner of its query partition.
+
+    Device d = (ci, si) computes, at visited round r, the dq of the query
+    partition it currently holds (per ring_schedule).  Contributions ride
+    dq_intra within a cycle, fold into dq_inter at boundaries, and take
+    the final return hops; truncated rings hold round 0's dq out in
+    dq_home.  Raises AssertionError on any contribution landing wrong —
+    the generated stream is only handed to the jaxpr matcher if this
+    proof passes."""
+    if r_live is None:
+        r_live = n_intra if n_inter == 1 else n_intra
+    world = n_inter * n_intra
+    truncated = n_inter == 1 and r_live < n_intra
+
+    def rot(reg, axis, hops):
+        """Move per-device contribution sets `hops` forward along axis."""
+        new = [set() for _ in range(world)]
+        for d in range(world):
+            ci, si = divmod(d, n_intra)
+            if axis == "intra":
+                nd = ci * n_intra + (si + hops) % n_intra
+            else:
+                nd = ((ci + hops) % n_inter) * n_intra + si
+            new[nd] |= reg[d]
+        return new
+
+    sched = ring_schedule(n_intra, n_inter)
+    dq_intra = [set() for _ in range(world)]
+    dq_inter = [set() for _ in range(world)]
+    dq_home = [set() for _ in range(world)]
+
+    def compute(r, into):
+        for d in range(world):
+            into[d].add((d, int(sched[d, r])))  # (computing device, q part)
+
+    for c in range(n_inter):
+        if c > 0:
+            for d in range(world):
+                dq_inter[d] |= dq_intra[d]
+            dq_inter = rot(dq_inter, "inter", 1)
+            dq_intra = [set() for _ in range(world)]
+        live = r_live if n_inter == 1 else n_intra
+        compute(c * n_intra, dq_home if truncated else dq_intra)
+        if live > 1:
+            start = n_intra - (live - 1)
+            # payload jumps `start` hops; dq_intra is all-zero then (cycle
+            # start), so only the visited rounds' rotations matter
+            for s_idx in range(start, n_intra - 1):
+                dq_intra = rot(dq_intra, "intra", 1)
+                compute(c * n_intra + s_idx, dq_intra)
+            dq_intra = rot(dq_intra, "intra", 1)
+            compute(c * n_intra + n_intra - 1, dq_intra)
+    final = [dq_inter[d] | dq_intra[d] for d in range(world)]
+    if n_inter > 1:
+        final = rot(final, "inter", 1)
+    if (r_live if n_inter == 1 else n_intra) > 1:
+        final = rot(final, "intra", 1)
+    for d in range(world):
+        final[d] |= dq_home[d]
+    for d in range(world):
+        for (_src, part) in final[d]:
+            assert part == d, (
+                f"dq of partition {part} landed on device {d} "
+                f"(n_inter={n_inter}, n_intra={n_intra}, r_live={r_live})")
+    # completeness: every visited (device, round) contribution arrived
+    n_contrib = sum(len(s) for s in final)
+    visited = world * (r_live if n_inter == 1 else n_intra * n_inter)
+    assert n_contrib == visited, (n_contrib, visited)
+
+
+# ---------------------------------------------------------------------------
+# windowed truncation
+
+
+def live_rounds_contig(seq: int, world: int, window: int) -> Set[int]:
+    """Independent (dense numpy) derivation of the live round set of a
+    windowed causal CONTIG single ring: round r is live iff any device's
+    (q chunk, kv chunk held at round r) block intersects the causal band
+    mask.  The implementation's static truncation must keep exactly this
+    set — truncating a live round loses attention mass, keeping a dead
+    round wastes a permute and can reference garbage."""
+    s = seq // world
+    live = set()
+    for r in range(world):
+        for d in range(world):
+            kv_part = (d - r) % world
+            qs = np.arange(d * s, (d + 1) * s)[:, None]
+            ks = np.arange(kv_part * s, (kv_part + 1) * s)[None, :]
+            m = (ks <= qs) & (ks > qs - window)
+            if m.any():
+                live.add(r)
+                break
+    return live
+
+
+def encode_runs(events: List[Event]) -> List[Tuple[str, str, int, int]]:
+    """Run-length encode consecutive identical events: (cls, axis, hops,
+    count).  Both oracle and extracted streams are compared in this form
+    (payload leaf fan-out is divided out before encoding)."""
+    out: List[Tuple[str, str, int, int]] = []
+    for ev in events:
+        if out and out[-1][:3] == ev:
+            out[-1] = (*ev, out[-1][3] + 1)
+        else:
+            out.append((*ev, 1))
+    return out

@@ -183,5 +183,5 @@ class DataLoader:
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception:  # burstlint: disable=silent-except
+            pass  # __del__ during interpreter teardown: logging itself can fail

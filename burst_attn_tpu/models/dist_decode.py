@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .transformer import ModelConfig, _attn_out, _mlp, _qkv_proj, _rms_norm
 from ..parallel import layouts
 from ..parallel.burst import burst_attn
+from ..utils.compat import shard_map
 
 
 class DistCache(NamedTuple):
@@ -54,7 +55,7 @@ def dist_prefill(params, tokens, cfg: ModelConfig, mesh, *, gen_budget: int):
     b, s = tokens.shape
     world = 1
     for a in cfg.seq_axes:
-        world *= mesh.shape[a]
+        world *= mesh.shape.get(a, 1)
     perm = layouts.seq_permutation(cfg.layout, s, world)
     pos = jnp.broadcast_to(jnp.asarray(perm, jnp.int32)[None, :], (b, s))
     tokens_l = jnp.take(tokens, jnp.asarray(perm), axis=1)
@@ -88,7 +89,8 @@ def dist_prefill(params, tokens, cfg: ModelConfig, mesh, *, gen_budget: int):
     # only ONE position feeds decoding; the full [B, S, vocab] fp32 logits
     # would be GBs at the contexts this module exists for.  The LAST token
     # in natural order sits at layout position inv_perm[s-1].
-    last_pos = int(layouts.inverse_permutation(perm)[s - 1])
+    # host numpy (perm is a host-side layout table), not a traced value
+    last_pos = int(layouts.inverse_permutation(perm)[s - 1])  # burstlint: disable=host-transfer-in-jit
     last_logits = jnp.einsum("bd,vd->bv", xf[:, last_pos], params["lm_head"],
                              preferred_element_type=jnp.float32)
 
@@ -177,7 +179,7 @@ def dist_decode_step(params, token, position, cache: DistCache,
             return m_g, l_g, acc_g
 
         seq_spec = sp_axes if len(sp_axes) > 1 else sp_axes[0]
-        m_c, l_c, acc_c = jax.shard_map(
+        m_c, l_c, acc_c = shard_map(
             shard_partial, mesh=mesh,
             in_specs=(P(cfg.batch_axis, None, None, None),
                       P(cfg.batch_axis, None, seq_spec, None),

@@ -45,6 +45,7 @@ from jax.sharding import PartitionSpec as P
 from .transformer import ModelConfig, _attn_out, _mlp, _qkv_proj, _rms_norm
 from .decode import _flash_prompt_attention, sample_logits
 from ..ops.paged_attention import paged_decode_attention, quantize_tokens
+from ..utils.compat import shard_map
 
 
 def _check_tp_mesh(cfg: ModelConfig, mesh):
@@ -57,7 +58,7 @@ def _check_tp_mesh(cfg: ModelConfig, mesh):
             f"head_axis {cfg.head_axis!r} is not an axis of the mesh "
             f"{dict(mesh.shape)}; pass mesh=None for single-device serving "
             "or set cfg.head_axis to a mesh axis")
-    tp = mesh.shape[cfg.head_axis]
+    tp = mesh.shape.get(cfg.head_axis, 1)
     if tp > 1 and (cfg.n_kv_heads % tp or cfg.n_heads % tp):
         raise ValueError(
             f"n_heads {cfg.n_heads} / n_kv_heads {cfg.n_kv_heads} not "
@@ -72,7 +73,7 @@ def _prompt_attention_dispatch(q, k, v, cfg: ModelConfig, mesh):
     if _check_tp_mesh(cfg, mesh) == 1:
         return _flash_prompt_attention(q, k, v, window=cfg.window)
     spec = P(None, cfg.head_axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_flash_prompt_attention, window=cfg.window),
         mesh=mesh,
         in_specs=(spec,) * 3,
@@ -115,7 +116,7 @@ def _paged_attention_dispatch(qg, kp, vp, ks, vs, table, lengths,
                                       k_scales=ks_l, v_scales=vs_l,
                                       window=cfg.window)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         shard, mesh=mesh, in_specs=tuple(in_specs), out_specs=spec4,
         check_vma=False,
     )
@@ -365,7 +366,7 @@ def _suffix_attention_dispatch(q, k, v, t_pre, q_hi, kv_hi, cfg, mesh):
         return _suffix_attention(q, k, v, t_pre, q_hi=q_hi, kv_hi=kv_hi,
                                  window=cfg.window)
     spec = P(None, cfg.head_axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda q_, k_, v_, qh, kh: _suffix_attention(
             q_, k_, v_, t_pre, q_hi=qh, kv_hi=kh, window=cfg.window),
         mesh=mesh,

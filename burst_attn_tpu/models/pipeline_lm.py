@@ -53,6 +53,7 @@ from ..parallel.burst import BurstConfig, burst_attn_shard, _resolve_backend
 # there must not silently break pp=1 vs pp=N parity (_mlp's dense path is
 # per-shard pure math too — cfg=None selects it)
 from .transformer import _attn_out, _mlp, _qkv_proj, _rms_norm, param_specs
+from ..utils.compat import axis_size, shard_map
 
 
 def stack_layers(layers):
@@ -124,7 +125,7 @@ def _pp_forward_shard(layers_p, embed, final_norm, lm_head, tokens, positions,
     layers_p: this stage's layers, leaves [L/P, ...]; tokens/positions
     [b_local, s_local] (dp x sp shard)."""
     pp = cfg.pp_axis
-    n_stages = lax.axis_size(pp)
+    n_stages = axis_size(pp)
     stage = lax.axis_index(pp)
     b_l, s_l = tokens.shape
     x = embed.astype(cfg.dtype)[tokens]
@@ -207,7 +208,7 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh,
                 f"head_axis {cfg.head_axis!r} is not an axis of the mesh "
                 f"{dict(mesh.shape)}; set head_axis=None (ModelConfig "
                 "defaults it to 'tp') or add the axis to the mesh")
-        tp_size = mesh.shape[cfg.head_axis]
+        tp_size = mesh.shape.get(cfg.head_axis, 1)
         if cfg.n_heads % tp_size or cfg.n_kv_heads % tp_size:
             raise ValueError(
                 f"n_heads {cfg.n_heads} / n_kv_heads {cfg.n_kv_heads} not "
@@ -222,7 +223,7 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh,
             raise ValueError(
                 f"expert_axis {cfg.expert_axis!r} is not an axis of the "
                 f"mesh {dict(mesh.shape)}")
-        ep_size = mesh.shape[cfg.expert_axis]
+        ep_size = mesh.shape.get(cfg.expert_axis, 1)
         if cfg.n_experts % ep_size:
             raise ValueError(
                 f"n_experts {cfg.n_experts} not divisible by "
@@ -237,12 +238,12 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh,
         raise ValueError(
             f"batch_axis {cfg.batch_axis!r} is not an axis of the mesh "
             f"{dict(mesh.shape)}; set batch_axis=None or add a dp axis")
-    n_stages = mesh.shape[cfg.pp_axis]
+    n_stages = mesh.shape.get(cfg.pp_axis, 1)
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"n_layers {cfg.n_layers} not divisible by pp={n_stages}")
     m = cfg.pp_microbatches
-    dp = mesh.shape[cfg.batch_axis] if cfg.batch_axis else 1
+    dp = mesh.shape.get(cfg.batch_axis, 1) if cfg.batch_axis else 1
     b_local = tokens.shape[0] // dp
     if b_local % m:
         raise ValueError(
@@ -276,7 +277,7 @@ def pp_forward_with_aux(params, tokens, positions, cfg, mesh,
     if segment_ids is not None:
         in_specs.append(tok_spec)
         args.append(jnp.asarray(segment_ids, jnp.int32))
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_pp_forward_shard, cfg=cfg, bcfg=bcfg, m=m),
         mesh=mesh,
         in_specs=tuple(in_specs),

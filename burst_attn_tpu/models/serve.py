@@ -36,12 +36,15 @@ Reference parity: none — the reference is an attention op library with no
 serving story (SURVEY.md §5); this is framework surface beyond it.
 """
 
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from .decode import sample_logits
 from .paged_decode import (
@@ -238,14 +241,23 @@ class ServeEngine:
                 # only ones the engine can survive) roll back cleanly.
                 try:
                     self.state = retire_slot(self.state, self.pool, slot)
-                except Exception:  # noqa: BLE001 — deleted donated buffers
-                    pass
+                except Exception as rollback_err:  # noqa: BLE001
+                    # non-fatal (deleted donated buffers), but an UNEXPECTED
+                    # rollback failure here is a silent page leak — log it
+                    logger.warning(
+                        "admission rollback: retire_slot(slot=%d) failed "
+                        "(%s: %s); continuing — pages may leak if this is "
+                        "not the deleted-donated-buffer case",
+                        slot, type(rollback_err).__name__, rollback_err)
                 if self.draft is not None:
                     try:
                         self.dstate = retire_slot(self.dstate, self.dpool,
                                                   slot)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as rollback_err:  # noqa: BLE001
+                        logger.warning(
+                            "admission rollback: draft retire_slot(slot=%d) "
+                            "failed (%s: %s); continuing",
+                            slot, type(rollback_err).__name__, rollback_err)
                 raise
             tok = self._sample(logits[None, :])[0]
             if tok < 0:  # sample_logits NaN-poison sentinel

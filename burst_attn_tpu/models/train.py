@@ -14,6 +14,7 @@ shifted BEFORE the permutation — shifting after would cross shard boundaries.
 `positions` carries true global positions for rotary (layouts.position_ids).
 """
 
+import logging
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Optional, Tuple
@@ -24,6 +25,8 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 from .transformer import ModelConfig, forward, forward_with_aux, init_params, param_specs
 from ..parallel import layouts
@@ -217,7 +220,7 @@ def probe_model_tri_bwd(cfg: ModelConfig, mesh: Mesh, batch=None, *,
         # all-to-all re-gathers the full sequence; heads split instead
         s_kernel = seq_len
     else:  # burst ring: each round's kernel sees the per-shard chunk
-        ring = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))
+        ring = int(np.prod([mesh.shape.get(a, 1) for a in cfg.seq_axes]))
         s_kernel = seq_len // ring
     from ..ops.pallas_flash import ensure_tri_bwd
 
@@ -252,7 +255,7 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
             if b0 % accum:
                 raise ValueError(f"batch {b0} not divisible by grad_accum {accum}")
             if cfg.batch_axis is not None:
-                dp = mesh.shape[cfg.batch_axis]
+                dp = mesh.shape.get(cfg.batch_axis, 1)
                 if (b0 // accum) % dp:
                     raise ValueError(
                         f"microbatch {b0 // accum} (batch {b0} / grad_accum "
@@ -305,9 +308,21 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
         # probe_tri_bwd) instead of crashing the training step.  Memoized
         # process-wide (ensure_tri_bwd) — one compile per config, shared
         # with every other entry point.
+        # The probe is a BEST-EFFORT guard: it must never be able to fail
+        # training itself (a raise here would crash the first step, and a
+        # retried step would silently skip the guard since `probed` is
+        # already marked) — any failure degrades to running unprobed.
         if not probed:
             probed.append(True)
-            probe_model_tri_bwd(cfg, mesh, batch)
+            try:
+                probe_model_tri_bwd(cfg, mesh, batch)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "tri-backward compile probe failed (%s: %s); training "
+                    "proceeds unprobed — a Mosaic rejection would now "
+                    "surface from the first step's jit instead of "
+                    "degrading to the rectangular kernel",
+                    type(e).__name__, e)
         return jit_step(state, batch)
 
     return guarded_step
@@ -342,7 +357,7 @@ def batch_from_host(tokens, labels, cfg: ModelConfig, mesh: Mesh,
     tokens = np.asarray(tokens)
     labels = np.asarray(labels)
     b, s = tokens.shape
-    world = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))
+    world = int(np.prod([mesh.shape.get(a, 1) for a in cfg.seq_axes]))
     perm = layouts.seq_permutation(cfg.layout, s, world)
     seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
     sharding = NamedSharding(mesh, P(cfg.batch_axis, seq_spec))
@@ -399,7 +414,7 @@ def make_packed_batch(key, cfg: ModelConfig, mesh: Mesh, batch: int, seq: int,
     """Synthetic PACKED LM batch: random tokens with EOS delimiters sprinkled
     in, fields derived by packed_fields, everything permuted into layout
     order and placed with (dp, sp) sharding."""
-    world = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))
+    world = int(np.prod([mesh.shape.get(a, 1) for a in cfg.seq_axes]))
     k1, k2 = jax.random.split(key)
     tokens = jax.random.randint(k1, (batch, seq), 0, cfg.vocab, dtype=jnp.int32)
     # ~4 documents per row on average
@@ -419,7 +434,7 @@ def make_packed_batch(key, cfg: ModelConfig, mesh: Mesh, batch: int, seq: int,
 
 def make_batch(key, cfg: ModelConfig, mesh: Mesh, batch: int, seq: int):
     """Synthetic LM batch in layout order, placed with (dp, sp) sharding."""
-    world = int(np.prod([mesh.shape[a] for a in cfg.seq_axes]))
+    world = int(np.prod([mesh.shape.get(a, 1) for a in cfg.seq_axes]))
     tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab, dtype=jnp.int32)
     labels = jnp.concatenate(
         [tokens[:, 1:], jnp.full((batch, 1), -1, jnp.int32)], axis=1

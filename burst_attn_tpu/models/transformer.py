@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.burst import burst_attn
+from ..utils.compat import shard_map
 
 
 @dataclass(frozen=True)
@@ -334,14 +335,14 @@ def _mlp(p, x, cfg: Optional[ModelConfig] = None, mesh=None, inference=False):
         seq_spec = cfg.seq_axes if len(cfg.seq_axes) > 1 else cfg.seq_axes[0]
         ep = cfg.expert_axis
         if ep is not None:
-            ep_size = mesh.shape[ep]
+            ep_size = mesh.shape.get(ep, 1)
             if cfg.n_experts % ep_size:
                 raise ValueError(
                     f"n_experts {cfg.n_experts} not divisible by "
                     f"expert_axis {ep!r} size {ep_size}")
         pspec = MoEParams(P(None, None), P(ep, None, None),
                           P(ep, None, None), P(ep, None, None))
-        y, aux = jax.shard_map(
+        y, aux = shard_map(
             group, mesh=mesh,
             in_specs=(pspec, P(cfg.batch_axis, seq_spec, None)),
             out_specs=(P(cfg.batch_axis, seq_spec, None), P()),

@@ -52,6 +52,7 @@ logger = logging.getLogger("burst_attn_tpu")
 # paths (burst.py's backend fallback) can resolve blocks without importing
 # this module
 from .tuning import resolve_blocks  # noqa: F401
+from ..utils.compat import tpu_compiler_params
 
 NEG_INF = float("-inf")
 # stand-in for -inf lse rows in the backward kernels: exp(s - BIG_LSE)
@@ -848,7 +849,7 @@ def flash_fwd(q, k, v, m, lse, acc, scale, spec: MaskSpec, *,
         # q-block dim must be "arbitrary": the packed m/lse out blocks are
         # shared by every q-block of a head, so a megacore split over dim 2
         # would race the partial writes.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=VMEM_LIMIT,
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
         ),
@@ -1577,7 +1578,7 @@ def _flash_bwd_fused_tri(do, q, k, v, delta, lse, scale, spec, *,
             jax.ShapeDtypeStruct((b, n, s_kv, d), jnp.float32),
             jax.ShapeDtypeStruct((b, n, s_kv, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=VMEM_LIMIT,
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
         ),
@@ -1675,7 +1676,7 @@ def _flash_bwd_fused(do, q, k, v, delta, lse, scale, spec, *,
         ],
         # flattened input index 7 = dq0 (after the scalar-prefetch spec array)
         input_output_aliases={7: 0},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=VMEM_LIMIT,
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
         ),
@@ -1936,7 +1937,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, n, s_q, d), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=VMEM_LIMIT,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
@@ -2000,7 +2001,7 @@ def flash_bwd(do, q, k, v, delta, lse, scale, spec: MaskSpec, *,
             jax.ShapeDtypeStruct((b, n_kv, s_kv, d), jnp.float32),
             jax.ShapeDtypeStruct((b, n_kv, s_kv, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=VMEM_LIMIT,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),

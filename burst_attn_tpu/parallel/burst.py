@@ -49,6 +49,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops import tile as jnp_tile
 from ..ops.masks import full_spec, round_spec, spec_live
 from .ring import ppermute_by, ppermute_next, my_partition, partition_at_round
+from ..utils.compat import axis_size, shard_map
 
 
 @dataclass(frozen=True)
@@ -159,8 +160,8 @@ def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec, triangular=False,
 
 
 def _sizes(cfg):
-    intra = lax.axis_size(cfg.intra_axis)
-    inter = lax.axis_size(cfg.inter_axis) if cfg.inter_axis is not None else 1
+    intra = axis_size(cfg.intra_axis)
+    inter = axis_size(cfg.inter_axis) if cfg.inter_axis is not None else 1
     return inter, intra
 
 
@@ -667,7 +668,7 @@ def burst_attn(
     spec = P(batch_axes, head_axes, seq_spec, None)
     if segment_ids is not None:
         seg_spec = P(batch_axes, seq_spec)
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda q, k, v, seg: burst_attn_shard(q, k, v, cfg, seg),
             mesh=mesh,
             in_specs=(spec, spec, spec, seg_spec),
@@ -675,7 +676,7 @@ def burst_attn(
             check_vma=False,
         )
         return fn(q, k, v, jnp.asarray(segment_ids, jnp.int32))
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(burst_attn_shard, cfg=cfg),
         mesh=mesh,
         in_specs=(spec, spec, spec),

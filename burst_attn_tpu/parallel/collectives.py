@@ -9,6 +9,7 @@ analogue of the reference's object gather over NCCL)."""
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..utils.compat import axis_size
 
 
 # ---- in-shard_map collectives (SPMD) ----
@@ -48,7 +49,7 @@ def rank(axis_name: str):
 
 
 def world_size(axis_name: str):
-    return lax.axis_size(axis_name)
+    return axis_size(axis_name)
 
 
 # ---- host-level helpers (multi-controller) ----

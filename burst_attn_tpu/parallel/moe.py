@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..utils.compat import shard_map
 
 
 class MoEParams(NamedTuple):
@@ -165,7 +166,7 @@ def moe_apply(p: MoEParams, x, *, mesh=None, axis: Optional[str] = "ep",
         y = y.reshape(b, t, d)
         return (y[0] if squeeze else y), aux, dropped
 
-    ep = mesh.shape[axis]
+    ep = mesh.shape.get(axis, 1)
     if e % ep:
         raise ValueError(f"experts {e} not divisible by ep axis size {ep}")
     if t % ep:
@@ -181,7 +182,7 @@ def moe_apply(p: MoEParams, x, *, mesh=None, axis: Optional[str] = "ep",
         return y.reshape(bb, tt, d), aux, dropped
 
     pspec = MoEParams(P(), P(axis), P(axis), P(axis))
-    y, aux, dropped = jax.shard_map(
+    y, aux, dropped = shard_map(
         body, mesh=mesh,
         in_specs=(pspec, P(None, axis, None)),
         out_specs=(P(None, axis, None), P(), P()),

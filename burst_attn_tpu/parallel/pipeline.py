@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..utils.compat import axis_size, shard_map
 
 
 def pipeline_shard(stage_fn, stage_params, x_mb, axis: str):
@@ -43,7 +44,7 @@ def pipeline_shard(stage_fn, stage_params, x_mb, axis: str):
     input (replicated across stages; only stage 0 reads it).  Returns
     [M, mb, ...] outputs (valid on every stage after the final psum).
     """
-    n_stages = lax.axis_size(axis)
+    n_stages = axis_size(axis)
     stage = lax.axis_index(axis)
     params = jax.tree.map(lambda a: jnp.squeeze(a, axis=0), stage_params)
     m = x_mb.shape[0]
@@ -95,7 +96,7 @@ def pipeline(stage_fn, stage_params, x, *, mesh, axis: str = "pp",
     x_mb = x.reshape(microbatches, b // microbatches, *x.shape[1:])
 
     spec_params = jax.tree.map(lambda _: P(axis), stage_params)
-    out = jax.shard_map(
+    out = shard_map(
         partial(pipeline_shard, fn, axis=axis),
         mesh=mesh,
         in_specs=(spec_params, P()),

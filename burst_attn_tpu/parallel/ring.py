@@ -18,6 +18,7 @@ device's rotating buffer holds at ring round r.
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..utils.compat import axis_size
 
 
 def ppermute_next(x, axis_name: str):
@@ -32,7 +33,7 @@ def ppermute_by(x, axis_name: str, hops: int):
     collective, not h.  The windowed ring uses this to skip its dead
     middle rounds (parallel/burst.py round truncation) without paying
     their payload traffic.  hops is static; hops % world == 0 is a no-op."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     h = hops % n
     if h == 0:
         return x
@@ -43,10 +44,10 @@ def ppermute_by(x, axis_name: str, hops: int):
 def axis_ranks(intra_axis: str, inter_axis):
     """(inter_rank, intra_rank, inter_size, intra_size) for this device."""
     intra_rank = lax.axis_index(intra_axis)
-    intra_size = lax.axis_size(intra_axis)
+    intra_size = axis_size(intra_axis)
     if inter_axis is None:
         return jnp.int32(0), intra_rank, 1, intra_size
-    return lax.axis_index(inter_axis), intra_rank, lax.axis_size(inter_axis), intra_size
+    return lax.axis_index(inter_axis), intra_rank, axis_size(inter_axis), intra_size
 
 
 def my_partition(intra_axis: str, inter_axis) -> jnp.ndarray:

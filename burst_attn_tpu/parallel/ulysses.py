@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..utils.compat import shard_map
 
 
 def _local_attention(q, k, v, scale, causal, backend, block_q, block_kv,
@@ -86,11 +87,11 @@ def ulysses_attn(
     """
     from .burst import _resolve_backend
 
-    w = mesh.shape[seq_axis]
+    w = mesh.shape.get(seq_axis, 1)
     tp = 1
     if head_axes is not None:
         for a in ((head_axes,) if isinstance(head_axes, str) else head_axes):
-            tp *= mesh.shape[a]
+            tp *= mesh.shape.get(a, 1)
     if (q.shape[1] // tp) % w or (k.shape[1] // tp) % w:
         raise ValueError(
             f"ulysses needs per-group q heads {q.shape[1]}/{tp} and kv heads "
@@ -115,7 +116,7 @@ def ulysses_attn(
     )
     qkv_spec = P(batch_axes, head_axes, seq_axis, None)
     if segment_ids is not None:
-        fn = jax.shard_map(
+        fn = shard_map(
             shard,
             mesh=mesh,
             in_specs=(qkv_spec,) * 3 + (P(batch_axes, None),),
@@ -123,7 +124,7 @@ def ulysses_attn(
             check_vma=False,
         )
         return fn(q, k, v, jnp.asarray(segment_ids, jnp.int32))
-    fn = jax.shard_map(
+    fn = shard_map(
         shard,
         mesh=mesh,
         in_specs=(qkv_spec,) * 3,

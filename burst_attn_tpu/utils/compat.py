@@ -1,0 +1,58 @@
+"""JAX version compatibility shims.
+
+The framework targets the current jax API (jax.shard_map with check_vma,
+pltpu.CompilerParams) but must also run on the 0.4.x line the container
+pins, where those spellings live in jax.experimental and carry their old
+names (shard_map's check_rep, pltpu.TPUCompilerParams).  Every call site
+goes through this module so the version probe happens exactly once.
+"""
+
+import jax
+
+_shard_map_new = getattr(jax, "shard_map", None)
+if _shard_map_new is None:
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+else:
+    _shard_map_old = None
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """jax.shard_map across versions; `check_vma` maps to the old check_rep."""
+    if _shard_map_new is not None:
+        return _shard_map_new(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma)
+    return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+def axis_size(axis_name):
+    """lax.axis_size across versions.
+
+    Older jax has no lax.axis_size; lax.psum(1, name) is the classic idiom
+    and constant-folds to a Python int under shard_map's static mesh."""
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def tpu_compiler_params(**kwargs):
+    """pltpu.CompilerParams (new) / pltpu.TPUCompilerParams (0.4.x)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def profile_options(host_tracer_level: int):
+    """jax.profiler.ProfileOptions, or None where the API predates it."""
+    cls = getattr(jax.profiler, "ProfileOptions", None)
+    if cls is None:
+        return None
+    opts = cls()
+    opts.host_tracer_level = host_tracer_level
+    return opts

@@ -32,9 +32,13 @@ def trace(log_dir: str, *, host_tracer_level: int = 2):
     the tool for confirming the ring's permute/compute overlap that the
     reference eyeballed with CUDA stream timing.
     """
-    opts = jax.profiler.ProfileOptions()
-    opts.host_tracer_level = host_tracer_level
-    jax.profiler.start_trace(log_dir, profiler_options=opts)
+    from .compat import profile_options
+
+    opts = profile_options(host_tracer_level)
+    if opts is not None:
+        jax.profiler.start_trace(log_dir, profiler_options=opts)
+    else:  # older jax: no ProfileOptions — default tracer levels
+        jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:

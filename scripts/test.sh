@@ -15,6 +15,12 @@ for a in "${args[@]}"; do
     *) filtered+=("$a") ;;
   esac
 done
+# burstlint pre-test gate: CPU-only static verification (ring invariants,
+# numerics contract, AST hygiene) in a few seconds — tier-1 fails on new
+# violations before any test runs.
+echo "== burstlint (python -m burst_attn_tpu.analysis) =="
+JAX_PLATFORMS=cpu python -m burst_attn_tpu.analysis
+
 if [[ $fast == 1 ]]; then
   python -m pytest tests/ -q -m "not slow" ${filtered[@]+"${filtered[@]}"}
 else

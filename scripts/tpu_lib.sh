@@ -7,8 +7,11 @@
 # Persistent compilation cache shared by every stage: a retried stage (the
 # tunnel can die mid-attempt, burning the timeout) must not re-pay remote
 # compiles its earlier attempt already completed.  Harmless if the PJRT
-# plugin doesn't support executable serialization.
-export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/root/repo/results/jax_cache}
+# plugin doesn't support executable serialization.  The default derives
+# from this script's own location so a checkout at any path caches inside
+# its own results/ instead of a foreign (possibly uncreatable) directory.
+_tpu_lib_repo_root=$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-"$_tpu_lib_repo_root/results/jax_cache"}
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-5}
 
 probe() {

@@ -1,0 +1,348 @@
+"""burstlint mutation suite: every rule must FIRE on a seeded defect with
+the right file:line, and stay QUIET on the real (fixed) codebase.
+
+The jaxpr-family mutations build deliberately-wrong ring shard programs
+(reversed rotation, dq that never returns home, swapped-pair permutation,
+un-truncated windowed ring, bf16 accumulator, downcast lse) and feed them
+through the same verifiers the CLI runs on the real entry points; the AST
+mutations are fixture files written to tmp_path.
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from burst_attn_tpu.analysis import astlint, numerics, oracle, ringcheck
+from burst_attn_tpu.analysis.core import RULES, run_analysis
+from burst_attn_tpu.parallel.ring import ppermute_by
+from burst_attn_tpu.utils.compat import shard_map
+
+ANCHOR = ("seeded.py", 7)
+
+
+def _mesh4():
+    return Mesh(np.asarray(jax.devices()[:4]), ("sp",))
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# registry / clean-run
+
+
+def test_at_least_8_rules_registered():
+    from burst_attn_tpu.analysis import astlint, numerics, ringcheck  # noqa: F401
+
+    assert len(RULES) >= 8
+    for expected in ("silent-except", "mesh-shape-index",
+                     "host-transfer-in-jit", "time-in-jit",
+                     "traced-bool-branch", "ring-rotation", "ring-hops",
+                     "ring-order", "dq-return-home", "window-truncation",
+                     "fp32-accum", "lse-fp32"):
+        assert expected in RULES, expected
+
+
+def test_clean_run_on_real_package():
+    findings = run_analysis()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_oracle_proves_itself():
+    for ni, na, rl in [(1, 4, None), (2, 4, None), (1, 8, 3)]:
+        oracle.verify_dq_returns_home(ni, na, rl)
+    # a tampered stream must NOT prove: live set that isn't a prefix
+    assert oracle.live_rounds_contig(64, 4, 20) == {0, 1, 2}
+
+
+# ---------------------------------------------------------------------------
+# jaxpr mutations — ring family
+
+
+def _trace_fwd_ring(hops_per_round):
+    """A fwd-like shard program: rotate a 2-leaf kv payload by the given
+    hop sizes (the healthy flat-4 ring is [1, 1, 1])."""
+    mesh = _mesh4()
+
+    def f(k, v):
+        kv = (k, v)
+        for h in hops_per_round:
+            kv = ppermute_by(kv, "sp", h)
+        return kv[0]
+
+    spec = P(None, None, "sp", None)
+    S = jax.ShapeDtypeStruct
+    q = S((1, 2, 64, 8), jnp.bfloat16)
+    fn = shard_map(f, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+                   check_vma=False)
+    return jax.make_jaxpr(fn)(q, q)
+
+
+def _verify_fwd(jx, **kw):
+    args = dict(kind="fwd", n_inter=1, n_intra=4, leaves_pay=2,
+                axis_map={"sp": "intra"}, where="seeded fwd", anchor=ANCHOR)
+    args.update(kw)
+    return ringcheck.verify_traced_ring(jx, **args)
+
+
+def test_healthy_ring_is_quiet():
+    assert _verify_fwd(_trace_fwd_ring([1, 1, 1])) == []
+
+
+def test_reversed_ring_permutation_fires():
+    # rank i -> i-1: the ring spins against the schedule
+    findings = _verify_fwd(_trace_fwd_ring([-1, -1, -1]))
+    assert "ring-order" in _rules_of(findings)
+    assert "ring-hops" in _rules_of(findings)
+    assert findings[0].file == "seeded.py" and findings[0].line == 7
+
+
+def test_extra_round_fires_hop_count():
+    findings = _verify_fwd(_trace_fwd_ring([1, 1, 1, 1]))
+    assert "ring-hops" in _rules_of(findings)
+
+
+def test_swapped_pair_permutation_fires_rotation():
+    mesh = _mesh4()
+
+    def f(x):
+        return jax.lax.ppermute(x, "sp", [(0, 1), (1, 0), (2, 3), (3, 2)])
+
+    fn = shard_map(f, mesh=mesh, in_specs=P("sp"), out_specs=P("sp"),
+                   check_vma=False)
+    jx = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((4, 8), jnp.bfloat16))
+    findings = _verify_fwd(jx, leaves_pay=1)
+    assert "ring-rotation" in _rules_of(findings)
+
+
+def _trace_bwd_ring(return_home):
+    """A bwd-like shard program with the 4-leaf payload and the f32 rank-4
+    dq accumulator of the real backward; `return_home=False` seeds the
+    defect — dq's final hop home is dropped."""
+    mesh = _mesh4()
+
+    def f(q, do, lse):
+        delta = lse
+        pay = (delta, do, q, lse)
+        dq = jnp.zeros(q.shape, jnp.float32)
+        pay = ppermute_by(pay, "sp", 1)         # jump (h=1 on a full ring)
+        for _ in range(2):                      # middle rounds
+            pay = ppermute_by(pay, "sp", 1)
+            dq = ppermute_by(dq, "sp", 1)
+        dq = ppermute_by(dq, "sp", 1)           # last round rotation
+        if return_home:
+            dq = ppermute_by(dq, "sp", 1)       # final return-home hop
+        return dq
+
+    spec4 = P(None, None, "sp", None)
+    spec3 = P(None, None, "sp")
+    S = jax.ShapeDtypeStruct
+    q = S((1, 2, 64, 8), jnp.bfloat16)
+    lse = S((1, 2, 64), jnp.float32)
+    fn = shard_map(f, mesh=mesh, in_specs=(spec4, spec4, spec3),
+                   out_specs=spec4, check_vma=False)
+    return jax.make_jaxpr(fn)(q, q, lse)
+
+
+def _verify_bwd(jx, **kw):
+    args = dict(kind="bwd", n_inter=1, n_intra=4, leaves_pay=4,
+                axis_map={"sp": "intra"}, where="seeded bwd", anchor=ANCHOR)
+    args.update(kw)
+    return ringcheck.verify_traced_ring(jx, **args)
+
+
+def test_healthy_bwd_ring_is_quiet():
+    assert _verify_bwd(_trace_bwd_ring(return_home=True)) == []
+
+
+def test_dq_not_returning_home_fires():
+    findings = _verify_bwd(_trace_bwd_ring(return_home=False))
+    assert "dq-return-home" in _rules_of(findings)
+    assert any(f.file == "seeded.py" and f.line == 7 for f in findings)
+
+
+def test_untruncated_window_ring_fires():
+    # band oracle proves 3 live rounds (seq=64, world=4, window=20) but the
+    # seeded ring still rotates the full n-1 = 3 hops
+    live = oracle.live_rounds_contig(64, 4, 20)
+    assert live == {0, 1, 2}
+    findings = _verify_fwd(_trace_fwd_ring([1, 1, 1]), r_live=len(live),
+                           window=True)
+    assert "window-truncation" in _rules_of(findings)
+
+
+def test_truncated_window_ring_is_quiet():
+    findings = _verify_fwd(_trace_fwd_ring([1, 1]), r_live=3, window=True)
+    assert "window-truncation" not in _rules_of(findings)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# jaxpr mutations — numerics family
+
+
+def test_bf16_accumulator_fires():
+    S = jax.ShapeDtypeStruct
+    q = S((1, 2, 64, 16), jnp.bfloat16)
+
+    def bad(q, k):  # bf16 dot WITHOUT a f32 accumulator
+        return jax.lax.dot_general(q[0, 0], k[0, 0], (((1,), (1,)), ((), ())))
+
+    jx = jax.make_jaxpr(bad)(q, q)
+    findings = numerics.check_trace(jx, where="seeded", anchor=ANCHOR)
+    assert _rules_of(findings) == {"fp32-accum"}
+    assert findings[0].file == "seeded.py" and findings[0].line == 7
+
+
+def test_f32_accumulator_is_quiet():
+    S = jax.ShapeDtypeStruct
+    q = S((1, 2, 64, 16), jnp.bfloat16)
+
+    def good(q, k):
+        return jax.lax.dot_general(q[0, 0], k[0, 0], (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    jx = jax.make_jaxpr(good)(q, q)
+    assert numerics.check_trace(jx, where="seeded", anchor=ANCHOR) == []
+
+
+def test_lse_downcast_fires():
+    S = jax.ShapeDtypeStruct
+    lse = S((1, 2, 64), jnp.float32)
+    jx = jax.make_jaxpr(lambda lse: lse.astype(jnp.bfloat16) * 1)(lse)
+    findings = numerics.check_trace(jx, where="seeded", anchor=ANCHOR)
+    assert _rules_of(findings) == {"lse-fp32"}
+
+
+# ---------------------------------------------------------------------------
+# AST mutations
+
+
+def _lint_fixture(tmp_path, source):
+    p = tmp_path / "fixture.py"
+    p.write_text(textwrap.dedent(source))
+    return astlint.lint_file(str(p))
+
+
+def test_bare_except_pass_fires(tmp_path):
+    findings = _lint_fixture(tmp_path, """\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """)
+    assert [(f.rule, f.line) for f in findings] == [("silent-except", 4)]
+
+
+def test_narrow_except_pass_is_flow_control(tmp_path):
+    findings = _lint_fixture(tmp_path, """\
+        def f(it):
+            try:
+                next(it)
+            except StopIteration:
+                pass
+    """)
+    assert findings == []
+
+
+def test_mesh_shape_index_fires(tmp_path):
+    findings = _lint_fixture(tmp_path, """\
+        def f(mesh, axes):
+            return [mesh.shape[a] for a in axes]
+    """)
+    assert [(f.rule, f.line) for f in findings] == [("mesh-shape-index", 2)]
+
+
+def test_mesh_shape_get_is_quiet(tmp_path):
+    findings = _lint_fixture(tmp_path, """\
+        def f(mesh, axes):
+            return [mesh.shape.get(a, 1) for a in axes]
+    """)
+    assert findings == []
+
+
+def test_host_transfer_and_time_and_branch_fire(tmp_path):
+    findings = _lint_fixture(tmp_path, """\
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            a = x.item()
+            b = jax.device_get(x)
+            c = float(jnp.sum(x))
+            t = time.time()
+            if jnp.sum(x) > 0:
+                return a
+            return b
+    """)
+    got = sorted((f.rule, f.line) for f in findings)
+    assert got == [
+        ("host-transfer-in-jit", 7),
+        ("host-transfer-in-jit", 8),
+        ("host-transfer-in-jit", 9),
+        ("time-in-jit", 10),
+        ("traced-bool-branch", 11),
+    ]
+
+
+def test_host_code_outside_jit_is_quiet(tmp_path):
+    findings = _lint_fixture(tmp_path, """\
+        import time
+        import jax.numpy as jnp
+
+        def host_loop(x):
+            t = time.time()
+            v = float(jnp.sum(x))
+            if jnp.sum(x) > 0:
+                return v
+            return t
+    """)
+    assert findings == []
+
+
+def test_jit_context_through_wrapper_reference(tmp_path):
+    # f is never decorated but is passed to lax.scan — still a jit context
+    findings = _lint_fixture(tmp_path, """\
+        import time
+        from jax import lax
+
+        def body(carry, x):
+            t = time.time()
+            return carry, x
+
+        def run(xs):
+            return lax.scan(body, 0, xs)
+    """)
+    assert [(f.rule, f.line) for f in findings] == [("time-in-jit", 5)]
+
+
+def test_suppression_comment_silences(tmp_path):
+    findings = _lint_fixture(tmp_path, """\
+        def f(mesh, a):
+            return mesh.shape[a]  # burstlint: disable=mesh-shape-index
+    """)
+    assert findings == []
+
+
+def test_cli_exits_zero_on_repo():
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "burst_attn_tpu.analysis", "--json"],
+        capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    import json
+
+    d = json.loads(r.stdout)
+    assert len(d["rules_registered"]) >= 8
+    assert d["n_findings"] == 0

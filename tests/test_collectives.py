@@ -6,6 +6,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from burst_attn_tpu.parallel import collectives as C
+from burst_attn_tpu.utils.compat import shard_map
 
 
 def _mesh():
@@ -13,7 +14,7 @@ def _mesh():
 
 
 def _run(fn, x, out_specs=P("sp")):
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=_mesh(), in_specs=P("sp"), out_specs=out_specs, check_vma=False
     )(x)
 

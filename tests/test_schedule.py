@@ -11,6 +11,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 import pytest
 
 from burst_attn_tpu.parallel.ring import partition_at_round, ring_schedule
+from burst_attn_tpu.utils.compat import shard_map
 
 
 @pytest.mark.parametrize("shape", [(8,), (2, 4), (4, 2)])
@@ -29,7 +30,7 @@ def test_schedule_matches_host_expectation(shape):
                for r in range(world)]
         return jnp.stack(ids)[None] + 0 * x.astype(jnp.int32)
 
-    out = jax.shard_map(
+    out = shard_map(
         fn, mesh=mesh,
         in_specs=P(names if len(names) > 1 else names[0]),
         out_specs=P(names if len(names) > 1 else names[0], None),
