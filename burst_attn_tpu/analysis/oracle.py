@@ -201,6 +201,100 @@ def verify_dq_returns_home(n_inter: int, n_intra: int, r_live=None) -> None:
 
 
 # ---------------------------------------------------------------------------
+# fused ring (in-kernel RDMA rotation, ops/fused_ring.py)
+
+
+def fused_slot_schedule(world: int, slots: int) -> List[int]:
+    """Independent derivation of the fused kernel's per-round KV-slot ids
+    (duplicated from parallel/ring.fused_slot_schedule on purpose — the
+    analyzer must not trust the code under test).  Round r consumes slot
+    r mod C with C = min(slots, world)."""
+    return [r % min(slots, world) for r in range(world)]
+
+
+def verify_fused_ring(world: int, slots: int, slot_sched=None) -> None:
+    """Prove by simulation that the fused ring's schedule + semaphore
+    protocol is correct, raising AssertionError otherwise:
+
+      delivery      with every device sending its round-r chunk from
+                    slot[r] to the RIGHT neighbor's slot[r+1], the chunk a
+                    device reads at round r is partition ring_schedule[d, r]
+                    — i.e. neighbor-only (+1) sends reproduce the exact
+                    schedule the scan ring realizes with ppermute.
+      hop count     every chunk travels exactly world - 1 hops (each of the
+                    world - 1 per-device sends moves one chunk one hop).
+      slot safety   under the capacity handshake (a sender at round
+                    r >= C-1 consumes a free credit the receiver grants
+                    only after finishing round r+1-C), a maximally-ahead
+                    sender can never overwrite a slot version the receiver
+                    has not consumed yet.  Simulated with the sender
+                    running unboundedly ahead of the receiver.
+    """
+    C = min(slots, world)
+    assert C >= 2, f"fused ring needs >= 2 slots, got {slots}"
+    if slot_sched is None:
+        slot_sched = fused_slot_schedule(world, slots)
+    slot_sched = [int(x) for x in slot_sched]
+    assert len(slot_sched) == world, (len(slot_sched), world)
+    assert all(0 <= s < C for s in slot_sched), slot_sched
+
+    # ---- delivery + hop count (lockstep rounds) ----
+    sched = ring_schedule(world, 1)
+    buf = [{slot_sched[0]: d} for d in range(world)]  # slot -> partition id
+    hops = {d: 0 for d in range(world)}  # partition -> hops traveled
+    for r in range(world):
+        sends = []
+        for d in range(world):
+            assert slot_sched[r] in buf[d], (
+                f"device {d} round {r}: slot {slot_sched[r]} never written")
+            part = buf[d][slot_sched[r]]
+            assert part == int(sched[d, r]), (
+                f"device {d} round {r}: holds partition {part}, schedule "
+                f"says {int(sched[d, r])}")
+            if r < world - 1:
+                sends.append(((d + 1) % world, slot_sched[r + 1], part))
+        for dst_dev, dst_slot, part in sends:  # all transfers in flight at once
+            buf[dst_dev][dst_slot] = part
+            hops[part] += 1
+    for part, h in hops.items():
+        assert h == world - 1, f"partition {part} made {h} hops, not {world - 1}"
+
+    # ---- slot safety: maximally-ahead sender vs slowest receiver ----
+    # Versions: the receiver must read version r of slot[r] at round r
+    # (version 0 = its own initial copy-in).  The sender may issue the
+    # round-r send as soon as its credits allow; each grant is emitted when
+    # the receiver FINISHES round t (t <= world-1-C).
+    consumed = 0          # receiver's completed rounds
+    credits = 0           # unconsumed free credits held by the sender
+    slot_version = {slot_sched[0]: 0}
+    pending = []          # writes the receiver has not yet read
+    for rs in range(world - 1):  # sender's rounds, run as early as possible
+        if rs >= C - 1:
+            # sender needs one credit: receiver must have finished rounds
+            # up to rs + 1 - C before this write may land
+            while credits == 0:
+                # receiver consumes its next round
+                t = consumed
+                got = slot_version.get(slot_sched[t])
+                assert got == t, (
+                    f"receiver reads slot {slot_sched[t]} at round {t} but "
+                    f"holds version {got} — overwritten before read")
+                consumed += 1
+                if t <= world - 1 - C:
+                    credits += 1
+            credits -= 1
+        assert consumed >= rs + 1 - C, (consumed, rs)
+        slot_version[slot_sched[rs + 1]] = rs + 1
+    while consumed < world:  # receiver drains the tail
+        t = consumed
+        got = slot_version.get(slot_sched[t])
+        assert got == t, (
+            f"receiver reads slot {slot_sched[t]} at round {t} but holds "
+            f"version {got} — overwritten before read")
+        consumed += 1
+
+
+# ---------------------------------------------------------------------------
 # windowed truncation
 
 

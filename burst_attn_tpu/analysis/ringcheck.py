@@ -49,6 +49,12 @@ rule("dq-return-home", "jaxpr",
      "bwd dq ring stream matches the proven return-home schedule")(None)
 rule("window-truncation", "jaxpr",
      "windowed ring truncation matches the dense band-mask live set")(None)
+rule("fused-ring-schedule", "jaxpr",
+     "fused kernel slot schedule matches the oracle; delivery, hop-count "
+     "and overwrite-before-read safety proven by simulation")(None)
+rule("fused-ring-fused", "jaxpr",
+     "fused forward issues zero XLA collectives and exactly one remote-"
+     "copy pair (k, v) per ring hop, with fp32-accum numerics")(None)
 
 
 @dataclass
@@ -294,6 +300,113 @@ def verify_ring_entry(entry: RingEntry) -> List[Finding]:
     return findings
 
 
+def verify_fused_ring() -> List[Finding]:
+    """Fused ring (ops/fused_ring.py) rules.
+
+    Schedule family: the slot schedule the kernel consumes (exported by
+    parallel/ring.fused_slot_schedule and delivered via scalar prefetch) is
+    matched against the oracle's independent derivation, and the oracle
+    PROVES — by simulating a maximally-ahead sender against the capacity
+    handshake — neighbor-only delivery of ring_schedule, exactly world-1
+    hops per chunk, and that no slot is overwritten before its last read.
+
+    Jaxpr family: the fused forward shard program is traced abstractly on a
+    simulated mesh and must contain ZERO XLA collectives (ppermute /
+    all_to_all / psum on the ring payload — the whole point of the fused
+    path) and exactly 2 remote dma_starts inside the kernel (one per
+    operand per hop; more would double-send, fewer would starve the ring);
+    the kernel's dots are also run through the fp32-accum/lse-fp32
+    numerics contract."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..ops import fused_ring as fr
+    from ..parallel import burst, ring
+    from ..utils.compat import shard_map
+    from . import numerics
+    from .jaxpr_tools import iter_eqns
+
+    findings: List[Finding] = []
+    anchor_plan = _anchor(ring.fused_slot_schedule)
+    for world, slots in ((2, 2), (4, 2), (8, 2), (8, 3), (8, 8)):
+        got = [int(x) for x in ring.fused_slot_schedule(world, slots)]
+        want = oracle.fused_slot_schedule(world, slots)
+        if got != want:
+            findings.append(Finding(
+                rule="fused-ring-schedule", file=anchor_plan[0],
+                line=anchor_plan[1],
+                message=f"world={world} slots={slots}: exported slot "
+                        f"schedule {got} != oracle derivation {want}"))
+            continue
+        try:
+            oracle.verify_fused_ring(world, slots, got)
+        except AssertionError as e:
+            findings.append(Finding(
+                rule="fused-ring-schedule", file=anchor_plan[0],
+                line=anchor_plan[1],
+                message=f"world={world} slots={slots}: schedule proof "
+                        f"failed: {e}"))
+
+    # ---- traced structure of the fused forward ----
+    anchor = _anchor(fr.fused_ring_fwd)
+    devs = jax.devices()
+    world = 4
+    if len(devs) < world:
+        raise RuntimeError(
+            f"analysis needs {world} simulated devices "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count=8); "
+            f"have {len(devs)}")
+    mesh = Mesh(np.asarray(devs[:world]), ("sp",))
+    b, n, d, s_local = 1, 2, 8, 16
+    S = jax.ShapeDtypeStruct
+    q = S((b, n, s_local * world, d), jnp.bfloat16)
+    spec4 = P(None, None, "sp", None)
+    spec3 = P(None, None, "sp")
+    # make_jaxpr never executes, but the dispatch's supported() gate reads
+    # the interpret opt-in off-TPU — enable it for the trace only
+    prev = os.environ.get("BURST_FUSED_INTERPRET")
+    os.environ["BURST_FUSED_INTERPRET"] = "1"
+    try:
+        for layout, causal in (("zigzag", True), ("striped", True),
+                               ("contig", False)):
+            cfg = burst.BurstConfig(causal=causal, layout=layout,
+                                    intra_axis="sp", backend="fused_ring")
+            fwd = shard_map(lambda q, k, v: burst._fwd_impl(q, k, v, cfg),
+                            mesh=mesh, in_specs=(spec4,) * 3,
+                            out_specs=(spec4, spec3), check_vma=False)
+            jx = jax.make_jaxpr(fwd)(q, q, q)
+            where = f"fused-{layout}{'-causal' if causal else ''}"
+            colls = [e for e in collect_collectives(jx)
+                     if e.prim in ("ppermute", "all_to_all")]
+            if colls:
+                findings.append(Finding(
+                    rule="fused-ring-fused", file=anchor[0], line=anchor[1],
+                    message=f"{where}: fused forward issues XLA collectives "
+                            f"{[(e.prim, e.axis) for e in colls]} — the ring "
+                            "must live entirely inside the kernel"))
+            remote = [e for e in iter_eqns(jx)
+                      if e.primitive.name == "dma_start"
+                      and e.params.get("device_id_type") is not None
+                      and "LOGICAL" in str(e.params["device_id_type"]).upper()]
+            if len(remote) != 2:
+                findings.append(Finding(
+                    rule="fused-ring-fused", file=anchor[0], line=anchor[1],
+                    message=f"{where}: expected exactly 2 remote dma_starts "
+                            f"(k and v, one hop each per round), traced "
+                            f"{len(remote)}"))
+            findings += numerics.check_trace(jx, where=where, anchor=anchor)
+    finally:
+        if prev is None:
+            os.environ.pop("BURST_FUSED_INTERPRET", None)
+        else:
+            os.environ["BURST_FUSED_INTERPRET"] = prev
+    return findings
+
+
 def verify_ulysses() -> List[Finding]:
     """Ulysses a2a contract: exactly 4 all_to_alls (q, k, v in; o out) on
     the sequence axis, no ppermutes, none conditional."""
@@ -344,5 +457,6 @@ def check_all() -> List[Finding]:
     findings: List[Finding] = []
     for entry in ENTRIES:
         findings += verify_ring_entry(entry)
+    findings += verify_fused_ring()
     findings += verify_ulysses()
     return findings

@@ -1,0 +1,511 @@
+"""Fused on-device ring attention: the whole W-round forward ring in ONE
+Pallas kernel, with neighbor KV rotation done by in-kernel inter-chip RDMA
+(`pltpu.make_async_remote_copy`) instead of per-round `lax.ppermute`
+collectives between per-round kernel launches.
+
+Why.  The scan-based ring (parallel/burst._fwd_impl) realizes BurstAttention's
+comm/compute overlap as "XLA hopefully schedules the async collective-permute
+behind the next round's pallas_call" — every round pays a kernel relaunch plus
+an XLA collective boundary, and the overlap is a compiler scheduling outcome,
+not a property of the program.  Here the overlap is owned by the kernel by
+construction:
+
+  * KV communication buffers are a rotating set of `slots` (>= 2, from the
+    per-generation table in ops/tuning.py) HBM slots per operand; the slot a
+    round reads and the slot a send writes come from ONE exported schedule
+    (parallel/ring.fused_slot_schedule), delivered to the kernel via scalar
+    prefetch — burstlint re-derives and proves that schedule independently
+    (analysis/oracle.verify_fused_ring) and matches it against this module.
+  * At the first grid step of round r the kernel waits the slot's recv
+    semaphore (round r's chunk has LANDED), then immediately starts the RDMA
+    of that chunk to the right neighbor's slot[r+1].  The transfer is in
+    flight for the entire round-r compute sweep — one full round of
+    FlashAttention tiles across every (batch, head, q-block) — before round
+    r+1 waits on it.  No collective barrier ever splits the instruction
+    stream.
+  * Double-buffer safety is a semaphore protocol, not a compiler contract:
+    DMA send/recv semaphores per slot, plus (hardware only) a capacity
+    handshake — a device signals its LEFT neighbor's free semaphore when a
+    slot's last reader is done, and a sender must take one free credit before
+    overwriting a previously-used remote slot.  All semaphores provably
+    drain to zero (counts are matched per round; see the choreography notes
+    in _fused_fwd_kernel).
+
+Compute path.  Per grid step (r, b, h, i) the kernel folds q-block i against
+the WHOLE resident KV chunk: the chunk is copied HBM-slot -> VMEM once per
+(round, batch, kv-head) and every q-block sweeps it from VMEM — KV streaming
+traffic is per-chunk, not per-(q-block, kv-block) as in the scan path's
+per-round grids.  The online-softmax state is split by size: m/l row stats
+live VMEM-resident for the entire kernel (packed [B, N, S/lp, lp] exactly
+like pallas_flash's packed-stats layout), while the [bq, D] f32 accumulator
+round-trips an HBM scratch between rounds via manual async copies (the same
+traffic the scan path pays implicitly via its m/lse/acc in/out operands).
+Rounds merge by the standard two-state softmax combine (split-k style), so
+the acc load overlaps the whole local sweep.
+
+Interpret mode.  jax's dma_start discharge rule emulates remote copies over
+a single named mesh axis, so THIS kernel — same slots, same schedule, same
+masks — runs on a simulated CPU mesh (tests/test_fused_ring.py).  Remote
+semaphore signals are not emulated, so the hardware-only capacity handshake
+and the startup barrier are statically gated on `interpret` (in the
+discharged program every copy lands synchronously at issue, so the hazards
+those guards exist for cannot occur).
+
+Supported: single ring (no inter axis), equal q/kv shard lengths, no sliding
+window, no packed segments, world >= 2, ring axis the only size>1 named axis
+in scope.  Everything else falls back to the scan ring in parallel/burst.py
+(see `supported`).  The backward keeps the scan path in this revision; the
+dispatch is structured so a fused dq ring slots in behind the same schedule
+export without touching callers.
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .masks import round_spec
+from .pallas_flash import (
+    LN2,
+    LOG2E,
+    NEG_INF,
+    VMEM_LIMIT,
+    _block_full,
+    _block_has_work,
+    _block_mask,
+    _pick_block,
+    _spec_array,
+    _unpack,
+)
+from .tuning import resolve_fused
+from ..parallel.ring import (
+    fused_slot_schedule,
+    my_partition,
+    neighbor_ids,
+    partition_at_round,
+)
+from ..utils.compat import axis_size, tpu_compiler_params
+
+# barrier-semaphore namespace for the startup neighbor barrier; any stable
+# id distinct from other collective pallas kernels in the same program works
+_COLLECTIVE_ID = 13
+
+
+def interpret_enabled() -> bool:
+    """BURST_FUSED_INTERPRET=1 lets `backend="fused_ring"` run the REAL fused
+    kernel under the pallas interpreter off-TPU (the parity tests set this);
+    default off-TPU behavior is the scan-ring fallback, because the
+    interpreted ring is orders of magnitude slower than the jnp scan path."""
+    return os.environ.get("BURST_FUSED_INTERPRET", "").strip().lower() not in (
+        "", "0", "false")
+
+
+def _extra_named_axes(intra_axis: str):
+    """Other size>1 named axes bound in the current trace (shard_map scope).
+
+    The kernel addresses its neighbor by LOGICAL device id computed from the
+    ring axis index alone, which is only the right address when the ring
+    axis is the sole partitioned axis; jax's interpret-mode DMA discharge
+    has the same single-axis restriction.  Returns None when the axis-env
+    API is unavailable (treated as unknown -> unsupported, fail safe)."""
+    try:
+        from jax._src.core import get_axis_env
+
+        sizes = dict(get_axis_env().axis_sizes)
+    except Exception:  # noqa: BLE001 — private-API probe; absence != error
+        return None
+    return [a for a, sz in sizes.items()
+            if a is not None and a != intra_axis and sz and sz > 1]
+
+
+def supported(cfg, q_shape, k_shape, has_segments: bool, *,
+              interpret=None):
+    """None if the fused ring can run this config, else a reason string the
+    dispatch logs / the tests assert on.  Must be called at trace time
+    (inside shard_map) — the axis-env and mesh-size probes read the trace
+    context."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret and not interpret_enabled():
+        return "off-TPU (set BURST_FUSED_INTERPRET=1 to run interpreted)"
+    if cfg.inter_axis is not None:
+        return "double ring (inter axis) not fused yet"
+    if cfg.window is not None:
+        return "sliding window not fused yet"
+    if has_segments:
+        return "packed segments not fused yet"
+    b, n, s, d = q_shape
+    if k_shape[2] != s:
+        return "cross-attention shard lengths"
+    world = axis_size(cfg.intra_axis)
+    if world < 2:
+        return "world < 2 (nothing to rotate)"
+    extra = _extra_named_axes(cfg.intra_axis)
+    if extra is None or extra:
+        return (f"ring axis must be the only partitioned axis in scope "
+                f"(found {extra})")
+    rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
+                       cfg.fused_kv_slots)
+    # VMEM plan: resident k+v chunk, packed m/l stats, acc staging — counted
+    # against the per-generation budget (4-byte worst case per element) so
+    # an oversized shard falls back instead of failing Mosaic allocation
+    # mid-ring
+    bq = _pick_block(s, rf.block_q)
+    vmem = 2 * s * d * 4 + 2 * b * n * s * 4 + 3 * bq * d * 4
+    if vmem > rf.vmem_budget:
+        return (f"VMEM plan {vmem} bytes exceeds fused budget "
+                f"{rf.vmem_budget}")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# packed m/l stats access ([B, N, S/lp, lp] refs — pallas_flash's packed
+# layout with explicit (batch, head) indices instead of pre-blocked refs)
+
+
+def _stat_read(ref, b_, h, i, bq, lp):
+    """Rows [i*bq, (i+1)*bq) of a packed [B, N, S/lp, lp] stats ref -> (bq, 1)."""
+    rows = bq // lp
+    pack = ref[b_, h, pl.ds(i * rows, rows), :]
+    if lp == 1:
+        return pack
+    rep = jnp.repeat(pack, lp, axis=0)  # (bq, lp); row t = pack[t // lp]
+    t_lane = jax.lax.broadcasted_iota(jnp.int32, (bq, lp), 0) % lp
+    c_idx = jax.lax.broadcasted_iota(jnp.int32, (bq, lp), 1)
+    return jnp.sum(jnp.where(t_lane == c_idx, rep, 0.0), axis=1, keepdims=True)
+
+
+def _stat_write(ref, b_, h, i, col, bq, lp):
+    rows = bq // lp
+    ref[b_, h, pl.ds(i * rows, rows), :] = jnp.reshape(col, (rows, lp))
+
+
+# ---------------------------------------------------------------------------
+# kernel
+
+
+def _fused_fwd_kernel(
+    sched_ref,
+    q_ref, k_hbm, v_hbm,
+    o_ref, lse_ref,
+    kbuf, vbuf, kchunk, vchunk, mstat, lstat, accbuf, acc_in, acc_scr,
+    m_sw, l_sw,
+    cp_sem, chunk_sem, acc_sem, ksend, krecv, vsend, vrecv, free_sem,
+    *, world, slots, scale, bq, bkv, lp, nqb, nkb, group, n_b, n_h, hw_sync,
+):
+    """One grid step = q-block i of head h, batch b_, ring round r.
+
+    sched_ref is the [world + 1, 6] prefetch table: rows 0..world-1 hold the
+    per-round (q_lo, q_hi, kv_hi, causal, offset, slot) — mask scalars from
+    ops/masks.round_spec plus the exported slot schedule — and row `world`
+    holds (me, right, left, 0, 0, 0) neighbor ids.
+
+    Semaphore ledger (everything drains to zero):
+      krecv/vrecv[slot]  +1 per arriving send (left neighbor, rounds 1..W-1)
+                         -1 at the round's first grid step
+      ksend/vsend[slot]  +1 per outgoing send (rounds 0..W-2)
+                         -1 at the same round's last grid step (drain)
+      free_sem (hw only) +1 from the right neighbor when our send's target
+                         slot is reusable; sends at rounds >= slots-1 take
+                         one credit; we grant the LEFT neighbor a credit at
+                         the end of rounds 0..W-1-slots.  Credits granted ==
+                         credits taken == max(0, W-1-(slots-1)).
+    """
+    r = pl.program_id(0)
+    b_ = pl.program_id(1)
+    h = pl.program_id(2)
+    i = pl.program_id(3)
+    right = sched_ref[world, 1]
+    left = sched_ref[world, 2]
+    slot = sched_ref[r, 5]
+    first_of_round = (b_ == 0) & (h == 0) & (i == 0)
+    last_of_round = (b_ == n_b - 1) & (h == n_h - 1) & (i == nqb - 1)
+
+    # ---- round choreography (first grid step of the round only) ----
+    @pl.when(first_of_round & (r == 0))
+    def _copy_in():
+        # local chunk -> slot[0]: one HBM->HBM copy so every later round
+        # (compute reads, RDMA sends) addresses kbuf/vbuf slots uniformly
+        ck = pltpu.make_async_copy(k_hbm, kbuf.at[slot], cp_sem.at[0])
+        cv = pltpu.make_async_copy(v_hbm, vbuf.at[slot], cp_sem.at[1])
+        ck.start()
+        cv.start()
+        ck.wait()
+        cv.wait()
+
+    if hw_sync:
+        @pl.when(first_of_round & (r == 0))
+        def _barrier():
+            # neighbors must have entered the kernel (buffers live) before
+            # any RDMA writes their slots
+            bar = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(bar, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_signal(bar, inc=1, device_id=right,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_wait(bar, 2)
+
+    @pl.when(first_of_round & (r > 0))
+    def _recv_wait():
+        # round r's chunk must have LANDED in slot[r] before compute or the
+        # onward send may read it
+        pltpu.semaphore_wait(krecv.at[slot], 1)
+        pltpu.semaphore_wait(vrecv.at[slot], 1)
+
+    @pl.when(first_of_round & (r < world - 1))
+    def _send_onward():
+        dst_slot = sched_ref[r + 1, 5]
+        if hw_sync:
+            @pl.when(r >= slots - 1)
+            def _capacity():
+                # target slot was last read by the neighbor at round
+                # r + 1 - slots; take one free credit proving it finished
+                pltpu.semaphore_wait(free_sem, 1)
+        sk = pltpu.make_async_remote_copy(
+            src_ref=kbuf.at[slot], dst_ref=kbuf.at[dst_slot],
+            send_sem=ksend.at[dst_slot], recv_sem=krecv.at[dst_slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        sv = pltpu.make_async_remote_copy(
+            src_ref=vbuf.at[slot], dst_ref=vbuf.at[dst_slot],
+            send_sem=vsend.at[dst_slot], recv_sem=vrecv.at[dst_slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        sk.start()
+        sv.start()
+        # no wait here: the transfer overlaps this whole round's sweep; the
+        # drain wait sits at the round's LAST grid step below
+
+    # ---- per-(round, batch, kv-head) chunk load: HBM slot -> VMEM ----
+    @pl.when((i == 0) & (h % group == 0))
+    def _chunk_load():
+        kvh = h // group
+        lk = pltpu.make_async_copy(kbuf.at[slot, b_, kvh], kchunk,
+                                   chunk_sem.at[0])
+        lv = pltpu.make_async_copy(vbuf.at[slot, b_, kvh], vchunk,
+                                   chunk_sem.at[1])
+        lk.start()
+        lv.start()
+        lk.wait()
+        lv.wait()
+
+    # ---- start the acc carry load early: it overlaps the whole sweep ----
+    @pl.when(r > 0)
+    def _acc_load_start():
+        pltpu.make_async_copy(accbuf.at[b_, h, i], acc_in,
+                              acc_sem.at[0]).start()
+
+    # ---- local online-softmax sweep over this round's chunk ----
+    spec_r = tuple(sched_ref[r, c] for c in range(5))
+    r0 = i * bq
+    m_sw[:] = jnp.full_like(m_sw, NEG_INF)
+    l_sw[:] = jnp.zeros_like(l_sw)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+    q_t = q_ref[0, 0, :, :] * (scale * LOG2E)
+
+    def _fold(c0, mask):
+        ks = kchunk[pl.ds(c0, bkv), :]
+        s_t = jax.lax.dot_general(
+            q_t, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if mask is not None:
+            s_t = jnp.where(mask, s_t, NEG_INF)
+        m_prev = m_sw[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s_t, axis=1, keepdims=True))
+        alpha = jnp.where(m_prev >= m_new, 1.0, jnp.exp2(m_prev - m_new))
+        p = jnp.exp2(s_t - m_new)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)  # all-masked-row nan guard
+        l_sw[:] = l_sw[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_sw[:] = m_new
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(vchunk.dtype), vchunk[pl.ds(c0, bkv), :],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    for j in range(nkb):
+        c0 = j * bkv
+        live = _block_has_work(spec_r, r0, c0, bq, bkv)
+        full = _block_full(spec_r, r0, c0, bq, bkv)
+
+        @pl.when(live & full)
+        def _fast(c0=c0):
+            _fold(c0, None)
+
+        @pl.when(live & ~full)
+        def _masked(c0=c0):
+            _fold(c0, _block_mask(spec_r, r0, c0, bq, bkv))
+
+    # ---- merge with the carried state (split-k style combine) ----
+    @pl.when(r == 0)
+    def _init_state():
+        # round 0 is always the self round: no carry, state = local sweep
+        _stat_write(mstat, b_, h, i, m_sw[:], bq, lp)
+        _stat_write(lstat, b_, h, i, l_sw[:], bq, lp)
+
+    @pl.when(r > 0)
+    def _merge():
+        m1 = _stat_read(mstat, b_, h, i, bq, lp)
+        l1 = _stat_read(lstat, b_, h, i, bq, lp)
+        m2, l2 = m_sw[:], l_sw[:]
+        m = jnp.maximum(m1, m2)
+        a1 = jnp.where(m1 == NEG_INF, 0.0, jnp.exp2(m1 - m))
+        a2 = jnp.where(m2 == NEG_INF, 0.0, jnp.exp2(m2 - m))
+        pltpu.make_async_copy(accbuf.at[b_, h, i], acc_in,
+                              acc_sem.at[0]).wait()
+        acc_scr[:] = acc_in[:] * a1 + acc_scr[:] * a2
+        _stat_write(mstat, b_, h, i, m, bq, lp)
+        _stat_write(lstat, b_, h, i, l1 * a1 + l2 * a2, bq, lp)
+
+    @pl.when(r < world - 1)
+    def _acc_store():
+        st = pltpu.make_async_copy(acc_scr, accbuf.at[b_, h, i],
+                                   acc_sem.at[1])
+        st.start()
+        st.wait()
+
+    @pl.when(r == world - 1)
+    def _finalize():
+        # fused finalize: o = acc / l in the caller's dtype; lse back to the
+        # natural-log domain, packed rows into the resident lse out block
+        m = _stat_read(mstat, b_, h, i, bq, lp)
+        l = _stat_read(lstat, b_, h, i, bq, lp)
+        o_ref[0, 0, :, :] = jnp.where(
+            l > 0, acc_scr[:] / l, 0.0).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m * LN2 + jnp.log(l), NEG_INF)
+        rows = bq // lp
+        lse_ref[b_, h, pl.ds(i * rows, rows), :] = jnp.reshape(
+            lse, (rows, lp))
+
+    # ---- round epilogue (last grid step of the round only) ----
+    @pl.when(last_of_round & (r < world - 1))
+    def _send_drain():
+        # our outgoing RDMA read slot[r]; it must be out the door before the
+        # left neighbor may overwrite that slot (free credit below) and
+        # before the kernel may exit with a live DMA
+        dst_slot = sched_ref[r + 1, 5]
+        pltpu.semaphore_wait(ksend.at[dst_slot], 1)
+        pltpu.semaphore_wait(vsend.at[dst_slot], 1)
+
+    if hw_sync:
+        @pl.when(last_of_round & (r <= world - 1 - slots))
+        def _grant_free():
+            # slot[r] has no further readers here: every q-block consumed it
+            # and our own onward send drained — the LEFT neighbor (writer of
+            # our slots) may now target it at its round r + slots - 1
+            pltpu.semaphore_signal(free_sem, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+# ---------------------------------------------------------------------------
+# shard-level entry point
+
+
+def fused_ring_fwd(q, k, v, cfg, *, interpret=None):
+    """Forward burst attention on per-shard arrays via the fused ring kernel.
+
+    Call inside shard_map on the ring axis (same contract as
+    parallel/burst._fwd_impl): q [B, N, S, D], k/v [B, Nk, S, D] in layout
+    order.  Returns (o [B, N, S, D] in q.dtype, lse [B, N, S] f32).
+    Callers must have checked `supported` first.
+    """
+    b, n, s, d = q.shape
+    n_kv = k.shape[1]
+    assert n % n_kv == 0, f"GQA needs Nq % Nk == 0, got {n} % {n_kv}"
+    group = n // n_kv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = cfg.scale if cfg.scale is not None else d ** -0.5
+    world = axis_size(cfg.intra_axis)
+    rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
+                       cfg.fused_kv_slots)
+    slots = min(rf.kv_slots, world)
+    bq = _pick_block(s, rf.block_q)
+    bkv = _pick_block(s, rf.block_kv)
+    lp = _pick_block(bq, 128)
+    nqb = s // bq
+    nkb = s // bkv
+
+    # [world + 1, 6] schedule table (see _fused_fwd_kernel docstring): mask
+    # scalars reuse the SAME per-round specs the scan ring computes, so the
+    # two paths mask identically by construction
+    part_me = my_partition(cfg.intra_axis, None)
+    slot_sched = fused_slot_schedule(world, slots)
+    rows = []
+    for r in range(world):
+        sp = round_spec(part_me, partition_at_round(r, cfg.intra_axis, None),
+                        s, s, cfg.causal, cfg.layout)
+        rows.append(jnp.concatenate(
+            [_spec_array(sp),
+             jnp.asarray([int(slot_sched[r])], jnp.int32)]))
+    me, right, left = neighbor_ids(cfg.intra_axis)
+    rows.append(jnp.stack([jnp.asarray(me, jnp.int32),
+                           jnp.asarray(right, jnp.int32),
+                           jnp.asarray(left, jnp.int32),
+                           jnp.int32(0), jnp.int32(0), jnp.int32(0)]))
+    sched = jnp.stack(rows)
+
+    kernel = functools.partial(
+        _fused_fwd_kernel, world=world, slots=slots, scale=scale, bq=bq,
+        bkv=bkv, lp=lp, nqb=nqb, nkb=nkb, group=group, n_b=b, n_h=n,
+        hw_sync=not interpret,
+    )
+
+    def q_map(r, b_, h, i, sp):
+        return (b_, h, i, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(world, b, n, nqb),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), q_map),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), q_map),
+            # whole-array resident block: written row-range-wise at the last
+            # round, flushed once (block dims == array dims, always legal)
+            pl.BlockSpec((b, n, s // lp, lp),
+                         lambda r, b_, h, i, sp: (0, 0, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.ANY((slots, b, n_kv, s, d), k.dtype),   # kbuf
+            pltpu.ANY((slots, b, n_kv, s, d), v.dtype),   # vbuf
+            pltpu.VMEM((s, d), k.dtype),                  # kchunk
+            pltpu.VMEM((s, d), v.dtype),                  # vchunk
+            pltpu.VMEM((b, n, s // lp, lp), jnp.float32),  # mstat (base-2)
+            pltpu.VMEM((b, n, s // lp, lp), jnp.float32),  # lstat (linear)
+            pltpu.ANY((b, n, nqb, bq, d), jnp.float32),   # accbuf (carry)
+            pltpu.VMEM((bq, d), jnp.float32),             # acc_in
+            pltpu.VMEM((bq, d), jnp.float32),             # acc_scr
+            pltpu.VMEM((bq, 1), jnp.float32),             # m_sw
+            pltpu.VMEM((bq, 1), jnp.float32),             # l_sw
+            pltpu.SemaphoreType.DMA((2,)),                # cp_sem
+            pltpu.SemaphoreType.DMA((2,)),                # chunk_sem
+            pltpu.SemaphoreType.DMA((2,)),                # acc_sem
+            pltpu.SemaphoreType.DMA((slots,)),            # ksend
+            pltpu.SemaphoreType.DMA((slots,)),            # krecv
+            pltpu.SemaphoreType.DMA((slots,)),            # vsend
+            pltpu.SemaphoreType.DMA((slots,)),            # vrecv
+            pltpu.SemaphoreType.REGULAR,                  # free_sem
+        ],
+    )
+    o, lse_packed = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, n, s // lp, lp), jnp.float32),
+        ],
+        # everything is sequential by construction: the ring choreography,
+        # the VMEM-resident stats, and the acc carry all assume one core
+        # walks the grid in order — a megacore split would race them
+        compiler_params=tpu_compiler_params(
+            vmem_limit_bytes=VMEM_LIMIT,
+            dimension_semantics=("arbitrary",) * 4,
+            collective_id=_COLLECTIVE_ID,
+        ),
+        interpret=interpret,
+    )(sched, q, k, v)
+    return o, _unpack(lse_packed)

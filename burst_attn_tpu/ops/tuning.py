@@ -35,6 +35,22 @@ class BlockTable(NamedTuple):
     # measured=False until a sweep pins them.
     fwd_cliff_area: int = 2048 * 2048
     bwd_cliff_area: int = 1024 * 2048
+    # Fused ring kernel (ops/fused_ring.py): KV communication-slot count
+    # (2 = plain double buffering; more slots let the RDMA pipeline run
+    # deeper ahead of compute at the cost of one extra KV chunk of HBM per
+    # slot) and the q-row block of its grid.  The fused kernel's sweep
+    # reads KV from a VMEM-resident chunk, so — unlike the scan-path
+    # kernels — its row block does NOT gate KV streaming traffic; 512 rows
+    # keeps the per-step acc/stat state small while giving the MXU full
+    # [512, kv] tiles.  Estimated until swept on hardware
+    # (benchmarks/ring_overlap.py reports per-config timings to retune).
+    fused_kv_slots: int = 2
+    fused_block_q: int = 512
+    fused_block_kv: int = 512
+    # VMEM budget (bytes) the fused kernel may plan against for its
+    # resident KV chunk + stats; above it the dispatch falls back to the
+    # scan ring rather than risk a Mosaic allocation failure mid-ring.
+    fused_vmem_budget: int = 96 * 1024 * 1024
 
 
 class ResolvedBlocks(NamedTuple):
@@ -179,6 +195,32 @@ def _clamp_cliff(bq: int, bkv: int, area: int, which: str):
         "kv block to %d (see results/cliff_probe.jsonl; BURST_ALLOW_CLIFF=1 to "
         "measure cliff configs anyway)", which, bq, bkv, area, new_bkv)
     return bq, new_bkv
+
+
+class ResolvedFused(NamedTuple):
+    """resolve_fused() result: the fused ring kernel's static plan knobs."""
+
+    block_q: int
+    block_kv: int
+    kv_slots: int
+    vmem_budget: int
+
+
+def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
+                  device=None) -> ResolvedFused:
+    """Fill the fused ring kernel's knobs from the per-generation table.
+
+    kv_slots < 2 cannot double-buffer (the send target would be the slot
+    being computed on) and is rejected rather than silently bumped — an
+    explicit wrong config should fail loudly, only the table default is
+    implicit."""
+    t = block_defaults(device)
+    bq = t.fused_block_q if block_q is None else block_q
+    bkv = t.fused_block_kv if block_kv is None else block_kv
+    slots = t.fused_kv_slots if kv_slots is None else kv_slots
+    if slots < 2:
+        raise ValueError(f"fused ring needs kv_slots >= 2, got {slots}")
+    return ResolvedFused(bq, bkv, slots, t.fused_vmem_budget)
 
 
 def resolve_blocks(block_q=None, block_kv=None, block_q_bwd=None,

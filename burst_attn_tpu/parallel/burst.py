@@ -37,6 +37,7 @@ chunked device-major over (inter, intra): partition id = inter_rank *
 intra_size + intra_rank.
 """
 
+import logging
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional, Tuple
@@ -50,6 +51,8 @@ from ..ops import tile as jnp_tile
 from ..ops.masks import full_spec, round_spec, spec_live
 from .ring import ppermute_by, ppermute_next, my_partition, partition_at_round
 from ..utils.compat import axis_size, shard_map
+
+logger = logging.getLogger("burst_attn_tpu")
 
 
 @dataclass(frozen=True)
@@ -68,7 +71,13 @@ class BurstConfig:
     scale: Optional[float] = None  # default 1/sqrt(head_dim)
     intra_axis: str = "sp"
     inter_axis: Optional[str] = None  # set for the hierarchical double ring
-    backend: str = "jnp"  # "jnp" | "pallas"
+    # "jnp" | "pallas" | "fused_ring".  fused_ring runs the WHOLE forward
+    # ring inside one Pallas kernel with in-kernel RDMA KV rotation
+    # (ops/fused_ring.py); configs the fused kernel does not cover (double
+    # ring, window, segments, cross-attention, off-TPU without
+    # BURST_FUSED_INTERPRET) and the backward fall back to the scan ring
+    # with the pallas (TPU) / jnp (CPU) tile backend.
+    backend: str = "jnp"
     optimize_bwd_comm: bool = True  # rotate delta=sum(o*do) [B,N,S] f32, not o
     # kernel blocks; None = resolved from the per-TPU-generation table
     # (ops/tuning.py) by resolved_blocks() in the tile dispatch, with bwd
@@ -85,6 +94,12 @@ class BurstConfig:
     # why the load-balancing permutations can't express a band); rounds
     # wholly outside the band are dead and skipped block-wise.
     window: Optional[int] = None
+    # Fused ring kernel knobs (backend="fused_ring" only): KV communication
+    # slot count (>= 2) and the fused grid's q-row / kv-sweep blocks; None =
+    # the per-TPU-generation table (ops/tuning.py resolve_fused).
+    fused_kv_slots: Optional[int] = None
+    fused_block_q: Optional[int] = None
+    fused_block_kv: Optional[int] = None
     # Structural causal scheduling (reference burst_attn_interface.py:221-235,
     # :303-367): zigzag rounds dispatch through a 3-way lax.cond whose
     # branches run statically-sliced dense tiles (full q x half kv / half q x
@@ -123,9 +138,19 @@ class BurstConfig:
 # tile dispatch
 
 
+def _tile_backend(cfg) -> str:
+    """Per-round tile backend.  "fused_ring" maps to the equivalent tile
+    backend for everything that stays on the scan ring — the backward pass
+    and any forward the fused kernel declines (see _fwd_impl) — so a
+    fused_ring config degrades to the best scan path instead of erroring."""
+    if cfg.backend != "fused_ring":
+        return cfg.backend
+    return "pallas" if jax.default_backend() == "tpu" else "jnp"
+
+
 def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec, triangular=False,
               segments=None):
-    if cfg.backend == "pallas":
+    if _tile_backend(cfg) == "pallas":
         from ..ops import pallas_flash
 
         rb = cfg.resolved_blocks()
@@ -146,7 +171,7 @@ def _tile_fwd(cfg, q, k, v, m, lse, acc, scale, spec, triangular=False,
 
 def _tile_bwd(cfg, do, q, k, v, delta, lse, scale, spec, triangular=False,
               segments=None):
-    if cfg.backend == "pallas":
+    if _tile_backend(cfg) == "pallas":
         from ..ops import pallas_flash
 
         rb = cfg.resolved_blocks()
@@ -190,6 +215,20 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
     (one extra tiny int32 array in the rotating payload); the q-side ids
     stay resident.  Attention never crosses a segment boundary.
     """
+    if cfg.backend == "fused_ring":
+        # tentpole fast path: the whole W-round ring in one Pallas kernel
+        # with in-kernel RDMA KV rotation (ops/fused_ring.py).  Declined
+        # configs fall through to the scan ring below with the tile backend
+        # from _tile_backend (the returned reason says why — logged once
+        # per trace so a silently-degraded bench run is visible).
+        from ..ops import fused_ring
+
+        reason = fused_ring.supported(cfg, q.shape, k.shape, seg is not None)
+        if reason is None:
+            return fused_ring.fused_ring_fwd(q, k, v, cfg)
+        logger.info("fused_ring backend falling back to the scan ring: %s",
+                    reason)
+
     b, n, s, d = q.shape
     scale = cfg.scale if cfg.scale is not None else d**-0.5
     n_inter, n_intra = _sizes(cfg)
@@ -623,6 +662,9 @@ def burst_attn(
     case_split: bool = True,
     window: Optional[int] = None,
     segment_ids=None,
+    fused_kv_slots: Optional[int] = None,
+    fused_block_q: Optional[int] = None,
+    fused_block_kv: Optional[int] = None,
 ) -> jax.Array:
     """Burst attention on global arrays [B, N, S, D]; S must already be in
     layout order (parallel/layouts.to_layout) for causal runs.
@@ -663,6 +705,9 @@ def burst_attn(
         block_kv_bwd=block_kv_bwd,
         case_split=case_split,
         window=window,
+        fused_kv_slots=fused_kv_slots,
+        fused_block_q=fused_block_q,
+        fused_block_kv=fused_block_kv,
     )
     seq_spec = seq_axes if len(seq_axes) > 1 else intra_axis
     spec = P(batch_axes, head_axes, seq_spec, None)

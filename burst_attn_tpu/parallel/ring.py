@@ -79,6 +79,46 @@ def ring_schedule(intra_size: int, inter_size: int = 1):
     return out
 
 
+def neighbor_ids(axis_name: str):
+    """(me, right, left) traced int32 rank ids on `axis_name`.
+
+    `right` (me + 1) is the ring SEND target — the same direction every
+    ppermute_next rotation and the reference's NCCL ring use — and `left`
+    is the rank whose sends land in our buffers.  Exported for the fused
+    ring kernel (ops/fused_ring.py), whose in-kernel RDMA must target the
+    identical neighbor the XLA ring would, so the two paths hold the same
+    partition at every round (asserted by burstlint's fused-ring rules).
+    """
+    me = lax.axis_index(axis_name)
+    n = axis_size(axis_name)
+    return me, (me + 1) % n, (me - 1) % n
+
+
+def fused_slot_schedule(world: int, slots: int):
+    """Host-side KV-slot schedule of the fused ring kernel: [world] int array
+    where entry r is the communication-buffer slot holding the chunk a
+    device consumes at ring round r.
+
+    The kernel (ops/fused_ring.py) reads THIS array (via scalar prefetch)
+    for every slot choice — the send at round r goes from slot[r] into the
+    right neighbor's slot[r+1] — so the schedule here is the single source
+    of truth, and burstlint verifies it against an independent derivation
+    plus a delivery proof (analysis/oracle.verify_fused_ring): neighbor-only
+    sends, exactly world-1 hops per chunk, and no slot overwritten before
+    its last read under the kernel's capacity handshake.
+
+    With `slots` = 2 this is plain double buffering (slot parity r % 2);
+    more slots deepen the pipeline so a send may run `slots - 1` rounds
+    ahead of compute before the handshake blocks it.
+    """
+    import numpy as np
+
+    if world < 1 or slots < 2:
+        raise ValueError(f"need world >= 1 and slots >= 2, got "
+                         f"world={world}, slots={slots}")
+    return np.arange(world, dtype=np.int64) % min(slots, world)
+
+
 def partition_at_round(r, intra_axis: str, inter_axis):
     """Global partition id of the KV (fwd) / query-side (bwd) payload held at
     0-indexed ring round r under the (double-)ring schedule.

@@ -7,11 +7,12 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 args=("$@")
 filtered=()
-fast=0; tpu=0
+fast=0; tpu=0; fused=0
 for a in "${args[@]}"; do
   case "$a" in
     --fast) fast=1 ;;
     --tpu) tpu=1 ;;
+    --fused) fused=1 ;;
     *) filtered+=("$a") ;;
   esac
 done
@@ -21,7 +22,12 @@ done
 echo "== burstlint (python -m burst_attn_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m burst_attn_tpu.analysis
 
-if [[ $fast == 1 ]]; then
+if [[ $fused == 1 ]]; then
+  # focused lane for the fused RDMA-ring kernel's interpret-mode parity
+  # tests (the same tests also run in the default/fast lanes — this is the
+  # quick iteration loop while working on ops/fused_ring.py)
+  python -m pytest tests/ -q -m "fused_ring" ${filtered[@]+"${filtered[@]}"}
+elif [[ $fast == 1 ]]; then
   python -m pytest tests/ -q -m "not slow" ${filtered[@]+"${filtered[@]}"}
 else
   python -m pytest tests/ -q ${filtered[@]+"${filtered[@]}"}

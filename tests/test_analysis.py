@@ -45,7 +45,8 @@ def test_at_least_8_rules_registered():
                      "host-transfer-in-jit", "time-in-jit",
                      "traced-bool-branch", "ring-rotation", "ring-hops",
                      "ring-order", "dq-return-home", "window-truncation",
-                     "fp32-accum", "lse-fp32"):
+                     "fp32-accum", "lse-fp32",
+                     "fused-ring-schedule", "fused-ring-fused"):
         assert expected in RULES, expected
 
 
@@ -346,3 +347,34 @@ def test_cli_exits_zero_on_repo():
     d = json.loads(r.stdout)
     assert len(d["rules_registered"]) >= 8
     assert d["n_findings"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fused ring schedule rules
+
+
+def test_fused_oracle_proves_itself():
+    for world, slots in [(2, 2), (4, 2), (8, 2), (8, 3), (8, 8)]:
+        oracle.verify_fused_ring(world, slots)
+    # no double buffering: every round reads/writes slot 0, so a sender one
+    # round ahead overwrites the version the receiver has not consumed yet
+    with pytest.raises(AssertionError):
+        oracle.verify_fused_ring(8, 2, [0] * 8)
+    # consecutive rounds sharing a slot: the round-1 send targets the slot
+    # round 2 still has to read, and the capacity credit (granted after
+    # round 0) does not cover it — overwritten before read
+    with pytest.raises(AssertionError):
+        oracle.verify_fused_ring(8, 2, [0, 1, 1, 0, 0, 1, 1, 0])
+
+
+def test_fused_schedule_mutation_fires(monkeypatch):
+    from burst_attn_tpu.parallel import ring
+
+    healthy = ringcheck.verify_fused_ring()
+    assert healthy == [], "\n".join(f.format() for f in healthy)
+
+    monkeypatch.setattr(ring, "fused_slot_schedule",
+                        lambda world, slots: np.zeros(world, dtype=np.int64))
+    findings = ringcheck.verify_fused_ring()
+    assert "fused-ring-schedule" in _rules_of(findings), [
+        f.format() for f in findings]
