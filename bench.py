@@ -161,6 +161,12 @@ import jax.numpy as jnp
 
 from benchmarks.benchmark import bench_fn as _time  # single timing impl
 
+# obs JSONL export target: written after the run and REQUIRED to parse
+# (ISSUE 3 satellite: the exporter's artifact is asserted, fsynced
+# alongside results/headline.json) — `python -m burst_attn_tpu.obs` reads it
+OBS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "results", "obs.jsonl")
+
 # seq -> reference per-chip fwd+bwd TFLOPs/s (README.md:81-85)
 BASELINE_FWDBWD = {65536: 170.0, 131072: 184.0, 262144: 191.0, 524288: 195.0, 1048576: 196.0}
 
@@ -243,6 +249,93 @@ def _bench_tpu_config(seq, b, n, d, causal):
     return rec
 
 
+def _record_headline_obs(rec: dict, seq: int) -> None:
+    """Mirror a headline record into the obs registry so BENCH JSON and obs
+    output share one schema (gauge value == the printed headline value)."""
+    from burst_attn_tpu import obs
+
+    labels = dict(seq=seq, unit=rec.get("unit", ""))
+    obs.gauge("bench.headline", "headline per-chip TFLOPs/s by config"
+              ).set(rec["value"], **labels)
+    if rec.get("vs_baseline"):
+        obs.gauge("bench.headline_vs_baseline").set(rec["vs_baseline"],
+                                                    seq=seq)
+    obs.counter("bench.runs").inc(
+        cached=str(bool(rec.get("cached"))).lower())
+
+
+def _obs_smoke() -> None:
+    """First-light observability pass: drive a tiny ring dispatch and a tiny
+    ServeEngine so a fresh bench run's obs export contains nonzero
+    ring-round counters, serve TTFT buckets, and fused-vs-scan dispatch
+    counts (ISSUE 3 acceptance) even though the headline config itself is
+    single-chip flash attention.  Correctness-scale (seconds); any failure
+    is logged and swallowed — diagnostics must never kill the benchmark."""
+    from burst_attn_tpu import obs
+
+    try:
+        with obs.span("bench.obs_smoke"):
+            import numpy as np
+            from jax.sharding import Mesh
+
+            import burst_attn_tpu as bat
+
+            devs = jax.devices()
+            world = 8 if len(devs) >= 8 else len(devs)
+            mesh = Mesh(np.asarray(devs[:world]), ("sp",))
+            dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+            q = jax.random.normal(jax.random.PRNGKey(0),
+                                  (1, 2, 32 * world, 16), dt)
+            ql = bat.layouts.to_layout(q, "zigzag", world, axis=2)
+            # one scan dispatch + one fused_ring dispatch: whichever way the
+            # fused gate decides, burst.dispatch gets both path labels and
+            # burst.fused_fallback the decline reason
+            for backend in ("auto", "fused_ring"):
+                o = bat.burst_attn(ql, ql, ql, mesh=mesh, causal=True,
+                                   layout="zigzag", backend=backend)
+                jax.block_until_ready(o)
+
+            from burst_attn_tpu.models import ModelConfig, init_params
+            from burst_attn_tpu.models.serve import ServeEngine
+
+            cfg = ModelConfig(
+                vocab=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                d_head=16, d_ff=128, block_q=8, block_kv=8,
+                attn_backend="jnp", remat=False, dtype=jnp.float32,
+                batch_axis=None, head_axis=None)
+            params = init_params(jax.random.PRNGKey(0), cfg)
+            eng = ServeEngine(params, cfg, slots=2, n_pages=10, page=128,
+                              max_pages_per_seq=3)
+            rng = np.random.default_rng(0)
+            for n_new in (4, 3, 5):
+                eng.submit(rng.integers(1, cfg.vocab, size=8,
+                                        dtype=np.int32), n_new)
+            eng.run()
+        EVENTS.event("obs_smoke_done")
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: obs smoke failed ({type(e).__name__}: {str(e)[:200]})",
+              file=sys.stderr, flush=True)
+        EVENTS.event("obs_smoke_failed",
+                     error=f"{type(e).__name__}: {str(e)[:200]}")
+
+
+def _export_and_check_obs(path: str = OBS_PATH) -> None:
+    """Export the registry to JSONL and ASSERT the artifact parses — an
+    exporter regression must fail the bench loudly, not ship an unreadable
+    observability file next to a healthy headline.json."""
+    from burst_attn_tpu import obs
+    from burst_attn_tpu.obs.__main__ import load_records, merge_records
+
+    obs.export_jsonl(path)
+    records = load_records(path)  # raises ValueError on any bad line
+    if not records:
+        raise RuntimeError(f"obs export {path} is empty")
+    metrics, _spans, _meta = merge_records(records)
+    if not metrics:
+        raise RuntimeError(f"obs export {path} contains no metric records")
+    EVENTS.event("obs_export", path=path, n_records=len(records))
+
+
 def main():
     on_tpu = jax.default_backend() == "tpu"
     b, n, d = 1, 32, 128
@@ -257,12 +350,16 @@ def main():
         _save_headline(rec_small, HEADLINE_SMALL)
         EVENTS.event("small_done", **rec_small)
         print(json.dumps(rec_small), flush=True)
+        _record_headline_obs(rec_small, SMALL_SEQ)
 
         seq = 65536
         rec = _bench_tpu_config(seq, b, n, d, causal)
         _save_headline(rec)
         EVENTS.event("done", **rec)
         print(json.dumps(rec))
+        _record_headline_obs(rec, seq)
+        _obs_smoke()
+        _export_and_check_obs()
     else:
         cached = _load_headline()
         if cached is not None:
@@ -279,6 +376,12 @@ def main():
             rec["cached_timestamp_utc"] = cached.get("timestamp_utc", "")
             EVENTS.event("done", cached=True)
             print(json.dumps(rec))
+            import re
+
+            m = re.search(r"seq=(\d+)", rec.get("metric", ""))
+            _record_headline_obs(rec, int(m.group(1)) if m else 0)
+            _obs_smoke()
+            _export_and_check_obs()
             return
         # CPU fallback: correctness-scale run so the driver always gets a line
         from burst_attn_tpu.ops.tile import single_device_attention
@@ -294,12 +397,16 @@ def main():
             q, k, v, on_event=EVENTS.event,
         )
         tflops = flops_fwd(b, seq, 8, 64, True) / t / 1e12
-        print(json.dumps({
+        rec = {
             "metric": f"cpu-fallback fwd TFLOPs/s @ seq={seq}",
             "value": round(tflops, 3),
             "unit": "TFLOPs/s",
             "vs_baseline": 0.0,
-        }))
+        }
+        print(json.dumps(rec))
+        _record_headline_obs(rec, seq)
+        _obs_smoke()
+        _export_and_check_obs()
 
 
 if __name__ == "__main__":

@@ -167,6 +167,16 @@ def run_config(seq, world, layout, n, d, causal, out_path):
         f.flush()
         os.fsync(f.fileno())
     print(json.dumps(rec))
+    # mirror the headline quantities into the obs registry so the overlap
+    # numbers show up in `python -m burst_attn_tpu.obs` next to the ring
+    # dispatch counters the measured programs just advanced
+    from burst_attn_tpu import obs
+
+    labels = dict(seq=seq, world=world, layout=layout)
+    for key in ("overlap_scan", "overlap_fused", "fused_speedup",
+                "tflops_scan", "tflops_fused"):
+        obs.gauge(f"bench.ring_overlap.{key}").set(rec[key], **labels)
+    obs.counter("bench.ring_overlap_runs").inc()
     return rec
 
 
@@ -186,6 +196,10 @@ def main():
     for seq in [int(s) for s in args.seqs.split(",")]:
         run_config(seq, args.mesh, args.layout, args.heads, args.dim,
                    not args.noncausal, args.out)
+    # one obs export per invocation, beside the jsonl results
+    from burst_attn_tpu import obs
+
+    obs.export_jsonl(os.path.join(os.path.dirname(args.out), "obs.jsonl"))
 
 
 if __name__ == "__main__":

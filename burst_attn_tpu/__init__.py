@@ -28,8 +28,10 @@ from .parallel.pipeline import pipeline, stack_stages
 from .parallel.moe import MoEParams, init_moe_params, moe_apply
 from .parallel import layouts
 from .ops import masks, tile, reference
+from . import obs
 
 __all__ = [
+    "obs",
     "BurstConfig",
     "burst_attn",
     "burst_attn_shard",

@@ -11,7 +11,10 @@ Two families of checks (docs/analysis.md):
     CPU-mesh test matrix can pass while real scale breaks.
   * AST-level lint rules (astlint): mechanical hygiene rules over the
     package source (silent exception swallowing, hard mesh.shape[axis]
-    indexing, host transfers / time calls / Python branches under jit).
+    indexing, host transfers / time calls / Python branches / obs
+    registry-span calls under jit — the latter paired with a jaxpr proof
+    (obscheck) that the traced rings carry zero host-callback
+    primitives).
 
 CLI: python -m burst_attn_tpu.analysis [--json]
 """

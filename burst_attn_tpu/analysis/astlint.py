@@ -21,6 +21,16 @@ the offending line):
                        jit-traced function: raises TracerBoolConversionError
                        at trace time (or silently specializes on trace-time
                        values under concrete transforms).
+  obs-jit-safe         any call reachable through an obs binding (the
+                       package's metrics registry / span tracer / obs
+                       logger, burst_attn_tpu.obs) inside a jit-traced
+                       function: at best a trace-time constant that never
+                       updates at run time, at worst a host callback wired
+                       into the hot path.  Instrumentation belongs at host
+                       boundaries (dispatch wrappers, engine loops); the
+                       jaxpr half of this rule (analysis/obscheck.py)
+                       additionally proves the traced ring programs contain
+                       ZERO host-callback primitives.
 
 "jit-traced" is a static under-approximation: functions decorated with
 jax.jit/pmap (incl. via partial), functions (or lambdas / partial targets)
@@ -261,8 +271,74 @@ def _check_traced_bool(tree, src_lines, path):
             )
 
 
+def _deep_root(node) -> str:
+    """Leftmost Name of an attribute/call/subscript chain:
+    `obs.counter("x").inc(...)` -> "obs"."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        else:
+            break
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _obs_bound_names(tree) -> Set[str]:
+    """Module-level names bound to the obs subsystem: imports of
+    burst_attn_tpu.obs (any spelling/level) and top-level assignments whose
+    value is rooted at one of those names (e.g. `_C = obs.counter("c")`)."""
+    bound: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                parts = a.name.split(".")
+                if "obs" in parts:
+                    # `import burst_attn_tpu.obs` binds the ROOT name, but
+                    # calls still route through a chain containing obs
+                    bound.add(a.asname or parts[0])
+        elif isinstance(node, ast.ImportFrom):
+            parts = (node.module or "").split(".")
+            if "obs" in parts:
+                bound.update(a.asname or a.name for a in node.names)
+            else:  # `from .. import obs` / `from burst_attn_tpu import obs`
+                bound.update(a.asname or a.name for a in node.names
+                             if a.name == "obs")
+    for node in tree.body:  # module level only: metric singletons
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _deep_root(node.value) in bound:
+                bound.update(t.id for t in node.targets
+                             if isinstance(t, ast.Name))
+    return bound
+
+
+@rule("obs-jit-safe", "ast",
+      "obs registry/span/logger calls must not be reachable under jit "
+      "(jaxpr half: traced rings carry zero host-callback primitives)")
+def _check_obs_jit_safe(tree, src_lines, path):
+    bound = _obs_bound_names(tree)
+    if not bound:
+        return
+    seen = set()  # one finding per line: `obs.counter("x").inc()` nests calls
+    for sub in _iter_jit_bodies(tree):
+        if isinstance(sub, ast.Call) and _deep_root(sub.func) in bound \
+                and sub.lineno not in seen:
+            seen.add(sub.lineno)
+            yield Finding(
+                rule="obs-jit-safe", file=path, line=sub.lineno,
+                message=f"obs call `{_deep_root(sub.func)}…` inside a "
+                        "jit-traced function — a registry/span update here "
+                        "is a trace-time constant (or a host callback in "
+                        "the hot path); hoist it to the host dispatch "
+                        "boundary",
+            )
+
+
 _AST_RULES = (_check_silent_except, _check_mesh_shape_index,
-              _check_host_transfer, _check_time_in_jit, _check_traced_bool)
+              _check_host_transfer, _check_time_in_jit, _check_traced_bool,
+              _check_obs_jit_safe)
 
 
 def lint_file(path: str) -> List[Finding]:

@@ -1,0 +1,97 @@
+"""obs-jit-safe, jaxpr half (burstlint family 1).
+
+The AST half (astlint._check_obs_jit_safe) proves no obs BINDING is called
+from a statically jit-marked function; this half closes the dynamic gap —
+instrumentation smuggled into a compiled program through any indirection
+(a helper module, `jax.debug.callback(REGISTRY.inc)`, a pure_callback
+wrapper) shows up in the traced jaxpr as a host-callback primitive no
+matter how it was spelled.  The hot attention programs are traced
+abstractly (same harness as ringcheck) and must contain ZERO callback
+primitives: the ring's value is overlap, and a host callback inside the
+ring is a synchronous device<->host round trip per step, exactly the
+regression this subsystem exists to catch.
+
+Flagged primitives: anything whose name contains "callback"
+(pure_callback / io_callback / debug_callback across jax versions) plus
+the legacy host_callback "outside_call".
+"""
+
+import inspect
+from typing import List
+
+from .core import Finding
+from .jaxpr_tools import iter_eqns
+
+_LEGACY_CALLBACK_PRIMS = ("outside_call",)
+
+
+def _is_callback_prim(name: str) -> bool:
+    return "callback" in name or name in _LEGACY_CALLBACK_PRIMS
+
+
+def _anchor(fn):
+    try:
+        return inspect.getsourcefile(fn), inspect.getsourcelines(fn)[1]
+    except (OSError, TypeError):
+        return "<trace>", 0
+
+
+def check_trace(closed_jaxpr, *, where: str, anchor) -> List[Finding]:
+    """Flag every host-callback primitive in one traced program."""
+    findings: List[Finding] = []
+    path, line = anchor
+    for eqn in iter_eqns(closed_jaxpr):
+        name = eqn.primitive.name
+        if _is_callback_prim(name):
+            findings.append(Finding(
+                rule="obs-jit-safe", file=path, line=line,
+                message=f"{where}: host-callback primitive `{name}` inside "
+                        "the traced program — a synchronous device<->host "
+                        "round trip per executed step; obs instrumentation "
+                        "must stay at the host dispatch boundary"))
+    return findings
+
+
+def check_all() -> List[Finding]:
+    """Trace the burst forward AND backward shard programs on a simulated
+    flat ring and prove both are callback-free.  (The scan/fused dispatch,
+    tile kernels, and case-split branches are all inside these traces;
+    ringcheck's topology matrix covers scheduling, this covers purity.)"""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..parallel import burst
+    from ..utils.compat import shard_map
+
+    findings: List[Finding] = []
+    devs = jax.devices()
+    world = 4
+    if len(devs) < world:
+        raise RuntimeError(
+            f"analysis needs {world} simulated devices "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count=8); "
+            f"have {len(devs)}")
+    mesh = Mesh(np.asarray(devs[:world]), ("sp",))
+    cfg = burst.BurstConfig(causal=True, layout="zigzag", intra_axis="sp",
+                            backend="jnp")
+    b, n, d, s_local = 1, 2, 8, 16
+    S = jax.ShapeDtypeStruct
+    q = S((b, n, s_local * world, d), jnp.bfloat16)
+    lse = S((b, n, s_local * world), jnp.float32)
+    spec4 = P(None, None, "sp", None)
+    spec3 = P(None, None, "sp")
+
+    fwd = shard_map(lambda q, k, v: burst._fwd_impl(q, k, v, cfg),
+                    mesh=mesh, in_specs=(spec4,) * 3,
+                    out_specs=(spec4, spec3), check_vma=False)
+    findings += check_trace(jax.make_jaxpr(fwd)(q, q, q),
+                            where="burst fwd", anchor=_anchor(burst._fwd_impl))
+    bwd = shard_map(
+        lambda q, k, v, o, lse, do: burst._bwd_impl(cfg, q, k, v, o, lse, do),
+        mesh=mesh, in_specs=(spec4,) * 4 + (spec3, spec4),
+        out_specs=(spec4,) * 3, check_vma=False)
+    findings += check_trace(jax.make_jaxpr(bwd)(q, q, q, q, lse, q),
+                            where="burst bwd", anchor=_anchor(burst._bwd_impl))
+    return findings

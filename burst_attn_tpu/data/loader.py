@@ -24,6 +24,10 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..obs.logs import get_logger, safe_warn
+
+_logger = get_logger("burst_attn_tpu.data")
+
 _MAGIC = 0x44544142  # "BATD"
 _HEADER = 16
 
@@ -183,5 +187,9 @@ class DataLoader:
     def __del__(self):
         try:
             self.close()
-        except Exception:  # burstlint: disable=silent-except
-            pass  # __del__ during interpreter teardown: logging itself can fail
+        except Exception as e:  # noqa: BLE001 — __del__ must not raise
+            # interpreter teardown: even logging can fail here, so route
+            # through obs.safe_warn (swallow-proof; failed emissions are
+            # kept in obs.logs._DROPPED instead of vanishing)
+            safe_warn(_logger, "DataLoader.__del__: close failed (%s: %s)",
+                      type(e).__name__, e)

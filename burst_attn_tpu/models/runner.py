@@ -4,8 +4,8 @@ trainer, not an op library.
 Ties together the subsystems the reference delegates to host frameworks
 (reference README.md:36-38): the native data loader (data/loader.py), the
 sharded train step (models/train.py), orbax checkpointing
-(utils/checkpoint.py), step timing (utils/profiling.py), and rank-0 logging
-(utils/log_helper.py).  Resume is exact: the checkpoint step repositions the
+(utils/checkpoint.py), step timing + metrics (burst_attn_tpu.obs), and
+rank-0 logging (utils/log_helper.py; handlers via the obs logger).  Resume is exact: the checkpoint step repositions the
 deterministic loader with `seek(step)`, so the token stream continues as if
 the run never stopped.
 
@@ -28,10 +28,10 @@ from .train import (
     prefetch_batches, probe_model_tri_bwd,
 )
 from .transformer import ModelConfig
+from .. import obs
 from ..data import DataLoader
+from ..obs import StepTimer, get_logger
 from ..utils import log_helper
-from ..utils.log_helper import get_logger
-from ..utils.profiling import StepTimer
 
 
 @dataclass(frozen=True)
@@ -96,7 +96,8 @@ def fit(cfg: ModelConfig, tcfg: TrainConfig, run: RunConfig, mesh):
             return
         if (step + 1) % run.eval_every and step + 1 != run.steps:
             return
-        metrics = evaluator(state[0])
+        with obs.span("train.eval", step=step + 1):
+            metrics = evaluator(state[0])
         row = {"step": step + 1, **{k: round(v, 4) for k, v in metrics.items()}}
         history.append(row)
         if primary:
@@ -139,6 +140,15 @@ def fit(cfg: ModelConfig, tcfg: TrainConfig, run: RunConfig, mesh):
     s = timer.summary()
     if s["steps"] and primary:
         log.info("done: %d steps, mean %.3fs/step", s["steps"], s["mean_s"])
+    # BURST_OBS_EXPORT=<path>: drop the run's full metric/span state as an
+    # obs JSONL export (readable with `python -m burst_attn_tpu.obs`)
+    import os
+
+    export_path = os.environ.get("BURST_OBS_EXPORT")
+    if export_path:
+        obs.export_jsonl(export_path)
+        if primary:
+            log.info("obs export written to %s", export_path)
     return state, history
 
 

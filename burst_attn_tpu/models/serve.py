@@ -36,7 +36,7 @@ Reference parity: none — the reference is an attention op library with no
 serving story (SURVEY.md §5); this is framework surface beyond it.
 """
 
-import logging
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -44,7 +44,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-logger = logging.getLogger(__name__)
+from .. import obs
+
+logger = obs.get_logger(__name__)
+
+# -- engine metrics (module-level: the registry aggregates per process, so
+# several engines in one server share one catalog; all host-side code).
+# TTFT = submit -> first token available (prefill samples it at admission);
+# token latency = engine-tick seconds per token added to a live stream.
+_M_SUBMITTED = obs.counter("serve.requests_submitted")
+_M_REJECTED = obs.counter("serve.requests_rejected",
+                          "submissions refused up front, by reason")
+_M_ADMITTED = obs.counter("serve.requests_admitted")
+_M_RETIRED = obs.counter("serve.requests_retired",
+                         "finished requests, by cause (eos | budget)")
+_M_STEPS = obs.counter("serve.engine_steps")
+_M_TOKENS = obs.counter("serve.tokens_generated")
+_M_QUEUE = obs.gauge("serve.queue_depth")
+_M_LIVE = obs.gauge("serve.live_slots")
+_M_POOL = obs.gauge("serve.page_pool_occupancy",
+                    "fraction of usable pool pages currently held")
+_M_SPEC_RATE = obs.gauge("serve.spec_acceptance_rate")
+_M_TTFT = obs.histogram("serve.ttft_s")
+_M_TOK_LAT = obs.histogram("serve.token_latency_s")
 
 from .decode import sample_logits
 from .paged_decode import (
@@ -60,6 +82,7 @@ class _Request:
     prompt: np.ndarray          # [T] int32
     max_new_tokens: int
     tokens: List[int] = field(default_factory=list)  # generated so far
+    t_submit: float = 0.0       # perf_counter at submit (TTFT anchor)
 
 
 class ServeEngine:
@@ -126,24 +149,31 @@ class ServeEngine:
         step() results / results() once finished)."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
+            _M_REJECTED.inc(reason="empty-prompt")
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
+            _M_REJECTED.inc(reason="bad-budget")
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{max_new_tokens} (prefill always samples one)")
         need = self._pages_for(tokens.size, max_new_tokens)
         if need > self.state.page_table.shape[1]:
+            _M_REJECTED.inc(reason="table-width")
             raise ValueError(
                 f"request needs {need} pages > max_pages_per_seq "
                 f"{self.state.page_table.shape[1]}")
         if need > self.pool.n_pages - 1:  # page 0 is the reserved sink
             # a permanently unservable request would deadlock the FIFO
             # queue (admission waits forever for pages that cannot exist)
+            _M_REJECTED.inc(reason="pool-size")
             raise ValueError(
                 f"request needs {need} pages but the pool only has "
                 f"{self.pool.n_pages - 1} usable pages total")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append(_Request(rid, tokens, max_new_tokens))
+        self._queue.append(_Request(rid, tokens, max_new_tokens,
+                                    t_submit=time.perf_counter()))
+        _M_SUBMITTED.inc()
+        _M_QUEUE.set(len(self._queue))
         return rid
 
     @property
@@ -172,10 +202,11 @@ class ServeEngine:
 
     def run(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Drive step() until every submitted request finishes."""
-        for _ in range(max_steps):
-            if not self._queue and self.live == 0:
-                return self.results()
-            self.step()
+        with obs.span("serve.run"):
+            for _ in range(max_steps):
+                if not self._queue and self.live == 0:
+                    return self.results()
+                self.step()
         raise RuntimeError(f"run() exceeded {max_steps} steps")
 
     # -- engine ------------------------------------------------------------
@@ -280,6 +311,10 @@ class ServeEngine:
             req.tokens.append(int(tok))
             self.slots[slot] = req
             self._next_tok[slot] = int(tok)
+            _M_ADMITTED.inc()
+            _M_TOKENS.inc()  # the prefill-sampled first token
+            _M_TTFT.observe(time.perf_counter() - req.t_submit)
+            _M_QUEUE.set(len(self._queue))
 
     def _sample(self, logits):
         self._rng, key = jax.random.split(self._rng)
@@ -303,7 +338,26 @@ class ServeEngine:
                 self.slots[slot] = None
                 self._finished[req.rid] = req.tokens
                 done.append((req.rid, req.tokens))
+                _M_RETIRED.inc(cause="eos" if hit_eos else "budget")
         return done
+
+    def _note_tick(self, dt: float, added: int) -> None:
+        """Per-tick obs update: queue/slot/pool gauges and, when tokens were
+        produced, the amortized per-token latency (tick seconds per token
+        per stream: live streams advance concurrently, so each stream's
+        tokens arrived `dt / (added / live)` apart)."""
+        _M_STEPS.inc()
+        _M_QUEUE.set(len(self._queue))
+        live = self.live
+        _M_LIVE.set(live)
+        usable = self.pool.n_pages - 1  # page 0 is the reserved sink
+        _M_POOL.set((usable - self.pool.available) / usable if usable else 0.0)
+        if added:
+            _M_TOKENS.inc(added)
+            _M_TOK_LAT.observe(dt * live / added)
+        rate = self.acceptance_rate
+        if rate is not None:
+            _M_SPEC_RATE.set(rate)
 
     def step(self) -> List[Tuple[int, List[int]]]:
         """One engine tick: retire -> admit -> one decode advance for every
@@ -317,6 +371,7 @@ class ServeEngine:
         queued request — WITHOUT running a decode step, or it would receive
         a token past its budget / past EOS and break parity with
         generate()."""
+        t0 = time.perf_counter()
         done = self._retire_finished()
         while True:
             before = self.pending
@@ -325,14 +380,17 @@ class ServeEngine:
             if self.pending == before:
                 break
         if self.live == 0:
+            self._note_tick(time.perf_counter() - t0, 0)
             return done
         if self.draft is not None:
-            self._spec_round()
+            added = self._spec_round()
+            self._note_tick(time.perf_counter() - t0, added)
             return done
         logits, self.state = paged_decode_step(
             self.params, jnp.asarray(self._next_tok), self.state, self.cfg,
             mesh=self.mesh)
         toks = self._sample(logits)
+        added = 0
         for slot, req in enumerate(self.slots):
             if req is None:
                 continue
@@ -342,16 +400,19 @@ class ServeEngine:
                     "a live slot was stepped without provisioned capacity")
             req.tokens.append(int(toks[slot]))
             self._next_tok[slot] = int(toks[slot])
+            added += 1
+        self._note_tick(time.perf_counter() - t0, added)
         return done
 
-    def _spec_round(self) -> None:
+    def _spec_round(self) -> int:
         """One speculative round for EVERY live slot: the draft proposes
         spec_k tokens per slot (k single paged steps on its own state);
         the target scores all k+1 positions in ONE paged_multi_step; each
         slot keeps its matching prefix + one target token, then both
         states roll back to exactly the kept tokens (a lengths decrement —
         entries past lengths are invisible).  Greedy: per-slot output is
-        token-exact with the non-speculative engine."""
+        token-exact with the non-speculative engine.  Returns the total
+        number of tokens kept across slots (obs per-token latency)."""
         k = self.spec_k
         dp, dc = self.draft
         # draft proposals stay ON DEVICE across the k steps (one transfer
@@ -387,6 +448,7 @@ class ServeEngine:
         # would silently read 0 (draft-side: 0-acceptance forever)
         bad = np.asarray(jnp.any(jnp.isnan(lg_t), axis=(1, 2)) | bad_d)
         undo = np.zeros(len(self.slots), np.int32)
+        n_kept = 0
         for slot, req in enumerate(self.slots):
             if req is None:
                 continue
@@ -406,6 +468,7 @@ class ServeEngine:
             if self.eos_id is not None and self.eos_id in new:
                 new = new[: new.index(self.eos_id) + 1]
             req.tokens += new
+            n_kept += len(new)
             self._next_tok[slot] = new[-1]
             undo[slot] = k + 1 - len(new)  # both states appended k+1
         # ONE vectorized lengths-subtract per state (dead slots undo 0).
@@ -416,3 +479,4 @@ class ServeEngine:
         self.state = self.state._replace(lengths=self.state.lengths - undo_dev)
         self.dstate = self.dstate._replace(
             lengths=self.dstate.lengths - undo_dev)
+        return n_kept

@@ -14,7 +14,7 @@ shifted BEFORE the permutation — shifting after would cross shard boundaries.
 `positions` carries true global positions for rotary (layouts.position_ids).
 """
 
-import logging
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Optional, Tuple
@@ -26,7 +26,23 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-logger = logging.getLogger(__name__)
+from .. import obs
+
+logger = obs.get_logger(__name__)
+
+# -- train-loop metrics (host boundary: updated by guarded_step's wrapper,
+# never inside the jitted step — burstlint `obs-jit-safe`).  Step time is
+# measured dispatch-to-dispatch: the jitted step is async, so wall time
+# between consecutive dispatches equals steady-state step time once the
+# pipeline fills, WITHOUT inserting a device sync that would serialize the
+# host-to-device prefetch against the running step (use
+# obs.StepTimer/runner for blocking per-step times).
+_M_STEPS = obs.counter("train.steps")
+_M_EVENTS = obs.counter(
+    "train.events", "exceptional train-loop events by kind (probe_failure; "
+                    "loss-scale kinds reserved for a mixed-precision scaler)")
+_M_STEP_S = obs.histogram("train.step_interval_s")
+_M_TPS = obs.gauge("train.tokens_per_s")
 
 from .transformer import ModelConfig, forward, forward_with_aux, init_params, param_specs
 from ..parallel import layouts
@@ -298,6 +314,7 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
 
     jit_step = jax.jit(step, donate_argnums=(0,))
     probed = []
+    last_dispatch = []  # [t_prev] once the first step has gone out
 
     def guarded_step(state, batch):
         # Default tri-backward probe (round-4 verdict #8): before the first
@@ -317,13 +334,25 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
             try:
                 probe_model_tri_bwd(cfg, mesh, batch)
             except Exception as e:  # noqa: BLE001
+                _M_EVENTS.inc(kind="probe_failure")
                 logger.warning(
                     "tri-backward compile probe failed (%s: %s); training "
                     "proceeds unprobed — a Mosaic rejection would now "
                     "surface from the first step's jit instead of "
                     "degrading to the rectangular kernel",
                     type(e).__name__, e)
-        return jit_step(state, batch)
+        out = jit_step(state, batch)
+        now = time.perf_counter()
+        _M_STEPS.inc()
+        if last_dispatch:
+            dt = now - last_dispatch[0]
+            _M_STEP_S.observe(dt)
+            if dt > 0:
+                # .size on a sharded array is the static GLOBAL element
+                # count — no device sync
+                _M_TPS.set(batch["tokens"].size / dt)
+        last_dispatch[:] = [now]
+        return out
 
     return guarded_step
 

@@ -1,0 +1,82 @@
+"""burst_attn_tpu.obs — unified observability: metrics, spans, logging.
+
+The north-star workloads (heavy serving traffic, long training runs, ring
+kernels whose whole value is comm/compute overlap) can only be steered by
+evidence; this package is where that evidence accumulates:
+
+  * `registry` — per-process counters / gauges / fixed-bucket histograms
+    (thread-safe, host-only), with JSONL and Prometheus-text exporters.
+  * `spans` — structured span tracer (context manager + decorator,
+    monotonic clocks, parent/child nesting, thread-safe) that doubles as a
+    `jax.profiler` annotation so the same names appear in xprof; no-op
+    under a jax trace.
+  * `logs` — the obs logger (log records counted in the registry) and
+    `safe_warn` for teardown paths.
+  * CLI — `python -m burst_attn_tpu.obs [--json|--prom]` renders a report
+    from a run's JSONL export (bench.py and the runner write
+    `results/obs.jsonl`).
+
+Metric catalog and naming conventions: docs/observability.md.
+
+JIT safety contract (enforced by burstlint's `obs-jit-safe` rule): no
+registry or span call may be reachable from inside a jit-traced function —
+instrumentation lives at host boundaries (dispatch wrappers, engine loops,
+bench harnesses).  Counters incremented at TRACE time (e.g. the burst
+dispatch counters) advance once per compiled program and are documented as
+such.
+"""
+
+from . import registry as _registry_mod
+from .registry import (
+    Counter, Gauge, Histogram, Registry, LATENCY_BUCKETS_S,
+    default_registry,
+)
+from .spans import (
+    Span, StepTimer, annotate, completed_spans, current_span, reset_spans,
+    span, span_records, traced,
+)
+from .logs import dropped_messages, get_logger, safe_warn
+
+
+def counter(name: str, help: str = "") -> Counter:
+    """Get-or-create a counter in the default registry."""
+    return default_registry().counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return default_registry().gauge(name, help)
+
+
+def histogram(name: str, help: str = "", buckets=None) -> Histogram:
+    return default_registry().histogram(name, help, buckets=buckets)
+
+
+def snapshot():
+    """Every metric child in the default registry as JSON-able dicts."""
+    return default_registry().snapshot()
+
+
+def to_prometheus() -> str:
+    return default_registry().to_prometheus()
+
+
+def export_jsonl(path: str) -> str:
+    """Append a full snapshot (metrics + completed spans) to `path`,
+    fsynced.  This is the artifact `python -m burst_attn_tpu.obs` reads."""
+    return default_registry().export_jsonl(path,
+                                           extra_records=span_records())
+
+
+def reset() -> None:
+    """Clear the default registry and span buffer (tests only)."""
+    default_registry().reset()
+    reset_spans()
+
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "Span", "StepTimer",
+    "LATENCY_BUCKETS_S", "annotate", "completed_spans", "counter",
+    "current_span", "default_registry", "dropped_messages", "export_jsonl",
+    "gauge", "get_logger", "histogram", "reset", "reset_spans", "safe_warn",
+    "snapshot", "span", "span_records", "to_prometheus", "traced",
+]

@@ -1,0 +1,195 @@
+"""obs CLI:  python -m burst_attn_tpu.obs [--json] [--prom] [--file PATH]
+
+Renders a report from a run's JSONL export (written by
+`obs.export_jsonl`, which bench.py, benchmarks/ring_overlap.py and the
+training runner call).  A file may hold several export snapshots (the
+exporter appends); the report shows each metric's LAST exported state —
+i.e. the final state of the run — and aggregates spans across snapshots.
+
+Exit status: 0 on a rendered report, 1 when the file is missing/empty,
+2 on unparseable content.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+DEFAULT_PATH = os.path.join("results", "obs.jsonl")
+
+
+def load_records(path: str) -> List[dict]:
+    """Parse every JSONL line; raises ValueError on a bad line (the bench
+    post-run assertion leans on this being strict)."""
+    records = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not JSON: {e}") from e
+            if not isinstance(rec, dict) or "kind" not in rec:
+                raise ValueError(f"{path}:{i}: not an obs record: {line[:80]}")
+            records.append(rec)
+    return records
+
+
+def merge_records(records: List[dict]) -> Tuple[List[dict], List[dict], dict]:
+    """(final metric states, all spans, summary meta).  Metrics are keyed by
+    (kind, name, labels) with last-wins — each snapshot is a full dump, so
+    the last one is the run's final state."""
+    metrics: Dict[tuple, dict] = {}
+    spans: List[dict] = []
+    n_snapshots = 0
+    last_ts = ""
+    seen_span_ids = set()
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "meta":
+            n_snapshots += 1
+            last_ts = rec.get("ts_utc", last_ts)
+        elif kind == "span":
+            # spans re-export with every snapshot (append model): dedup by id
+            sid = (rec.get("thread"), rec.get("span_id"))
+            if sid not in seen_span_ids:
+                seen_span_ids.add(sid)
+                spans.append(rec)
+        else:
+            key = (kind, rec.get("name"),
+                   tuple(sorted((rec.get("labels") or {}).items())))
+            metrics[key] = rec
+    meta = {"snapshots": n_snapshots, "last_ts_utc": last_ts,
+            "n_metrics": len(metrics), "n_spans": len(spans)}
+    return list(metrics.values()), spans, meta
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+
+
+def _hist_line(rec: dict) -> str:
+    parts = [f"count={rec['count']}", f"sum={rec['sum']:.6g}"]
+    if rec["count"]:
+        parts += [f"mean={rec['sum'] / rec['count']:.6g}",
+                  f"min={rec['min']:.6g}", f"max={rec['max']:.6g}"]
+    nonzero = [f"le{edge:g}:{cnt}" for edge, cnt in
+               zip(rec.get("bucket_edges", []), rec.get("bucket_counts", []))
+               if cnt]
+    if rec.get("overflow"):
+        nonzero.append(f"le+Inf:{rec['overflow']}")
+    if nonzero:
+        parts.append("buckets[" + " ".join(nonzero) + "]")
+    return "  ".join(parts)
+
+
+def render_text(metrics: List[dict], spans: List[dict], meta: dict,
+                source: str) -> str:
+    lines = [f"obs report — {source} "
+             f"({meta['snapshots']} snapshot(s), last {meta['last_ts_utc']}, "
+             f"{meta['n_metrics']} metrics, {meta['n_spans']} spans)"]
+    by_kind: Dict[str, List[dict]] = {"counter": [], "gauge": [],
+                                      "histogram": []}
+    for rec in metrics:
+        by_kind.setdefault(rec["kind"], []).append(rec)
+    width = max([len(r["name"] + _fmt_labels(r.get("labels") or {}))
+                 for r in metrics] + [20]) + 2
+    for kind in ("counter", "gauge", "histogram"):
+        recs = sorted(by_kind.get(kind, ()),
+                      key=lambda r: (r["name"], sorted(
+                          (r.get("labels") or {}).items())))
+        if not recs:
+            continue
+        lines.append(f"{kind}s:")
+        for rec in recs:
+            tag = rec["name"] + _fmt_labels(rec.get("labels") or {})
+            if kind == "histogram":
+                lines.append(f"  {tag:<{width}} {_hist_line(rec)}")
+            else:
+                lines.append(f"  {tag:<{width}} {rec['value']:g}")
+    if spans:
+        lines.append("recent spans (newest last):")
+        for rec in spans[-20:]:
+            indent = "  " * (1 + int(rec.get("depth") or 0))
+            lines.append(f"{indent}{rec['name']}  "
+                         f"{rec['duration_s'] * 1e3:.3f} ms"
+                         f"  [{rec.get('thread', '?')}]")
+    return "\n".join(lines)
+
+
+def render_prometheus(metrics: List[dict]) -> str:
+    """Rebuild Prometheus text from merged final metric states."""
+    from .registry import prom_name
+
+    def plabels(labels, extra=""):
+        parts = [f'{k}="{v}"' for k, v in sorted((labels or {}).items())]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    lines = []
+    for rec in sorted(metrics, key=lambda r: (r["name"], sorted(
+            (r.get("labels") or {}).items()))):
+        name = prom_name(rec["name"])
+        if rec["kind"] in ("counter", "gauge"):
+            lines.append(f"# TYPE {name} {rec['kind']}")
+            lines.append(f"{name}{plabels(rec.get('labels'))} "
+                         f"{rec['value']:g}")
+            continue
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for edge, cnt in zip(rec["bucket_edges"], rec["bucket_counts"]):
+            cum += cnt
+            lines.append(f"{name}_bucket"
+                         f"{plabels(rec.get('labels'), 'le=%s' % json.dumps(str(edge)))} {cum}")
+        cum += rec.get("overflow", 0)
+        lines.append(f"{name}_bucket"
+                     f"{plabels(rec.get('labels'), 'le=%s' % json.dumps('+Inf'))} {cum}")
+        lines.append(f"{name}_sum{plabels(rec.get('labels'))} {rec['sum']:g}")
+        lines.append(f"{name}_count{plabels(rec.get('labels'))} "
+                     f"{rec['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m burst_attn_tpu.obs",
+        description="render a report from an obs JSONL export")
+    ap.add_argument("--file", default=DEFAULT_PATH,
+                    help=f"JSONL export to read (default: {DEFAULT_PATH})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit machine-readable JSON")
+    ap.add_argument("--prom", action="store_true",
+                    help="emit Prometheus text exposition format")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.file):
+        print(f"obs: no export at {args.file} (run bench.py or call "
+              "obs.export_jsonl first)", file=sys.stderr)
+        return 1
+    try:
+        records = load_records(args.file)
+    except ValueError as e:
+        print(f"obs: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"obs: {args.file} is empty", file=sys.stderr)
+        return 1
+    metrics, spans, meta = merge_records(records)
+    if args.prom:
+        sys.stdout.write(render_prometheus(metrics))
+    elif args.as_json:
+        print(json.dumps({"source": args.file, "meta": meta,
+                          "metrics": metrics, "spans": spans}, indent=1))
+    else:
+        print(render_text(metrics, spans, meta, args.file))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

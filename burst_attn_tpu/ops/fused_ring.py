@@ -122,11 +122,16 @@ def _extra_named_axes(intra_axis: str):
 
 
 def supported(cfg, q_shape, k_shape, has_segments: bool, *,
-              interpret=None):
+              interpret=None, world=None, extra_axes=None):
     """None if the fused ring can run this config, else a reason string the
-    dispatch logs / the tests assert on.  Must be called at trace time
-    (inside shard_map) — the axis-env and mesh-size probes read the trace
-    context."""
+    dispatch logs / the tests assert on.  By default must be called at
+    trace time (inside shard_map) — the axis-env and mesh-size probes read
+    the trace context.  Passing `world` (ring axis size) and `extra_axes`
+    (other partitioned mesh axes) explicitly makes the predicate host-
+    callable with PER-SHARD shapes: the obs dispatch instrumentation
+    (parallel/burst._note_dispatch) evaluates the same gate the traced
+    dispatch runs, so the `burst.dispatch`/`burst.fused_fallback` counters
+    cannot drift from the real decision logic."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if interpret and not interpret_enabled():
@@ -140,10 +145,12 @@ def supported(cfg, q_shape, k_shape, has_segments: bool, *,
     b, n, s, d = q_shape
     if k_shape[2] != s:
         return "cross-attention shard lengths"
-    world = axis_size(cfg.intra_axis)
+    if world is None:
+        world = axis_size(cfg.intra_axis)
     if world < 2:
         return "world < 2 (nothing to rotate)"
-    extra = _extra_named_axes(cfg.intra_axis)
+    extra = _extra_named_axes(cfg.intra_axis) if extra_axes is None \
+        else list(extra_axes)
     if extra is None or extra:
         return (f"ring axis must be the only partitioned axis in scope "
                 f"(found {extra})")

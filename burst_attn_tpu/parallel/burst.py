@@ -47,12 +47,27 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..ops import tile as jnp_tile
 from ..ops.masks import full_spec, round_spec, spec_live
-from .ring import ppermute_by, ppermute_next, my_partition, partition_at_round
+from .ring import (ppermute_by, ppermute_next, my_partition,
+                   partition_at_round, ring_round_counts)
 from ..utils.compat import axis_size, shard_map
 
 logger = logging.getLogger("burst_attn_tpu")
+
+# -- obs dispatch instrumentation (host boundary only — see _note_dispatch).
+# These counters advance when a program is DISPATCHED (once per trace under
+# jit, once per call eagerly): the unit for "which path did the ring take",
+# not per-step execution counts (docs/observability.md, "per-trace").
+_M_DISPATCH = obs.counter(
+    "burst.dispatch", "ring dispatches by path (fused kernel vs scan ring)")
+_M_FALLBACK = obs.counter(
+    "burst.fused_fallback", "fused_ring dispatches declined, by reason")
+_M_ROUNDS = obs.counter(
+    "burst.ring_rounds", "scheduled ring rounds (incl. the self round)")
+_M_HOPS = obs.counter(
+    "burst.ring_hops", "scheduled KV ring hops, by mesh axis role")
 
 
 @dataclass(frozen=True)
@@ -335,8 +350,11 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
     spec0 = round_spec(part_me, part_me, s, k.shape[2], cfg.causal,
                        cfg.layout, window=cfg.window)
     tri0 = cfg.causal and k.shape[2] == s
-    state = _tile_fwd(cfg, q, k, v, None, None, None, scale, spec0,
-                      triangular=tri0, segments=segs0)
+    # obs.* named scopes: per-round xprof labels matching the span naming
+    # convention (docs/observability.md) — metadata only, no equations
+    with jax.named_scope("obs.ring.round0_self"):
+        state = _tile_fwd(cfg, q, k, v, None, None, None, scale, spec0,
+                          triangular=tri0, segments=segs0)
 
     for c in range(n_inter):
         if c < n_inter - 1:
@@ -359,10 +377,12 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
                 st = compute(st, kv_c, c * n_intra + s_idx)
                 return (kv_next, st), None
 
-            (kv, state), _ = lax.scan(body, (kv, state),
-                                      jnp.arange(start, r_live - 1))
+            with jax.named_scope(f"obs.ring.cycle{c}.scan_rounds"):
+                (kv, state), _ = lax.scan(body, (kv, state),
+                                          jnp.arange(start, r_live - 1))
         # last round of the cycle: no intra send (reference comm.py:238-251)
-        state = compute(state, kv, jnp.int32(c * n_intra + r_live - 1))
+        with jax.named_scope(f"obs.ring.cycle{c}.last_round"):
+            state = compute(state, kv, jnp.int32(c * n_intra + r_live - 1))
         if c < n_inter - 1:
             kv = kv_base = kv_base_next
     m, lse, acc = state
@@ -628,6 +648,80 @@ _burst_attn_shard_seg.defvjp(_seg_vjp_fwd, _seg_vjp_bwd)
 # global-array wrapper
 
 
+# (reason-string prefix -> bounded label) for burst.fused_fallback: the
+# supported() reasons embed shapes/budgets, which would explode counter
+# cardinality if used as labels verbatim
+_FALLBACK_LABELS = (
+    ("off-TPU", "off-tpu"),
+    ("double ring", "double-ring"),
+    ("sliding window", "window"),
+    ("packed segments", "segments"),
+    ("cross-attention", "cross-attn"),
+    ("world < 2", "world-lt-2"),
+    ("ring axis", "multi-axis"),
+    ("VMEM plan", "vmem-budget"),
+)
+
+
+def _fallback_label(reason: str) -> str:
+    for prefix, label in _FALLBACK_LABELS:
+        if reason.startswith(prefix):
+            return label
+    return "other"
+
+
+def _note_dispatch(cfg: BurstConfig, mesh, q_shape, k_shape, has_seg: bool,
+                   batch_axes, head_axes) -> None:
+    """Record one ring dispatch in the obs registry (burst.dispatch /
+    burst.fused_fallback / burst.ring_rounds / burst.ring_hops).
+
+    Host-boundary code: called from burst_attn BEFORE shard_map, never from
+    inside the traced shard program (burstlint `obs-jit-safe`).  The fused
+    gate is re-evaluated here with explicit world/extra_axes through the
+    SAME fused_ring.supported predicate the traced dispatch runs, on the
+    same per-shard shapes, so these counters cannot drift from _fwd_impl's
+    real decision."""
+    from ..ops import fused_ring
+
+    def _sizes_of(axes):
+        if axes is None:
+            return 1, []
+        axes = axes if isinstance(axes, (tuple, list)) else (axes,)
+        names = [a for a in axes if a is not None]
+        prod = 1
+        for a in names:
+            prod *= mesh.shape.get(a, 1)
+        return prod, [a for a in names if mesh.shape.get(a, 1) > 1]
+
+    n_intra = mesh.shape.get(cfg.intra_axis, 1)
+    n_inter = (mesh.shape.get(cfg.inter_axis, 1)
+               if cfg.inter_axis is not None else 1)
+    world = n_inter * n_intra
+    b_div, extra_b = _sizes_of(batch_axes)
+    h_div, extra_h = _sizes_of(head_axes)
+    q_local = (max(1, q_shape[0] // b_div), max(1, q_shape[1] // h_div),
+               max(1, q_shape[2] // world), q_shape[3])
+    k_local = (max(1, k_shape[0] // b_div), max(1, k_shape[1] // h_div),
+               max(1, k_shape[2] // world), k_shape[3])
+    path, reason = "scan", None
+    if cfg.backend == "fused_ring":
+        reason = fused_ring.supported(cfg, q_local, k_local, has_seg,
+                                      world=n_intra,
+                                      extra_axes=extra_b + extra_h)
+        path = "fused" if reason is None else "scan"
+    _M_DISPATCH.inc(path=path, backend=cfg.backend, tile=_tile_backend(cfg))
+    if reason is not None:
+        _M_FALLBACK.inc(reason=_fallback_label(reason))
+    r_live = _r_live(cfg, q_local[2], k_local[2], n_inter, n_intra)
+    rounds, intra_hops, inter_hops = ring_round_counts(n_inter, n_intra,
+                                                       r_live)
+    _M_ROUNDS.inc(rounds)
+    if intra_hops:
+        _M_HOPS.inc(intra_hops, axis="intra")
+    if inter_hops:
+        _M_HOPS.inc(inter_hops, axis="inter")
+
+
 def _resolve_backend(backend: str) -> str:
     if backend == "auto":
         if jax.default_backend() == "tpu":
@@ -709,6 +803,8 @@ def burst_attn(
         fused_block_q=fused_block_q,
         fused_block_kv=fused_block_kv,
     )
+    _note_dispatch(cfg, mesh, q.shape, k.shape, segment_ids is not None,
+                   batch_axes, head_axes)
     seq_spec = seq_axes if len(seq_axes) > 1 else intra_axis
     spec = P(batch_axes, head_axes, seq_spec, None)
     if segment_ids is not None:

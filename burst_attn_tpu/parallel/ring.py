@@ -38,7 +38,30 @@ def ppermute_by(x, axis_name: str, hops: int):
     if h == 0:
         return x
     perm = [(i, (i + h) % n) for i in range(n)]
-    return jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), x)
+    # named_scope: pure metadata so each hop is identifiable on the xprof
+    # timeline under the obs span naming convention (docs/observability.md);
+    # adds no equations, so burstlint's jaxpr rules see the same program
+    with jax.named_scope(f"obs.ring.hop{h}.{axis_name}"):
+        return jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), x)
+
+
+def ring_round_counts(n_inter: int, n_intra: int, r_live=None):
+    """Host-side accounting of ONE forward ring schedule: (rounds,
+    intra_hops, inter_hops).  The obs dispatch instrumentation
+    (parallel/burst._note_dispatch) records these per traced program, so
+    `burst.ring_rounds` / `burst.ring_hops` always agree with the schedule
+    the verifier proves (burstlint ring-order) instead of being counted by
+    hand at the call site.
+
+    Single ring (n_inter == 1): a windowed contig ring truncates to
+    `r_live` live rounds (parallel/burst._r_live) — r_live-1 KV hops.
+    Double ring: every cycle runs n_intra rounds with n_intra-1 intra hops
+    (the last round of a cycle consumes without sending), plus one
+    prefetched inter hop per cycle boundary."""
+    if n_inter == 1:
+        live = n_intra if r_live is None else r_live
+        return live, live - 1, 0
+    return n_inter * n_intra, n_inter * (n_intra - 1), n_inter - 1
 
 
 def axis_ranks(intra_axis: str, inter_axis):

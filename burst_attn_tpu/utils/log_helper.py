@@ -1,31 +1,26 @@
 """Logging utilities — parity with the reference's log_helper
 (burst_attn/log_helper.py:2-16) plus rank-aware helpers replacing its
-print_rank / log_rank0 (reference comm.py:324-333, :31)."""
+print_rank / log_rank0 (reference comm.py:324-333, :31).
+
+The handler setup itself moved to the obs subsystem (obs/logs.py) so every
+logger in the process is counted in the metrics registry
+(`log.events{level=...}`); `get_logger` here is a thin delegating shim —
+same signature, same handlers/format as before."""
 
 import logging
-import sys
 from typing import Optional
 
 import jax
 
-_FMT = "%(asctime)s %(name)s %(levelname)s: %(message)s"
-
 
 def get_logger(name: str, level=logging.INFO, file: Optional[str] = None):
     """Per-name logger with stream (and optional file) handlers, configured
-    once."""
-    logger = logging.getLogger(name)
-    if not logger.handlers:
-        logger.setLevel(level)
-        sh = logging.StreamHandler(sys.stderr)
-        sh.setFormatter(logging.Formatter(_FMT))
-        logger.addHandler(sh)
-        if file:
-            fh = logging.FileHandler(file)
-            fh.setFormatter(logging.Formatter(_FMT))
-            logger.addHandler(fh)
-        logger.propagate = False
-    return logger
+    once.  Delegates to burst_attn_tpu.obs.logs.get_logger (records are
+    counted in the obs registry); import lazily so utils stays importable
+    while the obs package itself initializes."""
+    from ..obs.logs import get_logger as _obs_get_logger
+
+    return _obs_get_logger(name, level=level, file=file)
 
 
 def is_primary() -> bool:

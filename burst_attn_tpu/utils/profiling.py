@@ -1,27 +1,30 @@
-"""Tracing / profiling utilities.
+"""XLA profiler capture + deprecation shims for the moved timing helpers.
 
 The reference has no profiling subsystem beyond its benchmark harness
-(SURVEY.md §5); the only debug aid is each rank's `record` list of visited
-partition ids (burst_attn_interface.py:213-217).  Here both live in the
-framework: XLA profiler capture (viewable in XProf/TensorBoard, incl. the
-collective-permute/compute overlap of the ring scan) and the ring-schedule
-replay check.
+(SURVEY.md §5); here the device side lives in this module and the host
+side in the obs subsystem:
+
+  * `trace(log_dir)` — XLA profiler capture (XProf/TensorBoard, incl. the
+    collective-permute/compute overlap of the ring scan).  Device
+    timelines are profiler state, not obs registry state, so it stays
+    here.
+  * `StepTimer` / `annotate` — MOVED to `burst_attn_tpu.obs.spans` (they
+    are host-side timing, which is obs's job; StepTimer now also feeds the
+    registry histogram `span.step_timer`).  Re-exported here so existing
+    imports keep working; new code should import from `burst_attn_tpu.obs`.
 
     with trace("/tmp/profile"):
         step(state, batch)          # -> /tmp/profile/plugins/profile/...
-
-    timer = StepTimer()
-    for batch in data:
-        with timer:
-            state, _ = step(state, batch)
-    print(timer.summary())
 """
 
 import contextlib
-import time
-from typing import List, Optional
 
 import jax
+
+# deprecation shims — canonical home is obs.spans (see module docstring)
+from ..obs.spans import StepTimer, annotate  # noqa: F401
+
+__all__ = ["trace", "StepTimer", "annotate"]
 
 
 @contextlib.contextmanager
@@ -30,7 +33,9 @@ def trace(log_dir: str, *, host_tracer_level: int = 2):
 
     On TPU this records device timelines (kernel + collective activity) —
     the tool for confirming the ring's permute/compute overlap that the
-    reference eyeballed with CUDA stream timing.
+    reference eyeballed with CUDA stream timing.  obs spans entered inside
+    the block appear on the same timeline (spans wrap
+    jax.profiler.TraceAnnotation).
     """
     from .compat import profile_options
 
@@ -43,56 +48,3 @@ def trace(log_dir: str, *, host_tracer_level: int = 2):
         yield
     finally:
         jax.profiler.stop_trace()
-
-
-def annotate(name: str):
-    """Named span that shows up on the profiler timeline (TraceAnnotation)."""
-    return jax.profiler.TraceAnnotation(name)
-
-
-class StepTimer:
-    """Wall-clock step timer that blocks on the step's OUTPUTS at exit so
-    device work is included without serializing unrelated async work (a
-    global live-array sweep would block on e.g. the next batch's
-    host-to-device prefetch and destroy the IO/compute overlap):
-
-        with timer as t:
-            state, metrics = step(state, batch)
-            t.watch(state)
-    """
-
-    def __init__(self):
-        self.times: List[float] = []
-        self._t0: Optional[float] = None
-        self._watched = None
-
-    def watch(self, *outputs):
-        """Register the step's outputs; exit blocks until they are ready."""
-        self._watched = outputs
-        return outputs[0] if len(outputs) == 1 else outputs
-
-    def __enter__(self):
-        self._watched = None
-        self._t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        if exc[0] is None:
-            if self._watched is None:
-                raise RuntimeError("StepTimer: call t.watch(outputs) inside the block")
-            jax.block_until_ready(self._watched)
-            self.times.append(time.perf_counter() - self._t0)
-        self._watched = None
-        return False
-
-    def summary(self, skip_first: int = 1) -> dict:
-        """Stats over recorded steps (first `skip_first` dropped: compile)."""
-        ts = self.times[skip_first:] or self.times
-        if not ts:
-            return {"steps": 0, "mean_s": 0.0, "min_s": 0.0, "max_s": 0.0}
-        return {
-            "steps": len(ts),
-            "mean_s": sum(ts) / len(ts),
-            "min_s": min(ts),
-            "max_s": max(ts),
-        }

@@ -7,12 +7,13 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 args=("$@")
 filtered=()
-fast=0; tpu=0; fused=0
+fast=0; tpu=0; fused=0; obs=0
 for a in "${args[@]}"; do
   case "$a" in
     --fast) fast=1 ;;
     --tpu) tpu=1 ;;
     --fused) fused=1 ;;
+    --obs) obs=1 ;;
     *) filtered+=("$a") ;;
   esac
 done
@@ -22,7 +23,13 @@ done
 echo "== burstlint (python -m burst_attn_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m burst_attn_tpu.analysis
 
-if [[ $fused == 1 ]]; then
+if [[ $obs == 1 ]]; then
+  # focused lane for the observability subsystem (registry math, spans,
+  # exporters, serve/ring instrumentation) + its burstlint rule mutations —
+  # the quick iteration loop while working on burst_attn_tpu/obs/
+  python -m pytest tests/test_obs.py tests/test_analysis.py -q \
+    ${filtered[@]+"${filtered[@]}"}
+elif [[ $fused == 1 ]]; then
   # focused lane for the fused RDMA-ring kernel's interpret-mode parity
   # tests (the same tests also run in the default/fast lanes — this is the
   # quick iteration loop while working on ops/fused_ring.py)
