@@ -38,7 +38,7 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         # force registration of the lazy rule families
-        from . import astlint, numerics, ringcheck  # noqa: F401
+        from . import astlint, numerics, obscheck, ringcheck  # noqa: F401
 
         for name in sorted(RULES):
             r = RULES[name]

@@ -289,20 +289,30 @@ def _deep_root(node) -> str:
 def _obs_bound_names(tree) -> Set[str]:
     """Module-level names bound to the obs subsystem: imports of
     burst_attn_tpu.obs (any spelling/level) and top-level assignments whose
-    value is rooted at one of those names (e.g. `_C = obs.counter("c")`)."""
+    value is rooted at one of those names (e.g. `_C = obs.counter("c")`).
+
+    `obs.devstats` is EXEMPT by name: it is the deliberately in-jit half of
+    obs — a purely functional telemetry pytree with no registry/span/clock
+    access — and the ring accumulates it under trace by design.  Its purity
+    is not taken on faith: the jaxpr rule `devstats-pure`
+    (analysis/obscheck.py) proves the stats-enabled traces contain zero
+    host-callback primitives."""
     bound: Set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 parts = a.name.split(".")
-                if "obs" in parts:
+                if "obs" in parts and "devstats" not in parts:
                     # `import burst_attn_tpu.obs` binds the ROOT name, but
                     # calls still route through a chain containing obs
                     bound.add(a.asname or parts[0])
         elif isinstance(node, ast.ImportFrom):
             parts = (node.module or "").split(".")
+            if "devstats" in parts:
+                continue
             if "obs" in parts:
-                bound.update(a.asname or a.name for a in node.names)
+                bound.update(a.asname or a.name for a in node.names
+                             if a.name != "devstats")
             else:  # `from .. import obs` / `from burst_attn_tpu import obs`
                 bound.update(a.asname or a.name for a in node.names
                              if a.name == "obs")

@@ -1,4 +1,4 @@
-"""obs-jit-safe, jaxpr half (burstlint family 1).
+"""obs-jit-safe + devstats-pure, jaxpr halves (burstlint family 1).
 
 The AST half (astlint._check_obs_jit_safe) proves no obs BINDING is called
 from a statically jit-marked function; this half closes the dynamic gap —
@@ -11,6 +11,19 @@ primitives: the ring's value is overlap, and a host callback inside the
 ring is a synchronous device<->host round trip per step, exactly the
 regression this subsystem exists to catch.
 
+`devstats-pure` extends the same proof to the device-side telemetry path
+(obs/devstats.py — the one obs module the AST rule deliberately EXEMPTS
+from the jit ban):
+
+  1. the stats-enabled ring forward AND backward
+     (`burst_attn_shard(..., collect_stats=True)` through
+     `jax.value_and_grad`) trace to jaxprs with zero host-callback
+     primitives — collecting telemetry in-graph must never smuggle a
+     host hop into the ring;
+  2. the stats-OFF trace is BIT-IDENTICAL (string-equal jaxpr) to the
+     plain pre-devstats entry point (`_burst_attn_shard_plain`) — turning
+     the feature off must cost nothing, byte for byte.
+
 Flagged primitives: anything whose name contains "callback"
 (pure_callback / io_callback / debug_callback across jax versions) plus
 the legacy host_callback "outside_call".
@@ -19,8 +32,12 @@ the legacy host_callback "outside_call".
 import inspect
 from typing import List
 
-from .core import Finding
+from .core import Finding, rule
 from .jaxpr_tools import iter_eqns
+
+rule("devstats-pure", "jaxpr",
+     "stats-enabled ring fwd/bwd carry zero host-callback primitives; "
+     "stats-off trace bit-identical to the plain ring")(None)
 
 _LEGACY_CALLBACK_PRIMS = ("outside_call",)
 
@@ -36,7 +53,8 @@ def _anchor(fn):
         return "<trace>", 0
 
 
-def check_trace(closed_jaxpr, *, where: str, anchor) -> List[Finding]:
+def check_trace(closed_jaxpr, *, where: str, anchor,
+                rule_name: str = "obs-jit-safe") -> List[Finding]:
     """Flag every host-callback primitive in one traced program."""
     findings: List[Finding] = []
     path, line = anchor
@@ -44,12 +62,43 @@ def check_trace(closed_jaxpr, *, where: str, anchor) -> List[Finding]:
         name = eqn.primitive.name
         if _is_callback_prim(name):
             findings.append(Finding(
-                rule="obs-jit-safe", file=path, line=line,
+                rule=rule_name, file=path, line=line,
                 message=f"{where}: host-callback primitive `{name}` inside "
                         "the traced program — a synchronous device<->host "
                         "round trip per executed step; obs instrumentation "
                         "must stay at the host dispatch boundary"))
     return findings
+
+
+_ADDR_RE = None
+
+
+def _canon_jaxpr(closed_jaxpr) -> str:
+    """Jaxpr pretty-print with run-dependent noise removed: custom_vjp
+    params embed live function objects whose reprs carry heap addresses
+    (`0x7f...`), which differ between two traces of the SAME program."""
+    global _ADDR_RE
+    if _ADDR_RE is None:
+        import re
+
+        _ADDR_RE = re.compile(r"0x[0-9a-f]+")
+    return _ADDR_RE.sub("0x", str(closed_jaxpr))
+
+
+def check_off_identity(jaxpr_off, jaxpr_plain, *, anchor) -> List[Finding]:
+    """devstats-pure half 2: the collect_stats=False trace must be
+    STRING-IDENTICAL (modulo heap addresses) to the plain (pre-devstats)
+    ring program — the only acceptable cost of the telemetry feature when
+    it is off is zero."""
+    path, line = anchor
+    if _canon_jaxpr(jaxpr_off) == _canon_jaxpr(jaxpr_plain):
+        return []
+    return [Finding(
+        rule="devstats-pure", file=path, line=line,
+        message="collect_stats=False ring trace diverged from the plain "
+                "ring program — devstats machinery is leaking into the "
+                "stats-off path (it must be bit-identical to a build "
+                "without devstats)")]
 
 
 def check_all() -> List[Finding]:
@@ -94,4 +143,38 @@ def check_all() -> List[Finding]:
         out_specs=(spec4,) * 3, check_vma=False)
     findings += check_trace(jax.make_jaxpr(bwd)(q, q, q, q, lse, q),
                             where="burst bwd", anchor=_anchor(burst._bwd_impl))
+
+    # ---- devstats-pure: the telemetry path keeps both promises ----
+    anchor_dev = _anchor(burst.burst_attn_shard)
+
+    def stats_fwdbwd(q, k, v):
+        # value_and_grad THROUGH the stats entry: fwd + bwd + every stats
+        # equation land in one jaxpr; summing the stats leaves into the
+        # output keeps them from being dead-code-eliminated
+        def loss(q, k, v):
+            o, st = burst.burst_attn_shard(q, k, v, cfg, collect_stats=True)
+            return jnp.sum(o.astype(jnp.float32)), st
+
+        (l, st), grads = jax.value_and_grad(loss, (0, 1, 2),
+                                            has_aux=True)(q, k, v)
+        st_sum = sum(jnp.sum(x.astype(jnp.float32))
+                     for x in jax.tree.leaves(st))
+        return l + st_sum, grads
+
+    stats_prog = shard_map(stats_fwdbwd, mesh=mesh, in_specs=(spec4,) * 3,
+                           out_specs=(P(), (spec4,) * 3), check_vma=False)
+    findings += check_trace(jax.make_jaxpr(stats_prog)(q, q, q),
+                            where="burst fwd+bwd (collect_stats=True)",
+                            anchor=anchor_dev, rule_name="devstats-pure")
+
+    off = shard_map(
+        lambda q, k, v: burst.burst_attn_shard(q, k, v, cfg,
+                                               collect_stats=False),
+        mesh=mesh, in_specs=(spec4,) * 3, out_specs=spec4, check_vma=False)
+    plain = shard_map(
+        lambda q, k, v: burst._burst_attn_shard_plain(q, k, v, cfg),
+        mesh=mesh, in_specs=(spec4,) * 3, out_specs=spec4, check_vma=False)
+    findings += check_off_identity(jax.make_jaxpr(off)(q, q, q),
+                                   jax.make_jaxpr(plain)(q, q, q),
+                                   anchor=anchor_dev)
     return findings

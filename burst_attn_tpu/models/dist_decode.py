@@ -88,9 +88,10 @@ def dist_prefill(params, tokens, cfg: ModelConfig, mesh, *, gen_budget: int):
     xf = _rms_norm(x, params["final_norm"])
     # only ONE position feeds decoding; the full [B, S, vocab] fp32 logits
     # would be GBs at the contexts this module exists for.  The LAST token
-    # in natural order sits at layout position inv_perm[s-1].
-    # host numpy (perm is a host-side layout table), not a traced value
-    last_pos = int(layouts.inverse_permutation(perm)[s - 1])  # burstlint: disable=host-transfer-in-jit
+    # in natural order sits at layout position inv_perm[s-1] — a host-side
+    # numpy scalar (perm is a layout table, never traced), so it indexes xf
+    # as a static constant under jit with no int() coercion needed.
+    last_pos = layouts.inverse_permutation(perm)[s - 1]
     last_logits = jnp.einsum("bd,vd->bv", xf[:, last_pos], params["lm_head"],
                              preferred_element_type=jnp.float32)
 

@@ -57,6 +57,15 @@ class TrainConfig:
     grad_clip: float = 1.0
     moe_aux_weight: float = 0.01  # weight of the MoE load-balancing loss
     grad_accum: int = 1  # microbatches per optimizer step (scan inside jit)
+    # Collect device-side ring telemetry (obs.devstats) every step: the
+    # forward accumulates a DevStats pytree IN-GRAPH and guarded_step
+    # publishes it into the obs registry after dispatch.  Diagnostic knob:
+    # publishing reads the (tiny) stats arrays back each step, which
+    # synchronizes the host with the step stream — leave off for
+    # steady-state throughput runs (the train.step_interval_s
+    # dispatch-interval histogram stays meaningful either way, the sync
+    # happens after the interval is measured).
+    collect_devstats: bool = False
 
 
 def make_mesh(axis_sizes: dict, devices=None) -> Mesh:
@@ -132,15 +141,24 @@ def init_train_state(key, cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
 
 
 def _loss_parts(params, tokens, positions, labels, cfg: ModelConfig, mesh,
-                segment_ids=None):
-    """(sum of masked nll, MoE aux) — the linear pieces of the objective."""
-    logits, aux = forward_with_aux(params, tokens, positions, cfg, mesh,
-                                   segment_ids=segment_ids)
+                segment_ids=None, collect_stats=False):
+    """(sum of masked nll, MoE aux[, DevStats]) — the linear pieces of the
+    objective; `collect_stats` (static) appends the ring telemetry pytree."""
+    out = forward_with_aux(params, tokens, positions, cfg, mesh,
+                           segment_ids=segment_ids,
+                           collect_stats=collect_stats)
+    if collect_stats:
+        logits, aux, stats = out
+    else:
+        logits, aux = out
     valid = labels >= 0
     labels_safe = jnp.where(valid, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels_safe[..., None], axis=-1)[..., 0]
-    return jnp.sum(jnp.where(valid, nll, 0.0)), aux
+    nll_sum = jnp.sum(jnp.where(valid, nll, 0.0))
+    if collect_stats:
+        return nll_sum, aux, stats
+    return nll_sum, aux
 
 
 def loss_fn(params, tokens, positions, labels, cfg: ModelConfig, mesh,
@@ -254,6 +272,12 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
     opt = _optimizer(tcfg)
     aux_w = tcfg.moe_aux_weight if cfg.n_experts else 0.0
     accum = tcfg.grad_accum
+    collect = tcfg.collect_devstats
+    if collect and accum != 1:
+        raise ValueError(
+            "collect_devstats supports grad_accum=1 only (per-microbatch "
+            "stats inside the accumulation scan would need a scan-carried "
+            "merge; fold it in when a run needs both)")
 
     def grad_of(params, batch):
         return jax.value_and_grad(loss_fn)(
@@ -262,9 +286,26 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
             segment_ids=batch.get("segment_ids"),
         )
 
+    def grad_of_stats(params, batch):
+        # loss_fn's objective with the ring telemetry riding as has_aux;
+        # gradients are bit-identical to grad_of (the stats custom_vjp
+        # reuses the plain backward — burstlint devstats-pure)
+        def scalar(params):
+            nll_sum, aux, stats = _loss_parts(
+                params, batch["tokens"], batch["positions"], batch["labels"],
+                cfg, mesh, segment_ids=batch.get("segment_ids"),
+                collect_stats=True)
+            ce = nll_sum / jnp.maximum(jnp.sum(batch["labels"] >= 0), 1)
+            return ce + aux_w * aux, stats
+
+        (loss, stats), grads = jax.value_and_grad(scalar, has_aux=True)(params)
+        return loss, stats, grads
+
     def step(state, batch):
         params, opt_state = state
-        if accum == 1:
+        if collect:
+            loss, devstats_out, grads = grad_of_stats(params, batch)
+        elif accum == 1:
             loss, grads = grad_of(params, batch)
         else:
             b0 = batch["tokens"].shape[0]
@@ -310,7 +351,10 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         gnorm = optax.global_norm(grads)
-        return (params, opt_state), {"loss": loss, "grad_norm": gnorm}
+        metrics = {"loss": loss, "grad_norm": gnorm}
+        if collect:
+            metrics["devstats"] = devstats_out
+        return (params, opt_state), metrics
 
     jit_step = jax.jit(step, donate_argnums=(0,))
     probed = []
@@ -352,6 +396,21 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh):
                 # count — no device sync
                 _M_TPS.set(batch["tokens"].size / dt)
         last_dispatch[:] = [now]
+        if collect:
+            # fold the (tiny) device stats into the host registry AFTER the
+            # dispatch interval is measured; publish reads the arrays back,
+            # so this is the one host<->device sync the knob buys.  Best
+            # effort: telemetry must never be able to fail a train step.
+            new_state, metrics = out
+            stats = metrics.pop("devstats")
+            try:
+                stats.publish(labels={"source": "train"})
+            except Exception as e:  # noqa: BLE001
+                _M_EVENTS.inc(kind="devstats_publish_failure")
+                logger.warning("devstats publish failed (%s: %s); step "
+                               "continues without telemetry",
+                               type(e).__name__, e)
+            out = (new_state, metrics)
         return out
 
     return guarded_step

@@ -218,8 +218,16 @@ def _attn_out(p, o):
     return jnp.einsum("bnsh,nhd->bsd", o, p["wo"])
 
 
-def _attention(p, x, positions, cfg: ModelConfig, mesh, segment_ids=None):
+def _attention(p, x, positions, cfg: ModelConfig, mesh, segment_ids=None,
+               collect_stats=False):
+    """One attention sublayer.  `collect_stats` (static) additionally
+    returns the ring's in-graph DevStats (burst strategy only — ulysses has
+    no ring to instrument): `(out, DevStats)` instead of `out`."""
     q, k, v = _qkv_proj(p, x, positions, cfg)
+    if collect_stats and cfg.attn_strategy != "burst":
+        raise ValueError(
+            "collect_stats requires attn_strategy='burst' (devstats "
+            f"instruments the ring); got {cfg.attn_strategy!r}")
     if cfg.attn_strategy == "ulysses":
         if len(cfg.seq_axes) != 1:
             raise ValueError("ulysses supports a single sequence axis")
@@ -255,7 +263,11 @@ def _attention(p, x, positions, cfg: ModelConfig, mesh, segment_ids=None):
             head_axes=cfg.head_axis,
             window=cfg.window,
             segment_ids=segment_ids,
+            collect_stats=collect_stats,
         )
+        if collect_stats:
+            o, stats = o
+            return _attn_out(p, o), stats
     else:
         raise ValueError(
             f"unknown attn_strategy {cfg.attn_strategy!r}; "
@@ -366,10 +378,21 @@ def forward(params: Params, tokens, positions, cfg: ModelConfig, mesh,
 
 
 def forward_with_aux(params: Params, tokens, positions, cfg: ModelConfig, mesh,
-                     segment_ids=None):
+                     segment_ids=None, collect_stats=False):
     """forward + the summed MoE auxiliary load-balancing loss (0 for dense
-    models); the trainer adds `moe_aux_weight * aux` to the objective."""
+    models); the trainer adds `moe_aux_weight * aux` to the objective.
+
+    `collect_stats` (static): additionally return the per-device ring
+    telemetry folded across layers (obs.devstats.merge — counts add,
+    extrema max/min) as a third element: `(logits, aux, DevStats)`.  Burst
+    attention only; the pipeline-parallel path keeps its own schedule and
+    does not thread stats."""
     if cfg.pp_axis is not None:
+        if collect_stats:
+            raise ValueError(
+                "collect_stats is not supported on the pipeline-parallel "
+                "path (pp_axis set) — the pp schedule slices layers across "
+                "stages and has no single ring to instrument")
         from .pipeline_lm import pp_forward_with_aux
 
         return pp_forward_with_aux(params, tokens, positions, cfg, mesh,
@@ -384,23 +407,41 @@ def forward_with_aux(params: Params, tokens, positions, cfg: ModelConfig, mesh,
     x = jax.lax.with_sharding_constraint(x, act_spec)
 
     def block(carry, p):
-        x, aux = carry
-        x = x + _attention(p, x, positions, cfg, mesh,
-                           segment_ids=segment_ids)
+        if collect_stats:
+            from ..obs import devstats
+
+            x, aux, stats = carry
+            a, st = _attention(p, x, positions, cfg, mesh,
+                               segment_ids=segment_ids, collect_stats=True)
+            x = x + a
+            stats = st if stats is None else devstats.merge(stats, st)
+        else:
+            x, aux = carry
+            x = x + _attention(p, x, positions, cfg, mesh,
+                               segment_ids=segment_ids)
         m, aux_l = _mlp(p, x, cfg, mesh)
         x = jax.lax.with_sharding_constraint(x + m, act_spec)
+        if collect_stats:
+            return x, aux + aux_l, stats
         return x, aux + aux_l
 
-    carry = (x, jnp.float32(0.0))
+    carry = ((x, jnp.float32(0.0), None) if collect_stats
+             else (x, jnp.float32(0.0)))
     for p in params["layers"]:
         if cfg.remat:
             carry = jax.checkpoint(block)(carry, p)
         else:
             carry = block(carry, p)
-    x, aux = carry
+    if collect_stats:
+        x, aux, stats = carry
+    else:
+        x, aux = carry
 
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum(
         "bsd,vd->bsv", x, params["lm_head"], preferred_element_type=jnp.float32
     )
-    return jax.lax.with_sharding_constraint(logits, logit_spec), aux
+    logits = jax.lax.with_sharding_constraint(logits, logit_spec)
+    if collect_stats:
+        return logits, aux, stats
+    return logits, aux

@@ -36,6 +36,12 @@ from .spans import (
     span, span_records, traced,
 )
 from .logs import dropped_messages, get_logger, safe_warn
+# devstats is the deliberately IN-JIT half of obs: a purely functional
+# telemetry pytree the ring accumulates in-graph (collect_stats=True) and
+# publishes host-side afterwards.  burstlint's obs-jit-safe AST rule
+# exempts it by name; the jaxpr rule `devstats-pure` proves its purity.
+from . import devstats
+from .devstats import DevStats
 
 
 def counter(name: str, help: str = "") -> Counter:
@@ -60,11 +66,25 @@ def to_prometheus() -> str:
     return default_registry().to_prometheus()
 
 
+def _process_index() -> int:
+    """This process's multi-host index (0 single-process / pre-jax-init);
+    lazy so registry-only users never pay a backend initialization."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — uninitialized backend == process 0
+        return 0
+
+
 def export_jsonl(path: str) -> str:
     """Append a full snapshot (metrics + completed spans) to `path`,
-    fsynced.  This is the artifact `python -m burst_attn_tpu.obs` reads."""
+    fsynced, tagged with this process's `process_index` so per-process
+    files merge cleanly (`python -m burst_attn_tpu.obs --merge`).  This is
+    the artifact `python -m burst_attn_tpu.obs` reads."""
     return default_registry().export_jsonl(path,
-                                           extra_records=span_records())
+                                           extra_records=span_records(),
+                                           process_index=_process_index())
 
 
 def reset() -> None:
@@ -74,9 +94,10 @@ def reset() -> None:
 
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "Registry", "Span", "StepTimer",
-    "LATENCY_BUCKETS_S", "annotate", "completed_spans", "counter",
-    "current_span", "default_registry", "dropped_messages", "export_jsonl",
-    "gauge", "get_logger", "histogram", "reset", "reset_spans", "safe_warn",
-    "snapshot", "span", "span_records", "to_prometheus", "traced",
+    "Counter", "DevStats", "Gauge", "Histogram", "Registry", "Span",
+    "StepTimer", "LATENCY_BUCKETS_S", "annotate", "completed_spans",
+    "counter", "current_span", "default_registry", "devstats",
+    "dropped_messages", "export_jsonl", "gauge", "get_logger", "histogram",
+    "reset", "reset_spans", "safe_warn", "snapshot", "span", "span_records",
+    "to_prometheus", "traced",
 ]

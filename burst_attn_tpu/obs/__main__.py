@@ -1,10 +1,16 @@
 """obs CLI:  python -m burst_attn_tpu.obs [--json] [--prom] [--file PATH]
+                                          [--merge GLOB [--by-process]]
 
 Renders a report from a run's JSONL export (written by
 `obs.export_jsonl`, which bench.py, benchmarks/ring_overlap.py and the
 training runner call).  A file may hold several export snapshots (the
 exporter appends); the report shows each metric's LAST exported state —
 i.e. the final state of the run — and aggregates spans across snapshots.
+
+`--merge GLOB` switches to the MULTI-PROCESS view: every matching file is
+one process's export, and the report is the job-level fold (counters sum,
+histograms add bucket-wise, gauges keep a `process_index` label — see
+obs/aggregate.py).  `--by-process` keeps every child per process instead.
 
 Exit status: 0 on a rendered report, 1 when the file is missing/empty,
 2 on unparseable content.
@@ -166,7 +172,36 @@ def main(argv=None) -> int:
                     help="emit machine-readable JSON")
     ap.add_argument("--prom", action="store_true",
                     help="emit Prometheus text exposition format")
+    ap.add_argument("--merge", action="append", metavar="GLOB", default=[],
+                    help="merge per-process exports matching this glob into "
+                         "one job-level report (repeatable)")
+    ap.add_argument("--by-process", action="store_true",
+                    help="with --merge: keep every metric child per process "
+                         "(process_index label) instead of folding")
     args = ap.parse_args(argv)
+
+    if args.merge:
+        from .aggregate import merge_files, resolve_files
+
+        try:
+            metrics, spans, meta = merge_files(args.merge,
+                                               by_process=args.by_process)
+        except FileNotFoundError as e:
+            print(f"obs: {e}", file=sys.stderr)
+            return 1
+        except ValueError as e:
+            print(f"obs: {e}", file=sys.stderr)
+            return 2
+        source = (f"merge of {meta['processes']} process export(s) "
+                  f"[{', '.join(resolve_files(args.merge))}]")
+        if args.prom:
+            sys.stdout.write(render_prometheus(metrics))
+        elif args.as_json:
+            print(json.dumps({"source": source, "meta": meta,
+                              "metrics": metrics, "spans": spans}, indent=1))
+        else:
+            print(render_text(metrics, spans, meta, source))
+        return 0
 
     if not os.path.exists(args.file):
         print(f"obs: no export at {args.file} (run bench.py or call "

@@ -1,0 +1,155 @@
+"""Cross-process obs aggregation: merge per-process JSONL exports.
+
+A multi-host job (jax.process_count() > 1) exports ONE JSONL snapshot file
+per process (`obs.export_jsonl` tags the `meta` header with that process's
+`process_index`).  This module folds those per-process final states into a
+single job-level report — the "one merged metrics view per job" the serving
+north star needs — with Prometheus-style semantics per metric kind:
+
+  counters    SUM across processes (each process counted disjoint events)
+  gauges      last-wins is only meaningful WITHIN a process, so gauges keep
+              a `process_index` label instead of being merged away
+  histograms  bucket-wise ADD when the bucket edges agree (they do for any
+              same-binary job); edge-mismatched children fall back to
+              per-process children with a `process_index` label
+  spans       concatenated, each tagged `process_index`
+
+`--by-process` skips the cross-process arithmetic entirely: every metric
+child keeps its own `process_index` label (the per-process drill-down view).
+
+CLI:  python -m burst_attn_tpu.obs --merge 'results/obs*.jsonl'
+                                   [--by-process] [--json | --prom]
+"""
+
+import glob
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from .__main__ import load_records, merge_records
+
+
+def resolve_files(patterns: Sequence[str]) -> List[str]:
+    """Expand globs (sorted, deduped).  Literal paths pass through."""
+    out = []
+    for pat in patterns:
+        hits = sorted(glob.glob(pat))
+        out += hits if hits else ([pat] if os.path.exists(pat) else [])
+    seen, files = set(), []
+    for f in out:
+        if f not in seen:
+            seen.add(f)
+            files.append(f)
+    return files
+
+
+def load_process_states(files: Sequence[str]):
+    """Per-process final states: [(process_label, metrics, spans, meta)].
+
+    Each file is one process's (possibly multi-snapshot) export; within a
+    file the existing last-wins merge applies.  The process label comes
+    from the newest `meta` record's `process_index` when present (the
+    exporter writes it), else the file's position in the sorted list —
+    and collides are disambiguated by position so two re-exports of
+    process 0 never silently alias."""
+    states = []
+    used = set()
+    for i, path in enumerate(files):
+        records = load_records(path)  # raises ValueError on bad lines
+        if not records:
+            continue
+        metrics, spans, meta = merge_records(records)
+        label = None
+        for rec in records:
+            if rec.get("kind") == "meta" and "process_index" in rec:
+                label = rec["process_index"]  # newest snapshot wins
+        if label is None or str(label) in used:
+            label = i
+        label = str(label)
+        used.add(label)
+        states.append((label, metrics, spans, dict(meta, file=path)))
+    return states
+
+
+def _child_key(rec: dict, extra: Tuple = ()) -> tuple:
+    return (rec["kind"], rec.get("name"),
+            tuple(sorted((rec.get("labels") or {}).items())) + tuple(extra))
+
+
+def _tagged(rec: dict, proc: str) -> dict:
+    out = dict(rec)
+    out["labels"] = dict(rec.get("labels") or {}, process_index=proc)
+    return out
+
+
+def merge_processes(states, by_process: bool = False):
+    """Fold per-process final states into one report.
+
+    Returns (metrics, spans, meta) in the same record schema the CLI
+    renderers consume.  See the module docstring for per-kind semantics."""
+    metrics: Dict[tuple, dict] = {}
+    spans: List[dict] = []
+    n_snapshots = 0
+    last_ts = ""
+    for proc, proc_metrics, proc_spans, proc_meta in states:
+        n_snapshots += proc_meta.get("snapshots", 0)
+        last_ts = max(last_ts, proc_meta.get("last_ts_utc", ""))
+        for rec in proc_spans:
+            spans.append(dict(rec, process_index=proc))
+        for rec in proc_metrics:
+            kind = rec["kind"]
+            if by_process or kind == "gauge":
+                # gauges: last-wins is per-process state; a cross-process
+                # sum/last would fabricate a value no process ever reported
+                tagged = _tagged(rec, proc)
+                metrics[_child_key(tagged)] = tagged
+                continue
+            key = _child_key(rec)
+            have = metrics.get(key)
+            if have is None:
+                metrics[key] = dict(rec, labels=dict(rec.get("labels") or {}))
+            elif kind == "counter":
+                have["value"] += rec["value"]
+            elif kind == "histogram":
+                if have.get("bucket_edges") == rec.get("bucket_edges"):
+                    have["count"] += rec["count"]
+                    have["sum"] += rec["sum"]
+                    have["min"] = min(have["min"], rec["min"])
+                    have["max"] = max(have["max"], rec["max"])
+                    have["bucket_counts"] = [
+                        a + b for a, b in zip(have["bucket_counts"],
+                                              rec["bucket_counts"])]
+                    have["overflow"] = (have.get("overflow", 0)
+                                        + rec.get("overflow", 0))
+                else:
+                    # mismatched edges (mixed binaries): keep both children
+                    # apart rather than adding apples to oranges
+                    tagged = _tagged(rec, proc)
+                    metrics[_child_key(tagged)] = tagged
+            else:  # unknown kinds pass through per process
+                tagged = _tagged(rec, proc)
+                metrics[_child_key(tagged)] = tagged
+    meta = {
+        "snapshots": n_snapshots,
+        "last_ts_utc": last_ts,
+        "processes": len(states),
+        "process_labels": [s[0] for s in states],
+        "n_metrics": len(metrics),
+        "n_spans": len(spans),
+    }
+    return list(metrics.values()), spans, meta
+
+
+def merge_files(patterns: Sequence[str], by_process: bool = False):
+    """Glob -> per-process states -> one merged (metrics, spans, meta).
+
+    Raises FileNotFoundError when the patterns match nothing and ValueError
+    on unparseable content (the CLI maps these to exit 1 / 2)."""
+    files = resolve_files(patterns)
+    if not files:
+        raise FileNotFoundError(
+            f"no obs exports match {list(patterns)!r}")
+    states = load_process_states(files)
+    if not states:
+        raise FileNotFoundError(
+            f"obs exports {files!r} contain no records")
+    return merge_processes(states, by_process=by_process)

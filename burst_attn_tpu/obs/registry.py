@@ -294,11 +294,15 @@ class Registry:
                          f" {rec['count']}")
         return "\n".join(lines) + ("\n" if lines else "")
 
-    def export_jsonl(self, path: str, extra_records: Sequence[dict] = ()
-                     ) -> str:
+    def export_jsonl(self, path: str, extra_records: Sequence[dict] = (),
+                     process_index: Optional[int] = None) -> str:
         """Append a full snapshot to `path` (one JSON object per line,
         `meta` header first), fsynced before returning — a run killed right
-        after export still leaves a complete, parseable file."""
+        after export still leaves a complete, parseable file.
+
+        `process_index`: multi-host process label written into the meta
+        header (the CLI `--merge` reader keys per-process states on it);
+        the registry itself stays jax-free — obs.export_jsonl fills it in."""
         records = self.snapshot()
         meta = {
             "kind": "meta",
@@ -306,6 +310,8 @@ class Registry:
             "pid": os.getpid(),
             "n_records": len(records) + len(extra_records),
         }
+        if process_index is not None:
+            meta["process_index"] = int(process_index)
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         with open(path, "a", encoding="utf-8") as f:

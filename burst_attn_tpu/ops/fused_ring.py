@@ -67,7 +67,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .masks import round_spec
+from .masks import round_spec, spec_live, spec_pair_count
 from .pallas_flash import (
     LN2,
     LOG2E,
@@ -198,10 +198,9 @@ def _fused_fwd_kernel(
     sched_ref,
     q_ref, k_hbm, v_hbm,
     o_ref, lse_ref,
-    kbuf, vbuf, kchunk, vchunk, mstat, lstat, accbuf, acc_in, acc_scr,
-    m_sw, l_sw,
-    cp_sem, chunk_sem, acc_sem, ksend, krecv, vsend, vrecv, free_sem,
-    *, world, slots, scale, bq, bkv, lp, nqb, nkb, group, n_b, n_h, hw_sync,
+    *rest,
+    world, slots, scale, bq, bkv, lp, nqb, nkb, group, n_b, n_h, hw_sync,
+    collect,
 ):
     """One grid step = q-block i of head h, batch b_, ring round r.
 
@@ -209,6 +208,13 @@ def _fused_fwd_kernel(
     per-round (q_lo, q_hi, kv_hi, causal, offset, slot) — mask scalars from
     ops/masks.round_spec plus the exported slot schedule — and row `world`
     holds (me, right, left, 0, 0, 0) neighbor ids.
+
+    `collect` (static) appends one more OUTPUT before the scratch refs: a
+    [1, slots] int32 SMEM array counting, per communication slot, how many
+    rounds consumed a chunk out of that slot — the devstats slot-reuse
+    counter (obs/devstats.py).  Pure scalar writes at the first grid step
+    of each round; the compute/DMA choreography is untouched, so stats-off
+    and stats-on kernels produce bit-identical o/lse.
 
     Semaphore ledger (everything drains to zero):
       krecv/vrecv[slot]  +1 per arriving send (left neighbor, rounds 1..W-1)
@@ -221,6 +227,13 @@ def _fused_fwd_kernel(
                          the end of rounds 0..W-1-slots.  Credits granted ==
                          credits taken == max(0, W-1-(slots-1)).
     """
+    if collect:
+        slot_use_ref = rest[0]
+        rest = rest[1:]
+    (kbuf, vbuf, kchunk, vchunk, mstat, lstat, accbuf, acc_in, acc_scr,
+     m_sw, l_sw, cp_sem, chunk_sem, acc_sem, ksend, krecv, vsend, vrecv,
+     free_sem) = rest
+
     r = pl.program_id(0)
     b_ = pl.program_id(1)
     h = pl.program_id(2)
@@ -230,6 +243,18 @@ def _fused_fwd_kernel(
     slot = sched_ref[r, 5]
     first_of_round = (b_ == 0) & (h == 0) & (i == 0)
     last_of_round = (b_ == n_b - 1) & (h == n_h - 1) & (i == nqb - 1)
+
+    if collect:
+        @pl.when(first_of_round)
+        def _slot_tally():
+            # devstats slot-reuse counter: zero once at round 0, then one
+            # scalar SMEM increment per round for the slot being consumed
+            @pl.when(r == 0)
+            def _zero():
+                for j in range(slots):
+                    slot_use_ref[0, j] = 0
+
+            slot_use_ref[0, slot] = slot_use_ref[0, slot] + 1
 
     # ---- round choreography (first grid step of the round only) ----
     @pl.when(first_of_round & (r == 0))
@@ -408,12 +433,18 @@ def _fused_fwd_kernel(
 # shard-level entry point
 
 
-def fused_ring_fwd(q, k, v, cfg, *, interpret=None):
+def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
     """Forward burst attention on per-shard arrays via the fused ring kernel.
 
     Call inside shard_map on the ring axis (same contract as
     parallel/burst._fwd_impl): q [B, N, S, D], k/v [B, Nk, S, D] in layout
-    order.  Returns (o [B, N, S, D] in q.dtype, lse [B, N, S] f32).
+    order.  Returns (o [B, N, S, D] in q.dtype, lse [B, N, S] f32) — plus a
+    per-shard obs.devstats.DevStats when `collect_stats`: mask occupancy and
+    liveness are derived in-graph from the SAME sched-table specs the kernel
+    masks by, slot-reuse counts come out of the kernel itself as an extra
+    scalar (SMEM) output, and lse/o health is computed on the results.  The
+    stats-off call emits the identical kernel (no extra output), so traces
+    without stats are bit-identical to pre-devstats builds.
     Callers must have checked `supported` first.
     """
     b, n, s, d = q.shape
@@ -439,9 +470,11 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None):
     part_me = my_partition(cfg.intra_axis, None)
     slot_sched = fused_slot_schedule(world, slots)
     rows = []
+    specs = []  # per-round MaskSpecs, reused for devstats occupancy tallies
     for r in range(world):
         sp = round_spec(part_me, partition_at_round(r, cfg.intra_axis, None),
                         s, s, cfg.causal, cfg.layout)
+        specs.append(sp)
         rows.append(jnp.concatenate(
             [_spec_array(sp),
              jnp.asarray([int(slot_sched[r])], jnp.int32)]))
@@ -455,11 +488,29 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None):
     kernel = functools.partial(
         _fused_fwd_kernel, world=world, slots=slots, scale=scale, bq=bq,
         bkv=bkv, lp=lp, nqb=nqb, nkb=nkb, group=group, n_b=b, n_h=n,
-        hw_sync=not interpret,
+        hw_sync=not interpret, collect=collect_stats,
     )
 
     def q_map(r, b_, h, i, sp):
         return (b_, h, i, 0)
+
+    out_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        # whole-array resident block: written row-range-wise at the last
+        # round, flushed once (block dims == array dims, always legal)
+        pl.BlockSpec((b, n, s // lp, lp),
+                     lambda r, b_, h, i, sp: (0, 0, 0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+        jax.ShapeDtypeStruct((b, n, s // lp, lp), jnp.float32),
+    ]
+    if collect_stats:
+        # devstats slot-reuse counts: whole-array SMEM output, scalar writes
+        # only at round boundaries (see _fused_fwd_kernel)
+        out_specs.append(
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.SMEM))
+        out_shape.append(jax.ShapeDtypeStruct((1, slots), jnp.int32))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -469,13 +520,7 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None):
             pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
             pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
         ],
-        out_specs=[
-            pl.BlockSpec((1, 1, bq, d), q_map),
-            # whole-array resident block: written row-range-wise at the last
-            # round, flushed once (block dims == array dims, always legal)
-            pl.BlockSpec((b, n, s // lp, lp),
-                         lambda r, b_, h, i, sp: (0, 0, 0, 0)),
-        ],
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.ANY((slots, b, n_kv, s, d), k.dtype),   # kbuf
             pltpu.ANY((slots, b, n_kv, s, d), v.dtype),   # vbuf
@@ -498,13 +543,10 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None):
             pltpu.SemaphoreType.REGULAR,                  # free_sem
         ],
     )
-    o, lse_packed = pl.pallas_call(
+    outs = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b, n, s // lp, lp), jnp.float32),
-        ],
+        out_shape=out_shape,
         # everything is sequential by construction: the ring choreography,
         # the VMEM-resident stats, and the acc carry all assume one core
         # walks the grid in order — a megacore split would race them
@@ -515,4 +557,20 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None):
         ),
         interpret=interpret,
     )(sched, q, k, v)
-    return o, _unpack(lse_packed)
+    o, lse_packed = outs[0], outs[1]
+    lse = _unpack(lse_packed)
+    if not collect_stats:
+        return o, lse
+    from ..obs import devstats
+
+    # occupancy/liveness from the SAME per-round specs the kernel masks by;
+    # the fused ring executes every scheduled round (dead contig-causal
+    # rounds run fully masked instead of being cond-skipped)
+    pairs = sum(spec_pair_count(sp, s, s) for sp in specs)
+    live = sum(spec_live(sp).astype(jnp.int32) for sp in specs)
+    stats = devstats.ring_stats(
+        rounds=world, rounds_live=live, attn_pairs=pairs,
+        total_pairs=float(world) * s * s, head_dim=d,
+        m=None,  # the running row max never leaves the kernel
+        lse=lse, acc=o, fused_rounds=world, slot_use=outs[2])
+    return o, lse, stats

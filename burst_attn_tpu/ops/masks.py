@@ -128,6 +128,31 @@ def spec_live(spec: MaskSpec, window=None):
     return live
 
 
+def spec_pair_count(spec: MaskSpec, s_q: int, s_kv: int, window=None):
+    """Traced f32 scalar: number of attending (row, col) pairs of one
+    round's tile — the closed-ish form of `dense_mask(...).sum()` without
+    materializing the [s_q, s_kv] mask (an O(s_q) row sweep instead of
+    O(s_q * s_kv) booleans).
+
+    This is the devstats mask-occupancy numerator (obs/devstats.py): per
+    ring round, each live row i contributes the clamped width of its
+    visible column interval [max(0, i + offset - window + 1),
+    min(kv_hi - 1, i + offset)] (the causal/window band), or the full
+    [0, kv_hi) range when the round is non-causal.  Asserted equal to the
+    dense-mask sum in tests/test_devstats.py."""
+    rows = jnp.arange(s_q, dtype=jnp.int32)
+    in_row = (rows >= spec.q_lo) & (rows < spec.q_hi)
+    hi = jnp.where(spec.causal > 0,
+                   jnp.minimum(spec.kv_hi - 1, rows + spec.offset),
+                   spec.kv_hi - 1)
+    lo = jnp.zeros_like(rows)
+    if window is not None:
+        lo = jnp.where(spec.causal > 0,
+                       jnp.maximum(lo, rows + spec.offset - window + 1), lo)
+    n = jnp.clip(hi - lo + 1, 0, s_kv)
+    return jnp.sum(jnp.where(in_row, n, 0)).astype(jnp.float32)
+
+
 def dense_mask(spec: MaskSpec, s_q: int, s_kv: int, window=None) -> jnp.ndarray:
     """Materialize the [s_q, s_kv] boolean mask (True = attend).
 

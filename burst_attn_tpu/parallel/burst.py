@@ -48,8 +48,9 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import obs
+from ..obs import devstats
 from ..ops import tile as jnp_tile
-from ..ops.masks import full_spec, round_spec, spec_live
+from ..ops.masks import full_spec, round_spec, spec_live, spec_pair_count
 from .ring import (ppermute_by, ppermute_next, my_partition,
                    partition_at_round, ring_round_counts)
 from ..utils.compat import axis_size, shard_map
@@ -219,11 +220,16 @@ def _r_live(cfg, s, s_kv, n_inter, n_intra):
 # forward
 
 
-def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
+def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None, collect=False):
     """Ring forward. Per-shard shapes q [B,N,S,D], k/v [B,Nk,S,D].
 
     Reference call stack SURVEY.md §3.1 / burst_attn_interface.py:170-253.
-    Returns (o, lse) with o [B,N,S,D] in q.dtype, lse [B,N,S] f32.
+    Returns (o, lse) with o [B,N,S,D] in q.dtype, lse [B,N,S] f32 — plus a
+    per-shard obs.devstats.DevStats as a third element when `collect`.
+
+    `collect` is a STATIC flag: every stats equation sits behind
+    `if collect`, so the collect=False trace is bit-identical to a build
+    without devstats at all (proved by burstlint `devstats-pure`).
 
     `seg` [B, S] int32 (optional): packed-sequence ids for the LOCAL shard,
     in the same layout order as q/k/v.  The kv-side ids ride the KV ring
@@ -240,7 +246,8 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
 
         reason = fused_ring.supported(cfg, q.shape, k.shape, seg is not None)
         if reason is None:
-            return fused_ring.fused_ring_fwd(q, k, v, cfg)
+            return fused_ring.fused_ring_fwd(q, k, v, cfg,
+                                             collect_stats=collect)
         logger.info("fused_ring backend falling back to the scan ring: %s",
                     reason)
 
@@ -326,6 +333,21 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
                 st)
         return _tile_fwd(cfg, q, k_c, v_c, *st, scale, spec, segments=segs)
 
+    def round_tally(r):
+        # devstats (collect only): one round's (live, attended pairs) from
+        # the UNIFORM mask spec — by construction the same attended set the
+        # case-split branches compute (ops/masks.py module docstring), so
+        # the tally is layout-exact without touching the kernels.
+        kv_part = partition_at_round(r, cfg.intra_axis, cfg.inter_axis)
+        sp_u = round_spec(part_me, kv_part, s, k.shape[2], cfg.causal,
+                          cfg.layout, window=cfg.window)
+        return (spec_live(sp_u, cfg.window).astype(jnp.int32),
+                spec_pair_count(sp_u, s, k.shape[2], cfg.window))
+
+    def tally_add(dv, r):
+        live, pairs = round_tally(r)
+        return dv[0] + live, dv[1] + pairs
+
     # Static round truncation (windowed single ring): round r's kv offset is
     # delta = r*s for r <= me and negative (future, dead) past that, so
     # every round >= r_live is dead on EVERY device — don't run them and
@@ -355,6 +377,11 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
     with jax.named_scope("obs.ring.round0_self"):
         state = _tile_fwd(cfg, q, k, v, None, None, None, scale, spec0,
                           triangular=tri0, segments=segs0)
+    if collect:
+        # devstats accumulators ride NEXT TO the state; every touch is
+        # behind `if collect` so the stats-off trace stays bit-identical
+        dv = tally_add((jnp.int32(0), jnp.float32(0.0)), jnp.int32(0))
+        rounds_exec = 1
 
     for c in range(n_inter):
         if c < n_inter - 1:
@@ -372,21 +399,41 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None):
         if r_live - 1 > start:
 
             def body(carry, s_idx, c=c):
-                kv_c, st = carry
+                if collect:
+                    kv_c, st, dv_c = carry
+                else:
+                    kv_c, st = carry
                 kv_next = ppermute_next(kv_c, cfg.intra_axis)  # overlaps compute
                 st = compute(st, kv_c, c * n_intra + s_idx)
+                if collect:
+                    dv_c = tally_add(dv_c, c * n_intra + s_idx)
+                    return (kv_next, st, dv_c), None
                 return (kv_next, st), None
 
             with jax.named_scope(f"obs.ring.cycle{c}.scan_rounds"):
-                (kv, state), _ = lax.scan(body, (kv, state),
-                                          jnp.arange(start, r_live - 1))
+                if collect:
+                    (kv, state, dv), _ = lax.scan(
+                        body, (kv, state, dv), jnp.arange(start, r_live - 1))
+                    rounds_exec += r_live - 1 - start
+                else:
+                    (kv, state), _ = lax.scan(body, (kv, state),
+                                              jnp.arange(start, r_live - 1))
         # last round of the cycle: no intra send (reference comm.py:238-251)
         with jax.named_scope(f"obs.ring.cycle{c}.last_round"):
             state = compute(state, kv, jnp.int32(c * n_intra + r_live - 1))
+        if collect:
+            dv = tally_add(dv, jnp.int32(c * n_intra + r_live - 1))
+            rounds_exec += 1
         if c < n_inter - 1:
             kv = kv_base = kv_base_next
     m, lse, acc = state
     o = jnp_tile.finalize(m, lse, acc, q.dtype)
+    if collect:
+        stats = devstats.ring_stats(
+            rounds=rounds_exec, rounds_live=dv[0], attn_pairs=dv[1],
+            total_pairs=float(rounds_exec) * s * k.shape[2], head_dim=d,
+            m=m, lse=lse, acc=acc)
+        return o, lse, stats
     return o, lse
 
 
@@ -576,7 +623,8 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
 # custom_vjp
 
 
-def burst_attn_shard(q, k, v, cfg: BurstConfig, segment_ids=None):
+def burst_attn_shard(q, k, v, cfg: BurstConfig, segment_ids=None,
+                     collect_stats: bool = False):
     """Burst attention on per-shard arrays — call inside shard_map.
 
     q: [B, N, S_local, D]; k, v: [B, Nk, Skv_local, D] (GQA when Nk < N;
@@ -584,7 +632,9 @@ def burst_attn_shard(q, k, v, cfg: BurstConfig, segment_ids=None):
     segment_ids: optional [B, S_local] int32 packed-sequence ids for the
     LOCAL shard, in the same layout order as q/k/v (use
     layouts.to_layout(ids, layout, world, axis=1) for zigzag/striped).
-    Returns o: [B, N, S_local, D] in q.dtype.
+    Returns o: [B, N, S_local, D] in q.dtype — or (o, DevStats) with
+    per-shard in-graph ring telemetry when `collect_stats`
+    (obs/devstats.py; the stats ride the forward, gradients are untouched).
     """
     if q.shape[2] != k.shape[2] and (
             cfg.causal or cfg.window is not None or segment_ids is not None):
@@ -596,6 +646,10 @@ def burst_attn_shard(q, k, v, cfg: BurstConfig, segment_ids=None):
         raise ValueError(
             f"cross-attention (s_q {q.shape[2]} != s_kv {k.shape[2]}) "
             "supports non-causal contig without segment_ids only")
+    if collect_stats:
+        if segment_ids is None:
+            return _burst_attn_shard_stats(q, k, v, cfg)
+        return _burst_attn_shard_stats_seg(q, k, v, segment_ids, cfg)
     if segment_ids is None:
         return _burst_attn_shard_plain(q, k, v, cfg)
     return _burst_attn_shard_seg(q, k, v, segment_ids, cfg)
@@ -642,6 +696,58 @@ def _seg_vjp_bwd(cfg, residuals, do):
 
 
 _burst_attn_shard_seg.defvjp(_seg_vjp_fwd, _seg_vjp_bwd)
+
+
+# stats-collecting twins: (o, DevStats) outputs, IDENTICAL backward.  The
+# stats are forward-only telemetry — their cotangents are dropped and the
+# residuals/bwd math are byte-for-byte the plain path's, so grads under
+# collect_stats=True equal the plain grads bit-for-bit
+# (tests/test_devstats.py asserts this on the 8-dev mesh).
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _burst_attn_shard_stats(q, k, v, cfg: BurstConfig):
+    o, _, stats = _fwd_impl(q, k, v, cfg, collect=True)
+    return o, stats
+
+
+def _stats_vjp_fwd(q, k, v, cfg):
+    o, lse, stats = _fwd_impl(q, k, v, cfg, collect=True)
+    return (o, stats), (q, k, v, o, lse)
+
+
+def _stats_vjp_bwd(cfg, residuals, cts):
+    do, _dstats = cts  # stats are telemetry: cotangent ignored
+    q, k, v, o, lse = residuals
+    dq, dk, dv = _bwd_impl(cfg, q, k, v, o, lse, do)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_burst_attn_shard_stats.defvjp(_stats_vjp_fwd, _stats_vjp_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _burst_attn_shard_stats_seg(q, k, v, seg, cfg: BurstConfig):
+    o, _, stats = _fwd_impl(q, k, v, cfg, seg=seg, collect=True)
+    return o, stats
+
+
+def _stats_seg_vjp_fwd(q, k, v, seg, cfg):
+    o, lse, stats = _fwd_impl(q, k, v, cfg, seg=seg, collect=True)
+    return (o, stats), (q, k, v, seg, o, lse)
+
+
+def _stats_seg_vjp_bwd(cfg, residuals, cts):
+    import numpy as np
+
+    do, _dstats = cts
+    q, k, v, seg, o, lse = residuals
+    dq, dk, dv = _bwd_impl(cfg, q, k, v, o, lse, do, seg=seg)
+    dseg = np.zeros(seg.shape, dtype=jax.dtypes.float0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dseg
+
+
+_burst_attn_shard_stats_seg.defvjp(_stats_seg_vjp_fwd, _stats_seg_vjp_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -759,6 +865,7 @@ def burst_attn(
     fused_kv_slots: Optional[int] = None,
     fused_block_q: Optional[int] = None,
     fused_block_kv: Optional[int] = None,
+    collect_stats: bool = False,
 ) -> jax.Array:
     """Burst attention on global arrays [B, N, S, D]; S must already be in
     layout order (parallel/layouts.to_layout) for causal runs.
@@ -771,6 +878,11 @@ def burst_attn(
     segment_ids: optional [B, S] int32 packed-sequence ids (non-negative),
     permuted into the SAME layout order as the sequence; attention never
     crosses a segment boundary — the kv-side ids ride the KV ring.
+    collect_stats: return `(o, obs.devstats.DevStats)` instead of `o` —
+    in-graph ring telemetry with a leading per-device axis of length
+    `world` (batch/head replica groups are pre-reduced in-graph).  Fold it
+    into the host registry with `stats.publish()` AFTER the step; gradients
+    through `o` are bit-identical to the collect_stats=False path.
     """
     if isinstance(seq_axes, str):
         seq_axes = (seq_axes,)
@@ -807,6 +919,44 @@ def burst_attn(
                    batch_axes, head_axes)
     seq_spec = seq_axes if len(seq_axes) > 1 else intra_axis
     spec = P(batch_axes, head_axes, seq_spec, None)
+    if collect_stats:
+        # stats come back stacked over the ring axis (leading axis length
+        # world); batch/head replica groups are reduced IN-GRAPH so every
+        # ring position reports one consistent value no matter how many
+        # dp/tp shards ride alongside (devstats.cross_reduce)
+        def _flat(axes):
+            if axes is None:
+                return ()
+            axes = axes if isinstance(axes, (tuple, list)) else (axes,)
+            return tuple(a for a in axes
+                         if a is not None and mesh.shape.get(a, 1) > 1)
+
+        extra_axes = _flat(batch_axes) + _flat(head_axes)
+        stats_spec = jax.tree.map(
+            lambda _: P(seq_spec), devstats.DevStats(*devstats.DevStats._fields))
+
+        def run_stats(q, k, v, seg=None):
+            o, st = burst_attn_shard(q, k, v, cfg, seg, collect_stats=True)
+            # custom_vjp outputs look differentiable to an OUTER grad trace
+            # (the in-call stop_gradient is opaque to it); re-severing here
+            # keeps the pmax/pmin cross-reduce off the autodiff path
+            st = jax.tree.map(lax.stop_gradient, st)
+            st = devstats.cross_reduce(st, extra_axes)
+            return o, devstats.expand_device_axis(st)
+
+        if segment_ids is not None:
+            seg_spec = P(batch_axes, seq_spec)
+            fn = shard_map(
+                run_stats, mesh=mesh,
+                in_specs=(spec, spec, spec, seg_spec),
+                out_specs=(spec, stats_spec), check_vma=False,
+            )
+            return fn(q, k, v, jnp.asarray(segment_ids, jnp.int32))
+        fn = shard_map(
+            run_stats, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=(spec, stats_spec), check_vma=False,
+        )
+        return fn(q, k, v)
     if segment_ids is not None:
         seg_spec = P(batch_axes, seq_spec)
         fn = shard_map(

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Perf-regression gate: compare fresh headline records against history.
+
+The bench trajectory lives in two places: `results/headline*.json` (the
+freshest on-chip records bench.py fsyncs) and the driver-captured
+`BENCH_*.json` round files (plus `BASELINE.json`'s published reference
+numbers, when it carries any).  This gate fails — exit 1 — when any current
+headline value drops more than `--tolerance` below the BEST prior value for
+the same metric string, so a perf regression is caught at bench time
+instead of three rounds later in a VERDICT.
+
+    python scripts/check_regression.py                # gate (exit 1 on regression)
+    python scripts/check_regression.py --dry-run      # report only, exit 0
+    python scripts/check_regression.py --tolerance 0.05
+
+Matching is by the exact `metric` string (configs self-describe:
+"... TFLOPs/s/chip @ seq=65536 causal bf16"), value direction is
+higher-is-better.  Metrics with no history PASS with a note — a brand-new
+config cannot regress.  Cached headline replays still gate: a cached record
+IS a prior on-chip measurement, and history only moves when fresh runs land.
+
+Exit status: 0 clean (or --dry-run), 1 regression, 2 internal error
+(missing/unparseable current headline counts as 2 — the gate cannot run).
+
+No third-party imports — runs anywhere the repo checks out.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_json(path):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def load_headlines(patterns):
+    """[(path, metric, value)] from headline-style records."""
+    out = []
+    for pat in patterns:
+        for path in sorted(glob.glob(pat)):
+            try:
+                rec = _load_json(path)
+            except (OSError, ValueError) as e:
+                raise RuntimeError(f"unreadable headline {path}: {e}")
+            if not isinstance(rec, dict) or "metric" not in rec:
+                raise RuntimeError(f"{path}: not a headline record")
+            out.append((path, str(rec["metric"]), float(rec["value"])))
+    return out
+
+
+def load_history(patterns, baseline_path):
+    """metric -> (best_value, source) over BENCH round files + BASELINE
+    published numbers.  Files that don't parse or carry no number are
+    skipped silently — history is best-effort evidence, the gate only
+    needs what it can read."""
+    best = {}
+
+    def _offer(metric, value, source):
+        metric = str(metric)
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            return
+        if metric not in best or value > best[metric][0]:
+            best[metric] = (value, source)
+
+    for pat in patterns:
+        for path in sorted(glob.glob(pat)):
+            try:
+                rec = _load_json(path)
+            except (OSError, ValueError):
+                continue
+            parsed = rec.get("parsed") if isinstance(rec, dict) else None
+            if isinstance(parsed, dict) and "metric" in parsed:
+                _offer(parsed.get("metric"), parsed.get("value"),
+                       os.path.basename(path))
+            elif isinstance(rec, dict) and "metric" in rec:
+                _offer(rec.get("metric"), rec.get("value"),
+                       os.path.basename(path))
+    if baseline_path and os.path.exists(baseline_path):
+        try:
+            base = _load_json(baseline_path)
+        except (OSError, ValueError):
+            base = {}
+        # BASELINE.json "published": {metric: value} when the reference
+        # published comparable numbers; empty for this paper's TPU port
+        for metric, value in (base.get("published") or {}).items():
+            _offer(metric, value, os.path.basename(baseline_path))
+    return best
+
+
+def check(headlines, history, tolerance):
+    """[(status, line)] verdicts; status in PASS/REGRESSION/NO-HISTORY."""
+    verdicts = []
+    for path, metric, value in headlines:
+        prior = history.get(metric)
+        if prior is None:
+            verdicts.append(("NO-HISTORY",
+                             f"NO-HISTORY  {metric}: {value:g} "
+                             f"({os.path.basename(path)}) — nothing to "
+                             "compare against"))
+            continue
+        best, source = prior
+        floor = best * (1.0 - tolerance)
+        ratio = value / best if best else float("inf")
+        line = (f"{metric}: current {value:g} vs best {best:g} "
+                f"[{source}] = {ratio:.4f} (floor {floor:g} at "
+                f"tolerance {tolerance:g})")
+        if value < floor:
+            verdicts.append(("REGRESSION", f"REGRESSION  {line}"))
+        else:
+            verdicts.append(("PASS", f"PASS        {line}"))
+    return verdicts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/check_regression.py",
+        description="fail when a headline metric regresses vs the "
+                    "BENCH/BASELINE trajectory")
+    ap.add_argument("--headline", action="append", metavar="GLOB",
+                    default=[],
+                    help="current headline record(s) "
+                         "(default: results/headline*.json)")
+    ap.add_argument("--history", action="append", metavar="GLOB",
+                    default=[],
+                    help="prior bench records (default: BENCH_*.json)")
+    ap.add_argument("--baseline", default=os.path.join(ROOT, "BASELINE.json"),
+                    help="baseline record with published reference numbers")
+    ap.add_argument("--tolerance", type=float, default=0.1,
+                    help="allowed fractional drop below the best prior "
+                         "value (default: 0.10)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="report verdicts but always exit 0 (CI smoke lane)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit machine-readable JSON verdicts")
+    args = ap.parse_args(argv)
+
+    headline_pats = args.headline or [
+        os.path.join(ROOT, "results", "headline*.json")]
+    history_pats = args.history or [os.path.join(ROOT, "BENCH_*.json")]
+
+    try:
+        headlines = load_headlines(headline_pats)
+        if not headlines:
+            raise RuntimeError(
+                f"no headline records match {headline_pats!r} — "
+                "run bench.py first")
+        history = load_history(history_pats, args.baseline)
+        verdicts = check(headlines, history, args.tolerance)
+    except RuntimeError as e:
+        print(f"check_regression: {e}", file=sys.stderr)
+        return 2
+
+    regressed = [line for st, line in verdicts if st == "REGRESSION"]
+    if args.as_json:
+        print(json.dumps({
+            "tolerance": args.tolerance,
+            "dry_run": args.dry_run,
+            "n_regressions": len(regressed),
+            "verdicts": [{"status": st, "detail": line}
+                         for st, line in verdicts],
+        }, indent=1))
+    else:
+        for _, line in verdicts:
+            print(line)
+        print(f"check_regression: {len(regressed)} regression(s) across "
+              f"{len(verdicts)} metric(s), tolerance {args.tolerance:g}"
+              + (" [dry-run]" if args.dry_run else ""))
+    if regressed and not args.dry_run:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

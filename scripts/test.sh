@@ -25,10 +25,28 @@ JAX_PLATFORMS=cpu python -m burst_attn_tpu.analysis
 
 if [[ $obs == 1 ]]; then
   # focused lane for the observability subsystem (registry math, spans,
-  # exporters, serve/ring instrumentation) + its burstlint rule mutations —
-  # the quick iteration loop while working on burst_attn_tpu/obs/
-  python -m pytest tests/test_obs.py tests/test_analysis.py -q \
-    ${filtered[@]+"${filtered[@]}"}
+  # exporters, devstats, serve/ring instrumentation) + its burstlint rule
+  # mutations — the quick iteration loop while working on burst_attn_tpu/obs/
+  python -m pytest tests/test_obs.py tests/test_devstats.py \
+    tests/test_analysis.py -q ${filtered[@]+"${filtered[@]}"}
+  # end-to-end CLI smoke: the multi-process merge on synthetic per-process
+  # snapshots, and the perf-regression gate in dry-run — both exercised on
+  # every --obs run so a CLI/gate regression can't hide behind unit tests
+  obs_tmp=$(mktemp -d)
+  trap 'rm -rf "$obs_tmp"' EXIT
+  python - "$obs_tmp" <<'PY'
+import sys
+from burst_attn_tpu.obs.registry import Registry
+
+tmp = sys.argv[1]
+for p in range(2):
+    r = Registry()
+    r.counter("smoke.count").inc(p + 1)
+    r.gauge("smoke.depth").set(p)
+    r.export_jsonl(f"{tmp}/obs_{p}.jsonl", process_index=p)
+PY
+  python -m burst_attn_tpu.obs --merge "$obs_tmp/obs*.jsonl" > /dev/null
+  python scripts/check_regression.py --dry-run
 elif [[ $fused == 1 ]]; then
   # focused lane for the fused RDMA-ring kernel's interpret-mode parity
   # tests (the same tests also run in the default/fast lanes — this is the

@@ -50,6 +50,11 @@ _SLOW = {
     ("test_checkpoint.py", "test_save_restore_roundtrip"),
     ("test_decode.py", "test_generate_greedy_matches_recompute"),
     ("test_decode.py", "test_moe_decode_chunked_prefill_matches_forward"),
+    ("test_devstats.py", "test_double_ring_collect_matches_plain"),
+    ("test_devstats.py", "test_fused_ring_bit_identity_and_slot_counts"),
+    ("test_devstats.py", "test_scan_ring_bit_identity_fwd_and_grads"),
+    ("test_devstats.py", "test_segments_collect_matches_plain"),
+    ("test_devstats.py", "test_windowed_contig_truncation_visible_in_stats"),
     ("test_dist_decode.py", "test_dist_prefill_matches_single_device"),
     ("test_pallas.py", "test_bwd_random_config_property_sweep"),
     ("test_pallas.py", "test_fwd_random_config_property_sweep"),

@@ -171,6 +171,133 @@ def test_cli_unparseable_file_exit_2(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# multi-process merge (obs/aggregate.py + CLI --merge)
+
+
+def _proc_registry(p):
+    """One synthetic process's final state: overlapping counter/gauge/
+    histogram children so the cross-process fold is non-trivial."""
+    r = Registry()
+    r.counter("serve.requests").inc(10 + p, route="a")
+    r.counter("train.steps").inc(100 * (p + 1))
+    r.gauge("queue.depth").set(2 * p)
+    h = r.histogram("lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5 + p)  # p=0 -> le1.0 bucket, p>=1 -> overflow
+    return r
+
+
+def _write_proc_files(tmp_path, n=3):
+    paths = []
+    for p in range(n):
+        path = str(tmp_path / f"obs_{p}.jsonl")
+        _proc_registry(p).export_jsonl(path, process_index=p)
+        paths.append(path)
+    return paths
+
+
+def test_merge_processes_counters_sum_gauges_labeled(tmp_path):
+    from burst_attn_tpu.obs.aggregate import merge_files
+
+    _write_proc_files(tmp_path, 3)
+    metrics, spans, meta = merge_files([str(tmp_path / "obs*.jsonl")])
+    assert meta["processes"] == 3
+    assert meta["process_labels"] == ["0", "1", "2"]
+    by = {(m["name"], tuple(sorted(m["labels"].items()))): m for m in metrics}
+    # counters: summed across processes, no process label
+    assert by[("serve.requests", (("route", "a"),))]["value"] == 10 + 11 + 12
+    assert by[("train.steps", ())]["value"] == 100 + 200 + 300
+    # gauges: last-wins is per-process state -> one child per process
+    for p in range(3):
+        assert by[("queue.depth", (("process_index", str(p)),))][
+            "value"] == 2 * p
+    # histograms: bucket-wise add (same edges)
+    hist = by[("lat", ())]
+    assert hist["count"] == 6 and hist["bucket_counts"] == [3, 1]
+    assert hist["overflow"] == 2
+    assert hist["min"] == 0.05 and hist["max"] == 2.5
+
+
+def test_merge_by_process_keeps_children_apart(tmp_path):
+    from burst_attn_tpu.obs.aggregate import merge_files
+
+    _write_proc_files(tmp_path, 2)
+    metrics, _, meta = merge_files([str(tmp_path / "obs*.jsonl")],
+                                   by_process=True)
+    by = {(m["name"], tuple(sorted(m["labels"].items()))): m for m in metrics}
+    assert by[("serve.requests",
+               (("process_index", "0"), ("route", "a")))]["value"] == 10
+    assert by[("serve.requests",
+               (("process_index", "1"), ("route", "a")))]["value"] == 11
+    assert by[("lat", (("process_index", "1"),))]["count"] == 2
+
+
+def test_merge_histogram_edge_mismatch_stays_per_process(tmp_path):
+    from burst_attn_tpu.obs.aggregate import merge_files
+
+    r0 = Registry()
+    r0.histogram("lat", buckets=(0.1, 1.0)).observe(0.5)
+    r0.export_jsonl(str(tmp_path / "obs_0.jsonl"), process_index=0)
+    r1 = Registry()
+    r1.histogram("lat", buckets=(0.2, 2.0)).observe(0.5)
+    r1.export_jsonl(str(tmp_path / "obs_1.jsonl"), process_index=1)
+    metrics, _, _ = merge_files([str(tmp_path / "obs*.jsonl")])
+    lat = sorted((m for m in metrics if m["name"] == "lat"),
+                 key=lambda m: sorted(m["labels"].items()))
+    # apples stay apart from oranges: the mismatched child keeps its
+    # process_index label instead of being added bucket-wise
+    assert len(lat) == 2
+    assert any(m["labels"].get("process_index") == "1" for m in lat)
+
+
+def test_export_meta_carries_process_index(tmp_path):
+    path = str(tmp_path / "obs.jsonl")
+    _proc_registry(0).export_jsonl(path, process_index=5)
+    metas = [r for r in load_records(path) if r["kind"] == "meta"]
+    assert metas and metas[-1]["process_index"] == 5
+    # and the package-level exporter tags automatically (process 0 here)
+    path2 = str(tmp_path / "obs2.jsonl")
+    obs.export_jsonl(path2)
+    metas2 = [r for r in load_records(path2) if r["kind"] == "meta"]
+    assert metas2 and metas2[-1]["process_index"] == 0
+
+
+def test_cli_merge_subprocess_report_and_exit_codes(tmp_path):
+    _write_proc_files(tmp_path, 2)
+    pat = str(tmp_path / "obs*.jsonl")
+    r = subprocess.run(
+        [sys.executable, "-m", "burst_attn_tpu.obs", "--merge", pat,
+         "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    d = json.loads(r.stdout)
+    assert d["meta"]["processes"] == 2
+    by = {(m["name"], tuple(sorted(m["labels"].items()))): m
+          for m in d["metrics"]}
+    assert by[("serve.requests", (("route", "a"),))]["value"] == 21
+    assert [("queue.depth", (("process_index", "0"),)) in by,
+            ("queue.depth", (("process_index", "1"),)) in by] == [True, True]
+    # text mode renders one report line with process provenance
+    r = subprocess.run(
+        [sys.executable, "-m", "burst_attn_tpu.obs", "--merge", pat],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0 and "2 process export(s)" in r.stdout
+    # no matches -> 1; unparseable -> 2
+    r = subprocess.run(
+        [sys.executable, "-m", "burst_attn_tpu.obs", "--merge",
+         str(tmp_path / "nope*.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    bad = tmp_path / "obs_bad.jsonl"
+    bad.write_text("not json\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "burst_attn_tpu.obs", "--merge",
+         str(tmp_path / "obs_bad.jsonl")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2
+
+
+# ---------------------------------------------------------------------------
 # spans
 
 
