@@ -1,18 +1,21 @@
 """Ring-overlap microbenchmark: scan+ppermute ring vs the fused RDMA kernel.
 
-Measures, per (seq, layout) config on the real ring mesh:
+Measures, per (seq, layout, pass) config on the real ring mesh:
 
-  t_scan     — the scan-based ring forward (`backend="pallas"` per-round
+  t_scan     — the scan-based ring (`backend="pallas"` per-round
                pallas_call + lax.ppermute; overlap is whatever XLA's async
                collective scheduling achieves)
-  t_fused    — the fused single-kernel ring (`backend="fused_ring"`,
-               in-kernel RDMA KV rotation, ops/fused_ring.py)
+  t_fused    — the fused single-kernel ring (`backend="fused_ring"`:
+               in-kernel RDMA rotation — KV for the forward,
+               ops/fused_ring.py; q-side bundle + concurrent dq ring for
+               the backward, ops/fused_ring_bwd.py)
   t_compute  — compute-only floor: the same W rounds of tile compute with
                the ring rotation REMOVED (every round re-reads the resident
-               local KV; identical kernel launches, masks and state carry,
-               zero inter-chip traffic)
-  t_comm     — comm-only floor: just the W-1 KV rotations (ppermute of the
-               k/v payload, no attention compute)
+               local operands; identical kernel launches, masks and state
+               carry, zero inter-chip traffic)
+  t_comm     — comm-only floor: just the rotations (fwd: W-1 k/v permutes;
+               bwd: W-1 bundle permutes + the W dq add-and-forward hops),
+               no attention compute
 
 and derives the achieved overlap fraction
 
@@ -20,16 +23,19 @@ and derives the achieved overlap fraction
 
 (1.0 = the smaller phase is fully hidden behind the larger; 0.0 = fully
 serialized), plus the ideal-floor ratio t_ring / max(t_compute, t_comm).
-One JSON line per config appends to results/ring_overlap.jsonl.
+One JSON line per (config, pass) appends to results/ring_overlap.jsonl,
+each tagged with its `pass` ("fwd" | "bwd" | "fwd+bwd"; the combined pass
+times one value_and_grad program and reports no floors — its floors are
+the sum of the per-pass ones).
 
 On a CPU host this still runs a tiny smoke config through the interpreted
-fused kernel (BURST_FUSED_INTERPRET=1 is set for the fused leg) so the
+fused kernels (BURST_FUSED_INTERPRET=1 is set for the fused legs) so the
 harness itself is testable anywhere; the numbers are only meaningful on a
 TPU ring.
 
 Usage:  python -m benchmarks.ring_overlap [--seqs 16384,65536]
         [--mesh 8] [--layout zigzag] [--heads 32] [--dim 128]
-        [--out results/ring_overlap.jsonl]
+        [--pass fwd|bwd|fwd+bwd|all] [--out results/ring_overlap.jsonl]
 """
 
 import argparse
@@ -111,16 +117,105 @@ def _comm_only(mesh, world):
     return jax.jit(lambda k, v: fn(k, v))
 
 
-def run_config(seq, world, layout, n, d, causal, out_path):
+def _shard_fwd_residuals(mesh, cfg):
+    """(o, lse) of the scan forward — the residuals both backward legs
+    consume, computed once per config outside the timed region."""
+    spec4 = P(None, None, "sp", None)
+    spec3 = P(None, None, "sp")
+    fn = shard_map(lambda q, k, v: burst._fwd_impl(q, k, v, cfg),
+                   mesh=mesh, in_specs=(spec4,) * 3,
+                   out_specs=(spec4, spec3), check_vma=False)
+    return jax.jit(fn)
+
+
+def _shard_bwd(mesh, cfg, no_rotate=False):
+    """Shard-level backward launcher; no_rotate=True swaps both rotating
+    streams for no-ops (the compute-only floor: same W rounds of tile_bwd
+    against the resident bundle, zero inter-chip traffic)."""
+    spec4 = P(None, None, "sp", None)
+    spec3 = P(None, None, "sp")
+
+    def f(q, k, v, o, lse, do):
+        if not no_rotate:
+            dq, dk, dv = burst._bwd_impl(cfg, q, k, v, o, lse, do)
+            return (jnp.sum(dq) + jnp.sum(dk) + jnp.sum(dv)).astype(
+                jnp.float32)
+        from burst_attn_tpu.ops.masks import round_spec
+        from burst_attn_tpu.parallel.ring import my_partition
+        from burst_attn_tpu.utils.compat import axis_size
+
+        world = axis_size(cfg.intra_axis)
+        me = my_partition(cfg.intra_axis, None)
+        s = q.shape[2]
+        scale = q.shape[3] ** -0.5
+        spec = round_spec(me, me, s, s, cfg.causal, cfg.layout)
+        delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                        axis=-1)
+        acc = jnp.float32(0.0)
+        for _ in range(world):
+            dq, dk, dv = burst._tile_bwd(cfg, do, q, k, v, delta, lse,
+                                         scale, spec)
+            acc = acc + jnp.sum(dq) + jnp.sum(dk) + jnp.sum(dv)
+        return acc
+
+    fn = shard_map(f, mesh=mesh, in_specs=(spec4,) * 4 + (spec3, spec4),
+                   out_specs=P(), check_vma=False)
+    return jax.jit(lambda *a: fn(*a))
+
+
+def _comm_only_bwd(mesh, world, opt_comm):
+    """Comm-only backward floor: W-1 rotations of the 4-operand q-side
+    bundle (delta|o, do, q, lse) plus the dq ring's W add-and-forward hops
+    (W-1 in-ring + the return-home hop), no compute."""
+    spec4 = P(None, None, "sp", None)
+    spec3 = P(None, None, "sp")
+    first_spec = spec3 if opt_comm else spec4
+
+    def f(first, do, q, lse):
+        pay = (first, do, q, lse)
+        dq = jnp.zeros(q.shape, jnp.float32)
+        for _ in range(world - 1):
+            pay = ppermute_next(pay, "sp")
+            dq = ppermute_next(dq, "sp")
+        dq = ppermute_next(dq, "sp")  # return-home hop
+        return sum(jnp.sum(t.astype(jnp.float32)) for t in pay) + jnp.sum(dq)
+
+    fn = shard_map(f, mesh=mesh,
+                   in_specs=(first_spec, spec4, spec4, spec3),
+                   out_specs=P(), check_vma=False)
+    return jax.jit(lambda *a: fn(*a))
+
+
+def _shard_fwdbwd(mesh, cfg):
+    """value_and_grad through the shard-level custom_vjp — both passes of
+    one training-step attention in one timed program."""
+    spec4 = P(None, None, "sp", None)
+
+    def f(q, k, v, do):
+        def loss(q, k, v):
+            o = burst.burst_attn_shard(q, k, v, cfg)
+            return jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32))
+
+        l, grads = jax.value_and_grad(loss, (0, 1, 2))(q, k, v)
+        return l + sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
+
+    fn = shard_map(f, mesh=mesh, in_specs=(spec4,) * 4, out_specs=P(),
+                   check_vma=False)
+    return jax.jit(lambda *a: fn(*a))
+
+
+def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd"):
     on_tpu = jax.default_backend() == "tpu"
     mesh = _mesh(world)
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     key = jax.random.PRNGKey(0)
-    kq, kk, kv = jax.random.split(key, 3)
+    kq, kk, kv, kg = jax.random.split(key, 4)
     q = jax.random.normal(kq, (1, n, seq, d), dtype)
     k = jax.random.normal(kk, (1, n, seq, d), dtype)
     v = jax.random.normal(kv, (1, n, seq, d), dtype)
-    q, k, v = (layouts.to_layout(t, layout, world, 2) for t in (q, k, v))
+    do = jax.random.normal(kg, (1, n, seq, d), dtype)
+    q, k, v, do = (layouts.to_layout(t, layout, world, 2)
+                   for t in (q, k, v, do))
 
     tile_backend = "pallas" if on_tpu else "jnp"
     scan_cfg = burst.BurstConfig(causal=causal, layout=layout,
@@ -129,12 +224,40 @@ def run_config(seq, world, layout, n, d, causal, out_path):
                                   intra_axis="sp", backend="fused_ring")
 
     bench_kw = dict(warmup=2, iters=3, reps=2) if not on_tpu else {}
-    t_scan = bench_fn(_shard_fwd(mesh, scan_cfg), q, k, v, **bench_kw)
-    os.environ["BURST_FUSED_INTERPRET"] = "1"  # fused leg off-TPU
-    t_fused = bench_fn(_shard_fwd(mesh, fused_cfg), q, k, v, **bench_kw)
-    t_compute = bench_fn(_shard_fwd(mesh, scan_cfg, no_rotate=True), q, k, v,
-                         **bench_kw)
-    t_comm = bench_fn(_comm_only(mesh, world), k, v, **bench_kw)
+    os.environ["BURST_FUSED_INTERPRET"] = "1"  # fused legs off-TPU
+    if pass_ == "fwd":
+        t_scan = bench_fn(_shard_fwd(mesh, scan_cfg), q, k, v, **bench_kw)
+        t_fused = bench_fn(_shard_fwd(mesh, fused_cfg), q, k, v, **bench_kw)
+        t_compute = bench_fn(_shard_fwd(mesh, scan_cfg, no_rotate=True),
+                             q, k, v, **bench_kw)
+        t_comm = bench_fn(_comm_only(mesh, world), k, v, **bench_kw)
+    elif pass_ == "bwd":
+        # residuals once, outside the timed region — both legs consume the
+        # identical (o, lse)
+        o, lse = jax.block_until_ready(
+            _shard_fwd_residuals(mesh, scan_cfg)(q, k, v))
+        t_scan = bench_fn(_shard_bwd(mesh, scan_cfg), q, k, v, o, lse, do,
+                          **bench_kw)
+        t_fused = bench_fn(_shard_bwd(mesh, fused_cfg), q, k, v, o, lse, do,
+                           **bench_kw)
+        t_compute = bench_fn(_shard_bwd(mesh, scan_cfg, no_rotate=True),
+                             q, k, v, o, lse, do, **bench_kw)
+        delta_or_o = (jnp.sum(o.astype(jnp.float32)
+                              * do.astype(jnp.float32), axis=-1)
+                      if scan_cfg.optimize_bwd_comm else o)
+        t_comm = bench_fn(
+            _comm_only_bwd(mesh, world, scan_cfg.optimize_bwd_comm),
+            delta_or_o, do, q, lse.astype(jnp.float32), **bench_kw)
+    elif pass_ == "fwd+bwd":
+        # one value_and_grad program per backend; floors are the sum of the
+        # per-pass floors, so none are (re)measured here
+        t_scan = bench_fn(_shard_fwdbwd(mesh, scan_cfg), q, k, v, do,
+                          **bench_kw)
+        t_fused = bench_fn(_shard_fwdbwd(mesh, fused_cfg), q, k, v, do,
+                           **bench_kw)
+        t_compute = t_comm = None
+    else:
+        raise SystemExit(f"unknown --pass {pass_!r}")
 
     def overlap(t_ring):
         lo = min(t_compute, t_comm)
@@ -142,25 +265,30 @@ def run_config(seq, world, layout, n, d, causal, out_path):
             return 0.0
         return max(0.0, min(1.0, (t_compute + t_comm - t_ring) / lo))
 
-    fwd_f = flops(1, seq, n, d, mode="fwd", causal=causal)
+    mode = {"fwd": "fwd", "bwd": "bwd", "fwd+bwd": "fwd_bwd"}[pass_]
+    pass_f = flops(1, seq, n, d, mode=mode, causal=causal)
     rec = {
         "bench": "ring_overlap",
         "backend": jax.default_backend(),
+        "pass": pass_,
         "seq": seq, "world": world, "layout": layout, "heads": n, "dim": d,
         "causal": causal,
         "t_scan_s": round(t_scan, 6),
         "t_fused_s": round(t_fused, 6),
-        "t_compute_only_s": round(t_compute, 6),
-        "t_comm_only_s": round(t_comm, 6),
-        "overlap_scan": round(overlap(t_scan), 4),
-        "overlap_fused": round(overlap(t_fused), 4),
-        "ring_vs_floor_scan": round(t_scan / max(t_compute, t_comm), 4),
-        "ring_vs_floor_fused": round(t_fused / max(t_compute, t_comm), 4),
         "fused_speedup": round(t_scan / t_fused, 4),
-        "tflops_scan": round(fwd_f / t_scan / 1e12 / world, 2),
-        "tflops_fused": round(fwd_f / t_fused / 1e12 / world, 2),
+        "tflops_scan": round(pass_f / t_scan / 1e12 / world, 2),
+        "tflops_fused": round(pass_f / t_fused / 1e12 / world, 2),
         "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if t_compute is not None:
+        rec.update({
+            "t_compute_only_s": round(t_compute, 6),
+            "t_comm_only_s": round(t_comm, 6),
+            "overlap_scan": round(overlap(t_scan), 4),
+            "overlap_fused": round(overlap(t_fused), 4),
+            "ring_vs_floor_scan": round(t_scan / max(t_compute, t_comm), 4),
+            "ring_vs_floor_fused": round(t_fused / max(t_compute, t_comm), 4),
+        })
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "a") as f:
         f.write(json.dumps(rec) + "\n")
@@ -172,11 +300,12 @@ def run_config(seq, world, layout, n, d, causal, out_path):
     # dispatch counters the measured programs just advanced
     from burst_attn_tpu import obs
 
-    labels = dict(seq=seq, world=world, layout=layout)
+    labels = {"seq": seq, "world": world, "layout": layout, "pass": pass_}
     for key in ("overlap_scan", "overlap_fused", "fused_speedup",
                 "tflops_scan", "tflops_fused"):
-        obs.gauge(f"bench.ring_overlap.{key}").set(rec[key], **labels)
-    obs.counter("bench.ring_overlap_runs").inc()
+        if key in rec:
+            obs.gauge(f"bench.ring_overlap.{key}").set(rec[key], **labels)
+    obs.counter("bench.ring_overlap_runs").inc(**{"pass": pass_})
     return rec
 
 
@@ -189,13 +318,20 @@ def main():
     ap.add_argument("--heads", type=int, default=32 if on_tpu else 2)
     ap.add_argument("--dim", type=int, default=128 if on_tpu else 16)
     ap.add_argument("--noncausal", action="store_true")
+    ap.add_argument("--pass", dest="pass_", default="fwd",
+                    choices=["fwd", "bwd", "fwd+bwd", "all"],
+                    help="which pass(es) to measure; 'all' runs the three "
+                         "modes back to back per seq")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "results", "ring_overlap.jsonl"))
     args = ap.parse_args()
+    passes = (["fwd", "bwd", "fwd+bwd"] if args.pass_ == "all"
+              else [args.pass_])
     for seq in [int(s) for s in args.seqs.split(",")]:
-        run_config(seq, args.mesh, args.layout, args.heads, args.dim,
-                   not args.noncausal, args.out)
+        for p in passes:
+            run_config(seq, args.mesh, args.layout, args.heads, args.dim,
+                       not args.noncausal, args.out, pass_=p)
     # one obs export per invocation, beside the jsonl results
     from burst_attn_tpu import obs
 

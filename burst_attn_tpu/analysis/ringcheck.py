@@ -50,11 +50,13 @@ rule("dq-return-home", "jaxpr",
 rule("window-truncation", "jaxpr",
      "windowed ring truncation matches the dense band-mask live set")(None)
 rule("fused-ring-schedule", "jaxpr",
-     "fused kernel slot schedule matches the oracle; delivery, hop-count "
-     "and overwrite-before-read safety proven by simulation")(None)
+     "fused fwd AND bwd slot schedules match the oracle; delivery, "
+     "hop-count, dq exactly-once return-home and overwrite-before-read "
+     "safety proven by simulation")(None)
 rule("fused-ring-fused", "jaxpr",
-     "fused forward issues zero XLA collectives and exactly one remote-"
-     "copy pair (k, v) per ring hop, with fp32-accum numerics")(None)
+     "fused fwd/bwd issue zero XLA collectives and exactly the expected "
+     "remote-copy census (fwd: k+v pair; bwd: 4-operand bundle + dq ring "
+     "+ dq return-home), with fp32-accum numerics")(None)
 
 
 @dataclass
@@ -300,8 +302,51 @@ def verify_ring_entry(entry: RingEntry) -> List[Finding]:
     return findings
 
 
+def _remote_dma_starts(closed_jaxpr):
+    from .jaxpr_tools import iter_eqns
+
+    return [e for e in iter_eqns(closed_jaxpr)
+            if e.primitive.name == "dma_start"
+            and e.params.get("device_id_type") is not None
+            and "LOGICAL" in str(e.params["device_id_type"]).upper()]
+
+
+def verify_fused_bwd_trace(closed_jaxpr, *, where: str, anchor
+                           ) -> List[Finding]:
+    """fused-ring-fused checks on one traced fused BACKWARD shard program.
+
+    Shared by verify_fused_ring (tracing the real dispatch) and the
+    mutation tests (tracing seeded-bad programs): the trace must contain
+    ZERO XLA collectives (the two rotating streams live entirely inside
+    the kernel) and exactly 6 remote dma_starts — 4 for the q-side bundle
+    (delta|o, do, q, lse), 1 for the streamed dq ring hop, 1 for the dq
+    return-home hop; more would double-send, fewer would starve a stream —
+    and the kernel's dots must pass the fp32-accum/lse-fp32 contract."""
+    from . import numerics
+
+    findings: List[Finding] = []
+    path, line = anchor
+    colls = [e for e in collect_collectives(closed_jaxpr)
+             if e.prim in ("ppermute", "all_to_all")]
+    if colls:
+        findings.append(Finding(
+            rule="fused-ring-fused", file=path, line=line,
+            message=f"{where}: fused backward issues XLA collectives "
+                    f"{[(e.prim, e.axis) for e in colls]} — both the "
+                    "bundle and the dq ring must live inside the kernel"))
+    remote = _remote_dma_starts(closed_jaxpr)
+    if len(remote) != 6:
+        findings.append(Finding(
+            rule="fused-ring-fused", file=path, line=line,
+            message=f"{where}: expected exactly 6 remote dma_starts (4 "
+                    "bundle operands + dq ring hop + dq return-home), "
+                    f"traced {len(remote)}"))
+    findings += numerics.check_trace(closed_jaxpr, where=where, anchor=anchor)
+    return findings
+
+
 def verify_fused_ring() -> List[Finding]:
-    """Fused ring (ops/fused_ring.py) rules.
+    """Fused ring (ops/fused_ring.py + ops/fused_ring_bwd.py) rules.
 
     Schedule family: the slot schedule the kernel consumes (exported by
     parallel/ring.fused_slot_schedule and delivered via scalar prefetch) is
@@ -351,6 +396,27 @@ def verify_fused_ring() -> List[Finding]:
                 message=f"world={world} slots={slots}: schedule proof "
                         f"failed: {e}"))
 
+    # ---- bwd schedule family: the bundle + dq twin streams ----
+    anchor_bwd_plan = _anchor(ring.fused_bwd_slot_schedule)
+    for world, slots in ((2, 2), (4, 2), (8, 2), (8, 3), (8, 8)):
+        got = [int(x) for x in ring.fused_bwd_slot_schedule(world, slots)]
+        want = oracle.fused_bwd_slot_schedule(world, slots)
+        if got != want:
+            findings.append(Finding(
+                rule="fused-ring-schedule", file=anchor_bwd_plan[0],
+                line=anchor_bwd_plan[1],
+                message=f"world={world} slots={slots}: exported bwd slot "
+                        f"schedule {got} != oracle derivation {want}"))
+            continue
+        try:
+            oracle.verify_fused_ring_bwd(world, slots, got)
+        except AssertionError as e:
+            findings.append(Finding(
+                rule="fused-ring-schedule", file=anchor_bwd_plan[0],
+                line=anchor_bwd_plan[1],
+                message=f"world={world} slots={slots}: bwd schedule proof "
+                        f"failed: {e}"))
+
     # ---- traced structure of the fused forward ----
     anchor = _anchor(fr.fused_ring_fwd)
     devs = jax.devices()
@@ -388,10 +454,7 @@ def verify_fused_ring() -> List[Finding]:
                     message=f"{where}: fused forward issues XLA collectives "
                             f"{[(e.prim, e.axis) for e in colls]} — the ring "
                             "must live entirely inside the kernel"))
-            remote = [e for e in iter_eqns(jx)
-                      if e.primitive.name == "dma_start"
-                      and e.params.get("device_id_type") is not None
-                      and "LOGICAL" in str(e.params["device_id_type"]).upper()]
+            remote = _remote_dma_starts(jx)
             if len(remote) != 2:
                 findings.append(Finding(
                     rule="fused-ring-fused", file=anchor[0], line=anchor[1],
@@ -399,6 +462,52 @@ def verify_fused_ring() -> List[Finding]:
                             f"(k and v, one hop each per round), traced "
                             f"{len(remote)}"))
             findings += numerics.check_trace(jx, where=where, anchor=anchor)
+
+        # ---- traced structure of the fused backward ----
+        from ..ops import fused_ring_bwd as frb
+
+        anchor_bwd = _anchor(frb.fused_ring_bwd)
+        lse = S((b, n, s_local * world), jnp.float32)
+        for layout, causal, opt in (("zigzag", True, True),
+                                    ("striped", True, False),
+                                    ("contig", False, True)):
+            cfg = burst.BurstConfig(causal=causal, layout=layout,
+                                    intra_axis="sp", backend="fused_ring",
+                                    optimize_bwd_comm=opt)
+            bwd = shard_map(
+                lambda q, k, v, o, l, do: burst._bwd_impl(
+                    cfg, q, k, v, o, l, do),
+                mesh=mesh, in_specs=(spec4,) * 4 + (spec3, spec4),
+                out_specs=(spec4,) * 3, check_vma=False)
+            jx = jax.make_jaxpr(bwd)(q, q, q, q, lse, q)
+            where = (f"fused-bwd-{layout}{'-causal' if causal else ''}"
+                     f"{'' if opt else '-rotate-o'}")
+            findings += verify_fused_bwd_trace(jx, where=where,
+                                               anchor=anchor_bwd)
+
+        # ---- end-to-end: value_and_grad through the fused backend keeps
+        # BOTH passes collective-free (the acceptance-criterion trace) ----
+        cfg = burst.BurstConfig(causal=True, layout="zigzag",
+                                intra_axis="sp", backend="fused_ring")
+
+        def loss(q, k, v):
+            o = burst._burst_attn_shard_plain(q, k, v, cfg)
+            return jnp.sum(o.astype(jnp.float32))
+
+        vg = shard_map(
+            lambda q, k, v: jax.value_and_grad(loss, (0, 1, 2))(q, k, v),
+            mesh=mesh, in_specs=(spec4,) * 3,
+            out_specs=(P(), (spec4,) * 3), check_vma=False)
+        jx = jax.make_jaxpr(vg)(q, q, q)
+        colls = [e for e in collect_collectives(jx)
+                 if e.prim in ("ppermute", "all_to_all")]
+        if colls:
+            findings.append(Finding(
+                rule="fused-ring-fused", file=anchor_bwd[0],
+                line=anchor_bwd[1],
+                message="value_and_grad(fused_ring) issues XLA collectives "
+                        f"{[(e.prim, e.axis) for e in colls]} — both passes "
+                        "must live inside their kernels"))
     finally:
         if prev is None:
             os.environ.pop("BURST_FUSED_INTERPRET", None)

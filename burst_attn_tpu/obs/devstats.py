@@ -37,7 +37,15 @@ caller sees per-device arrays of leading length `world`:
   nonfinite_acc  count of non-finite accumulator/output entries
   fused_rounds   rounds executed inside the fused RDMA kernel (0 on scan)
   slot_use       [MAX_SLOTS] per-KV-slot consume counts from the fused
-                 kernel's in-kernel scalar output (zeros on the scan path)
+                 forward kernel's in-kernel scalar output (zeros on the
+                 scan path)
+  slot_use_bwd   [MAX_SLOTS] per-slot bundle consume counts from the fused
+                 BACKWARD kernel (ops/fused_ring_bwd.py), emitted through
+                 the same SMEM scalar-output channel.  Zeros on the scan
+                 path AND on the autodiff path: custom_vjp cotangents
+                 cannot carry telemetry forward in time, so these counters
+                 only populate via the direct `fused_ring_bwd(...,
+                 collect_stats=True)` call (tests, offline audits)
 
 The split of labor per causal layout is visible directly: zigzag/striped
 devices report near-equal `attn_pairs` (the load-balancing the layouts
@@ -79,6 +87,7 @@ class DevStats(NamedTuple):
     nonfinite_acc: jnp.ndarray   # i32
     fused_rounds: jnp.ndarray    # i32
     slot_use: jnp.ndarray        # i32[MAX_SLOTS]
+    slot_use_bwd: jnp.ndarray    # i32[MAX_SLOTS]
 
     def publish(self, registry=None, *, labels: Optional[dict] = None):
         """Fold concrete (post-step) stats into a host metrics registry.
@@ -137,26 +146,43 @@ class DevStats(NamedTuple):
         reg.counter("devstats.fused_rounds",
                     "ring rounds executed inside the fused RDMA kernel").inc(
             float(leaves["fused_rounds"].sum()), **base)
-        slot_tot = leaves["slot_use"].sum(axis=0)
-        for j in range(slot_tot.shape[0]):
-            if slot_tot[j]:
-                reg.counter("devstats.slot_use",
-                            "fused-ring KV chunk consumes per comm slot").inc(
-                    float(slot_tot[j]), slot=j, **base)
+        for field, pass_ in (("slot_use", "fwd"), ("slot_use_bwd", "bwd")):
+            slot_tot = leaves[field].sum(axis=0)
+            for j in range(slot_tot.shape[0]):
+                if slot_tot[j]:
+                    reg.counter(
+                        "devstats.slot_use",
+                        "fused-ring chunk/bundle consumes per comm slot, "
+                        "by pass").inc(
+                        float(slot_tot[j]), slot=j, **base,
+                        **{"pass": pass_})
         reg.counter("devstats.publishes",
                     "DevStats pytrees folded into the registry").inc()
         return reg
 
 
+def _slot_vec(slot_use):
+    """Zero-pad a [.., slots] counter vector to the static MAX_SLOTS width
+    (None = all zeros, the scan path's value)."""
+    if slot_use is None:
+        return jnp.zeros((MAX_SLOTS,), jnp.int32)
+    return jnp.zeros((MAX_SLOTS,), jnp.int32).at[:slot_use.shape[-1]].set(
+        jnp.asarray(slot_use, jnp.int32).reshape(-1))
+
+
 def ring_stats(rounds, rounds_live, attn_pairs, total_pairs, head_dim,
-               m, lse, acc, fused_rounds=0, slot_use=None) -> DevStats:
+               m, lse, acc, fused_rounds=0, slot_use=None,
+               slot_use_bwd=None) -> DevStats:
     """Assemble a per-shard DevStats from ring results (traced context).
 
     `m` may be None (fused kernel: the row max never leaves the kernel);
     `acc` is the f32 accumulator on the scan path and the finalized output
     on the fused path — either way, non-finite entries mean the softmax
     went wrong.  `lse` -inf entries are legal (fully-masked rows) and are
-    excluded from the finite range but not counted as corruption."""
+    excluded from the finite range but not counted as corruption.
+    `slot_use_bwd` carries the fused backward kernel's bundle slot-consume
+    counters when the caller ran it with collect_stats (see the field
+    docstring above)."""
     i32 = jnp.int32
     f32 = jnp.float32
     attn_pairs = jnp.asarray(attn_pairs, f32)
@@ -175,10 +201,8 @@ def ring_stats(rounds, rounds_live, attn_pairs, total_pairs, head_dim,
             jnp.isnan(lse) | (lse == _POS_INF)).astype(i32),
         nonfinite_acc=jnp.sum(~jnp.isfinite(acc)).astype(i32),
         fused_rounds=jnp.asarray(fused_rounds, i32),
-        slot_use=(jnp.zeros((MAX_SLOTS,), i32) if slot_use is None
-                  else jnp.zeros((MAX_SLOTS,), i32).at[
-                      :slot_use.shape[-1]].set(
-                          jnp.asarray(slot_use, i32).reshape(-1))),
+        slot_use=_slot_vec(slot_use),
+        slot_use_bwd=_slot_vec(slot_use_bwd),
     )
     # telemetry is non-differentiable by definition: zero the tangents here
     # so downstream cross_reduce/merge arithmetic never asks autodiff for

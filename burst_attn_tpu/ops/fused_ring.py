@@ -54,9 +54,10 @@ those guards exist for cannot occur).
 Supported: single ring (no inter axis), equal q/kv shard lengths, no sliding
 window, no packed segments, world >= 2, ring axis the only size>1 named axis
 in scope.  Everything else falls back to the scan ring in parallel/burst.py
-(see `supported`).  The backward keeps the scan path in this revision; the
-dispatch is structured so a fused dq ring slots in behind the same schedule
-export without touching callers.
+(see `supported`).  The BACKWARD has its own fused kernel
+(ops/fused_ring_bwd.py: the q-side bundle plus a concurrent dq ring rotate
+while K, V stay resident), gated by the same predicate with pass_="bwd" —
+configs either kernel declines take the scan ring for that pass only.
 """
 
 import functools
@@ -122,7 +123,7 @@ def _extra_named_axes(intra_axis: str):
 
 
 def supported(cfg, q_shape, k_shape, has_segments: bool, *,
-              interpret=None, world=None, extra_axes=None):
+              interpret=None, world=None, extra_axes=None, pass_="fwd"):
     """None if the fused ring can run this config, else a reason string the
     dispatch logs / the tests assert on.  By default must be called at
     trace time (inside shard_map) — the axis-env and mesh-size probes read
@@ -131,7 +132,16 @@ def supported(cfg, q_shape, k_shape, has_segments: bool, *,
     callable with PER-SHARD shapes: the obs dispatch instrumentation
     (parallel/burst._note_dispatch) evaluates the same gate the traced
     dispatch runs, so the `burst.dispatch`/`burst.fused_fallback` counters
-    cannot drift from the real decision logic."""
+    cannot drift from the real decision logic.
+
+    `pass_` ("fwd" | "bwd") selects which kernel's gate to evaluate: the
+    structural constraints are shared, but each pass has its own blocks and
+    VMEM plan (the bwd keeps fp32 dk/dv accumulators resident where the fwd
+    keeps packed m/l stats), so a shard can be fused in one pass and fall
+    back in the other — parallel/burst._bwd_impl runs this with
+    pass_="bwd" at its single dispatch point."""
+    if pass_ not in ("fwd", "bwd"):
+        raise ValueError(f"pass_ must be 'fwd' or 'bwd', got {pass_!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if interpret and not interpret_enabled():
@@ -155,7 +165,21 @@ def supported(cfg, q_shape, k_shape, has_segments: bool, *,
         return (f"ring axis must be the only partitioned axis in scope "
                 f"(found {extra})")
     rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
-                       cfg.fused_kv_slots)
+                       cfg.fused_kv_slots,
+                       block_q_bwd=getattr(cfg, "fused_block_q_bwd", None),
+                       block_kv_bwd=getattr(cfg, "fused_block_kv_bwd", None),
+                       bwd_slots=getattr(cfg, "fused_bwd_slots", None))
+    if pass_ == "bwd":
+        # VMEM plan, bwd roles: resident k+v chunk, fp32 dk/dv accumulators,
+        # the per-step bundle tiles (q, do, delta|o, lse, arriving dq, local
+        # dq) — 4-byte worst case, so an oversized shard falls back instead
+        # of failing Mosaic allocation mid-ring
+        bqb = _pick_block(s, rf.block_q_bwd)
+        vmem = 2 * s * d * 4 + 2 * s * d * 4 + 6 * bqb * d * 4
+        if vmem > rf.vmem_budget:
+            return (f"VMEM plan {vmem} bytes exceeds fused budget "
+                    f"{rf.vmem_budget} (bwd)")
+        return None
     # VMEM plan: resident k+v chunk, packed m/l stats, acc staging — counted
     # against the per-generation budget (4-byte worst case per element) so
     # an oversized shard falls back instead of failing Mosaic allocation

@@ -1,0 +1,590 @@
+"""Fused on-device ring attention BACKWARD: all W backward rounds in ONE
+Pallas kernel — the comm-optimized BurstAttention backward (SURVEY §3.2)
+with both of its concurrent streams carried by in-kernel inter-chip RDMA
+instead of per-round `lax.ppermute` collectives between kernel launches.
+
+Roles flip versus the fused forward (ops/fused_ring.py): K and V stay
+RESIDENT on their home device for the whole kernel (dk/dv accumulate in
+fp32 locally and never move), while two streams rotate concurrently:
+
+  * the q-side BUNDLE — (delta, do, q, lse) in `optimize_bwd_comm` form
+    (delta = sum(o * do) [B, N, S] f32; with the optimization off, o rides
+    instead and delta is recomputed per tile, reproducing the reference's
+    payload trade) — rotates exactly like the forward's KV: the round-r+1
+    send leaves at round r's FIRST grid step from the slot the exported
+    schedule names, and is in flight for the entire round-r compute sweep.
+  * the dq RING — the fp32 partial gradient of whichever partition's bundle
+    a device holds — follows ONE HOP BEHIND: a block's dq cannot leave until
+    the local contribution is folded in, so each [bq, D] row-block streams
+    out the moment its grid step finishes, arriving at the right neighbor
+    before that neighbor's next round needs it.  At the last round the
+    stream takes its return-home hop into a dedicated HOME slot on the
+    right neighbor (one extra hop, exactly the scan backward's final
+    ppermute), which the epilogue copies into the dq output.
+
+Slot choreography for both streams comes from ONE exported schedule
+(parallel/ring.fused_bwd_slot_schedule, scalar-prefetched into the kernel);
+burstlint re-derives it independently and PROVES delivery, hop counts,
+exactly-once dq return-home and overwrite-before-read safety by simulation
+(analysis/oracle.verify_fused_ring_bwd), then checks the traced program
+contains zero XLA collectives and the expected remote-copy census
+(fused-ring-schedule / fused-ring-fused, bwd families).
+
+Compute path.  Per grid step (r, b, h, i) the kernel folds bundle q-block i
+against the WHOLE resident KV chunk (copied HBM -> VMEM once per (round,
+batch, kv-head), as in the forward): per kv block j it forms
+p = exp2(s·scale·log2e − lse·log2e) from the FINAL lse riding the bundle
+(no online softmax in the backward — p is the true probability), then
+dv += pᵀ·do, ds = p·(dp − delta), dk += dsᵀ·q, dq_local += ds·k, all f32
+accumulated with the trailing *scale of ds deferred exactly like
+pallas_flash's backward kernels.  dk/dv live in VMEM for a (b, kv-head)
+segment and round-trip the output buffers between rounds (zero-initialized
+at round 0, final at round W-1); masks reuse the SAME per-round
+ops/masks.round_spec scalars the scan backward computes, with q/kv roles
+swapped, so the two paths mask identically by construction.
+
+Interpret mode, supported matrix, and fallback behavior mirror the forward
+(docs/fused_ring.md): `fused_ring.supported(..., pass_="bwd")` gates the
+dispatch in parallel/burst._bwd_impl, and any declined config takes the
+scan-ring backward for that pass only.
+
+Semaphore ledger (everything drains to zero; N = B*Nq*nqb grid steps per
+round, C = slot count, world = W):
+
+  precv[slot]   +4 per arriving bundle (left, rounds 1..W-1: one increment
+                per operand), -4 at the round's first grid step
+  psend[slot]   +4 per outgoing bundle send (rounds 0..W-2), -4 at the same
+                round's last grid step (drain)
+  dqrecv[slot]  +N from the left neighbor's streamed round-(r-1) dq blocks,
+                -N at round r's first grid step
+  dqsend[slot]  +N per round's streamed sends (rounds 0..W-2), -N at that
+                round's last grid step
+  home_sem[0/1] +N each during round W-1 (our sends out / left's blocks
+                in), both -N at the globally last grid step before the
+                HOME-slot -> dq output copy
+  free_pay/free_dq (hw only)  capacity handshake per stream, the forward's
+                formula: grants at the end of rounds 0..W-1-C, one credit
+                taken per send round >= C-1; granted == taken == max(0, W-C).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .masks import round_spec
+from .pallas_flash import (
+    BIG_LSE,
+    LOG2E,
+    NEG_INF,
+    VMEM_LIMIT,
+    _block_full,
+    _block_has_work,
+    _block_mask,
+    _pack,
+    _pick_block,
+    _spec_array,
+)
+from .tuning import resolve_fused
+from ..parallel.ring import (
+    fused_bwd_slot_schedule,
+    my_partition,
+    neighbor_ids,
+    partition_at_round,
+)
+from ..utils.compat import axis_size, tpu_compiler_params
+
+# barrier-semaphore namespace, distinct from the fused forward's (13) so a
+# program tracing both kernels never aliases their startup barriers
+_COLLECTIVE_ID = 14
+
+
+def _col_from_pack(pack, bq, lp):
+    """[bq // lp, lp] packed row-stat tile -> (bq, 1) column (element t of
+    the flat row vector lives at pack[t // lp, t % lp] — same layout as
+    pallas_flash's packed stats, read from a VMEM tile instead of a ref)."""
+    if lp == 1:
+        return pack
+    rep = jnp.repeat(pack, lp, axis=0)  # (bq, lp); row t = pack[t // lp]
+    t_lane = jax.lax.broadcasted_iota(jnp.int32, (bq, lp), 0) % lp
+    c_idx = jax.lax.broadcasted_iota(jnp.int32, (bq, lp), 1)
+    return jnp.sum(jnp.where(t_lane == c_idx, rep, 0.0), axis=1, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# kernel
+
+
+def _fused_bwd_kernel(
+    sched_ref,
+    first_hbm, do_hbm, q_hbm, lse_hbm, k_hbm, v_hbm,
+    dq_ref, dk_ref, dv_ref,
+    *rest,
+    world, slots, scale, bq, bkv, lp, nqb, nkb, group, n_b, n_h,
+    hw_sync, collect, opt_comm,
+):
+    """One grid step = bundle q-block i of head h, batch b_, bwd ring round r.
+
+    sched_ref is the [world + 1, 6] prefetch table: rows 0..world-1 hold the
+    per-round (q_lo, q_hi, kv_hi, causal, offset, slot) — mask scalars from
+    ops/masks.round_spec with the q side being the ROTATING bundle and the
+    kv side the resident chunk — and row `world` holds (me, right, left,
+    0, 0, 0) neighbor ids.
+
+    `collect` (static) appends one more OUTPUT before the scratch refs: a
+    [1, slots] int32 SMEM array counting bundle consumes per communication
+    slot — the devstats bwd slot-reuse counter (obs/devstats.py), written
+    with pure scalar increments at round boundaries so the compute/DMA
+    choreography (and dq/dk/dv) is bit-identical to collect=off.
+
+    `opt_comm` (static) selects the bundle's first operand: delta in packed
+    [.., rows, lp] f32 form (on) or o in [.., bq, D] form (off, delta
+    recomputed per tile) — the reference's optimize_bwd_comm trade.
+    """
+    if collect:
+        slot_use_ref = rest[0]
+        rest = rest[1:]
+    (firstbuf, dobuf, qbuf, lsebuf, dqbuf,
+     kchunk, vchunk, dk_acc, dv_acc,
+     q_t, do_t, first_t, lse_t, dq_arr, dq_scr,
+     cp_sem, chunk_sem, kvio_sem, tile_sem, dqio_sem,
+     psend, precv, dqsend, dqrecv, home_sem,
+     free_pay, free_dq) = rest
+
+    r = pl.program_id(0)
+    b_ = pl.program_id(1)
+    h = pl.program_id(2)
+    i = pl.program_id(3)
+    right = sched_ref[world, 1]
+    left = sched_ref[world, 2]
+    slot = sched_ref[r, 5]
+    first_of_round = (b_ == 0) & (h == 0) & (i == 0)
+    last_of_round = (b_ == n_b - 1) & (h == n_h - 1) & (i == nqb - 1)
+    n_steps = n_b * n_h * nqb  # dq blocks streamed per round
+    home = slots  # dedicated return-home slot, outside the ring cycle
+
+    if collect:
+        @pl.when(first_of_round)
+        def _slot_tally():
+            @pl.when(r == 0)
+            def _zero():
+                for j in range(slots):
+                    slot_use_ref[0, j] = 0
+
+            slot_use_ref[0, slot] = slot_use_ref[0, slot] + 1
+
+    # ---- round choreography (first grid step of the round only) ----
+    @pl.when(first_of_round & (r == 0))
+    def _copy_in():
+        # local bundle -> slot[0]: one HBM->HBM copy per operand so every
+        # later round (compute reads, RDMA sends) addresses the slot
+        # buffers uniformly
+        cps = [
+            pltpu.make_async_copy(first_hbm, firstbuf.at[slot], cp_sem.at[0]),
+            pltpu.make_async_copy(do_hbm, dobuf.at[slot], cp_sem.at[1]),
+            pltpu.make_async_copy(q_hbm, qbuf.at[slot], cp_sem.at[2]),
+            pltpu.make_async_copy(lse_hbm, lsebuf.at[slot], cp_sem.at[3]),
+        ]
+        for c in cps:
+            c.start()
+        for c in cps:
+            c.wait()
+
+    if hw_sync:
+        @pl.when(first_of_round & (r == 0))
+        def _barrier():
+            # neighbors must have entered the kernel (buffers live) before
+            # any RDMA writes their slots
+            bar = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(bar, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_signal(bar, inc=1, device_id=right,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_wait(bar, 2)
+
+    @pl.when(first_of_round & (r > 0))
+    def _recv_wait():
+        # round r's bundle (4 operands) and every streamed dq block of the
+        # left neighbor's previous round must have LANDED in slot[r]
+        pltpu.semaphore_wait(precv.at[slot], 4)
+        pltpu.semaphore_wait(dqrecv.at[slot], n_steps)
+
+    @pl.when(first_of_round & (r < world - 1))
+    def _send_bundle():
+        dst_slot = sched_ref[r + 1, 5]
+        if hw_sync:
+            @pl.when(r >= slots - 1)
+            def _capacity():
+                # target slots were last read by the neighbor at round
+                # r + 1 - slots; take one credit per stream proving both
+                # the bundle slot and the dq slot finished
+                pltpu.semaphore_wait(free_pay, 1)
+                pltpu.semaphore_wait(free_dq, 1)
+        for src in (firstbuf, dobuf, qbuf, lsebuf):
+            pltpu.make_async_remote_copy(
+                src_ref=src.at[slot], dst_ref=src.at[dst_slot],
+                send_sem=psend.at[dst_slot], recv_sem=precv.at[dst_slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+        # no wait here: the transfers overlap this whole round's sweep; the
+        # drain wait sits at the round's LAST grid step below
+
+    # ---- per-(round, batch, kv-head) chunk load: HBM -> VMEM, plus the
+    # fp32 dk/dv accumulator carry (outputs double as the between-round
+    # staging, like the forward's acc scratch) ----
+    @pl.when((i == 0) & (h % group == 0))
+    def _chunk_load():
+        kvh = h // group
+        lk = pltpu.make_async_copy(k_hbm.at[b_, kvh], kchunk, chunk_sem.at[0])
+        lv = pltpu.make_async_copy(v_hbm.at[b_, kvh], vchunk, chunk_sem.at[1])
+        lk.start()
+        lv.start()
+
+        @pl.when(r > 0)
+        def _carry_load():
+            ldk = pltpu.make_async_copy(dk_ref.at[b_, kvh], dk_acc,
+                                        kvio_sem.at[0])
+            ldv = pltpu.make_async_copy(dv_ref.at[b_, kvh], dv_acc,
+                                        kvio_sem.at[1])
+            ldk.start()
+            ldv.start()
+            ldk.wait()
+            ldv.wait()
+
+        @pl.when(r == 0)
+        def _carry_zero():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        lk.wait()
+        lv.wait()
+
+    # ---- per-step bundle tile loads: slot HBM -> VMEM ----
+    tl = [
+        pltpu.make_async_copy(qbuf.at[slot, b_, h, i], q_t, tile_sem.at[0]),
+        pltpu.make_async_copy(dobuf.at[slot, b_, h, i], do_t, tile_sem.at[1]),
+        pltpu.make_async_copy(firstbuf.at[slot, b_, h, i], first_t,
+                              tile_sem.at[2]),
+        pltpu.make_async_copy(lsebuf.at[slot, b_, h, i], lse_t,
+                              tile_sem.at[3]),
+    ]
+    for c in tl:
+        c.start()
+
+    # start the arriving-dq load early: it is only needed at the merge,
+    # after the whole local sweep
+    @pl.when(r > 0)
+    def _dq_arr_start():
+        pltpu.make_async_copy(dqbuf.at[slot, b_, h, i], dq_arr,
+                              dqio_sem.at[0]).start()
+
+    for c in tl:
+        c.wait()
+
+    # ---- local sweep over the resident chunk (no online softmax: p is
+    # the true probability from the bundle's final lse) ----
+    spec_r = tuple(sched_ref[r, c] for c in range(5))
+    r0 = i * bq
+    lse_col = _col_from_pack(lse_t[:], bq, lp)
+    # fully-masked rows carry lse = -inf; BIG_LSE makes p underflow to 0
+    # on the fast path without an elementwise select (pallas_flash idiom)
+    lse_col = jnp.where(lse_col == NEG_INF, BIG_LSE, lse_col * LOG2E)
+    q_raw = q_t[:]
+    do_raw = do_t[:]
+    if opt_comm:
+        delta_col = _col_from_pack(first_t[:], bq, lp)
+    else:
+        delta_col = jnp.sum(
+            first_t[:].astype(jnp.float32) * do_raw.astype(jnp.float32),
+            axis=1, keepdims=True)
+    q_sc = q_raw * (scale * LOG2E)
+    dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _fold(c0, mask):
+        ks = kchunk[pl.ds(c0, bkv), :]
+        vs = vchunk[pl.ds(c0, bkv), :]
+        s_t = jax.lax.dot_general(
+            q_sc, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dp is independent of the softmax: issue it before the VPU chain
+        dp = jax.lax.dot_general(
+            do_raw, vs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp2(s_t - lse_col)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        # trailing *scale of ds deferred to the dq merge / final dk store
+        ds = p * (dp - delta_col)
+        dv_acc[pl.ds(c0, bkv), :] = dv_acc[pl.ds(c0, bkv), :] + \
+            jax.lax.dot_general(
+                p.astype(do_raw.dtype), do_raw, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        dk_acc[pl.ds(c0, bkv), :] = dk_acc[pl.ds(c0, bkv), :] + \
+            jax.lax.dot_general(
+                ds.astype(q_raw.dtype), q_raw, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(ks.dtype), ks, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    for j in range(nkb):
+        c0 = j * bkv
+        live = _block_has_work(spec_r, r0, c0, bq, bkv)
+        full = _block_full(spec_r, r0, c0, bq, bkv)
+
+        @pl.when(live & full)
+        def _fast(c0=c0):
+            _fold(c0, None)
+
+        @pl.when(live & ~full)
+        def _masked(c0=c0):
+            _fold(c0, _block_mask(spec_r, r0, c0, bq, bkv))
+
+    # ---- dq merge: arriving partial (one hop behind) + local contribution,
+    # staged back into the slot and streamed onward immediately ----
+    @pl.when(r > 0)
+    def _dq_merge():
+        pltpu.make_async_copy(dqbuf.at[slot, b_, h, i], dq_arr,
+                              dqio_sem.at[0]).wait()
+        dq_scr[:] = dq_arr[:] + dq_scr[:] * scale
+
+    @pl.when(r == 0)
+    def _dq_init():
+        # round 0 starts this partition's accumulation: no arrival to merge
+        dq_scr[:] = dq_scr[:] * scale
+
+    wb = pltpu.make_async_copy(dq_scr, dqbuf.at[slot, b_, h, i],
+                               dqio_sem.at[1])
+    wb.start()
+    wb.wait()
+
+    @pl.when(r < world - 1)
+    def _dq_send_ring():
+        # the concurrent dq stream: this block's partial leaves NOW, while
+        # later blocks of the same round are still computing — it lands in
+        # the right neighbor's slot[r+1] before its round r+1 first-step wait
+        dst_slot = sched_ref[r + 1, 5]
+        pltpu.make_async_remote_copy(
+            src_ref=dqbuf.at[slot, b_, h, i],
+            dst_ref=dqbuf.at[dst_slot, b_, h, i],
+            send_sem=dqsend.at[dst_slot], recv_sem=dqrecv.at[dst_slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+
+    @pl.when(r == world - 1)
+    def _dq_send_home():
+        # return-home hop: the fully-accumulated partition gradient lands in
+        # the right neighbor's dedicated HOME slot (its owner)
+        pltpu.make_async_remote_copy(
+            src_ref=dqbuf.at[slot, b_, h, i],
+            dst_ref=dqbuf.at[home, b_, h, i],
+            send_sem=home_sem.at[0], recv_sem=home_sem.at[1],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+
+    # ---- dk/dv segment epilogue: stage the fp32 accumulators back to the
+    # output buffers (final at the last round, with ds's deferred scale) ----
+    @pl.when((i == nqb - 1) & (h % group == group - 1))
+    def _kv_store():
+        kvh = h // group
+
+        @pl.when(r == world - 1)
+        def _final_scale():
+            dk_acc[:] = dk_acc[:] * scale
+
+        sk = pltpu.make_async_copy(dk_acc, dk_ref.at[b_, kvh], kvio_sem.at[2])
+        sv = pltpu.make_async_copy(dv_acc, dv_ref.at[b_, kvh], kvio_sem.at[3])
+        sk.start()
+        sv.start()
+        sk.wait()
+        sv.wait()
+
+    # ---- round epilogue (last grid step of the round only) ----
+    @pl.when(last_of_round & (r < world - 1))
+    def _send_drain():
+        # outgoing RDMA read slot[r]; everything must be out the door before
+        # the left neighbor may overwrite the slots (free credits below) and
+        # before the kernel may exit with a live DMA
+        dst_slot = sched_ref[r + 1, 5]
+        pltpu.semaphore_wait(psend.at[dst_slot], 4)
+        pltpu.semaphore_wait(dqsend.at[dst_slot], n_steps)
+
+    @pl.when(last_of_round & (r == world - 1))
+    def _home_epilogue():
+        # drain our own return-home sends, wait for the left neighbor's
+        # full set of home blocks, then land the HOME slot in the output
+        pltpu.semaphore_wait(home_sem.at[0], n_steps)
+        pltpu.semaphore_wait(home_sem.at[1], n_steps)
+        cp = pltpu.make_async_copy(dqbuf.at[home], dq_ref, cp_sem.at[0])
+        cp.start()
+        cp.wait()
+
+    if hw_sync:
+        @pl.when(last_of_round & (r <= world - 1 - slots))
+        def _grant_free():
+            # slot[r] of both streams has no further readers here: every
+            # grid step consumed its tiles, our onward sends drained — the
+            # LEFT neighbor (writer of our slots) may target them again
+            pltpu.semaphore_signal(free_pay, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_signal(free_dq, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+# ---------------------------------------------------------------------------
+# shard-level entry point
+
+
+def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
+                   collect_stats=False):
+    """Backward burst attention on per-shard arrays via the fused ring
+    kernel — the drop-in twin of parallel/burst._bwd_impl's scan ring.
+
+    Call inside shard_map on the ring axis: q/o/do [B, N, S, D], k/v
+    [B, Nk, S, D], lse [B, N, S] f32 (the forward residuals in layout
+    order).  Returns (dq, dk, dv) in float32 — the caller casts back to
+    the input dtypes, exactly like the scan backward — plus the kernel's
+    [1, slots] int32 bundle slot-consume counters when `collect_stats`
+    (the devstats bwd slot-reuse channel; the stats-off call emits the
+    identical kernel with no extra output).  Callers must have checked
+    `fused_ring.supported(..., pass_="bwd")` first.
+    """
+    b, n, s, d = q.shape
+    n_kv = k.shape[1]
+    assert n % n_kv == 0, f"GQA needs Nq % Nk == 0, got {n} % {n_kv}"
+    group = n // n_kv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = cfg.scale if cfg.scale is not None else d ** -0.5
+    world = axis_size(cfg.intra_axis)
+    rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
+                       cfg.fused_kv_slots,
+                       block_q_bwd=cfg.fused_block_q_bwd,
+                       block_kv_bwd=cfg.fused_block_kv_bwd,
+                       bwd_slots=cfg.fused_bwd_slots)
+    slots = min(rf.bwd_slots, world)
+    bq = _pick_block(s, rf.block_q_bwd)
+    bkv = _pick_block(s, rf.block_kv_bwd)
+    lp = _pick_block(bq, 128)
+    nqb = s // bq
+    rows = bq // lp
+    nkb = s // bkv
+
+    # [world + 1, 6] schedule table (see _fused_bwd_kernel docstring): mask
+    # scalars reuse the SAME per-round specs the scan backward computes —
+    # q side = rotating bundle partition, kv side = resident local chunk
+    part_me = my_partition(cfg.intra_axis, None)
+    slot_sched = fused_bwd_slot_schedule(world, slots)
+    table = []
+    for r in range(world):
+        sp = round_spec(partition_at_round(r, cfg.intra_axis, None), part_me,
+                        s, s, cfg.causal, cfg.layout)
+        table.append(jnp.concatenate(
+            [_spec_array(sp),
+             jnp.asarray([int(slot_sched[r])], jnp.int32)]))
+    me, right, left = neighbor_ids(cfg.intra_axis)
+    table.append(jnp.stack([jnp.asarray(me, jnp.int32),
+                            jnp.asarray(right, jnp.int32),
+                            jnp.asarray(left, jnp.int32),
+                            jnp.int32(0), jnp.int32(0), jnp.int32(0)]))
+    sched = jnp.stack(table)
+
+    # bundle operands, pre-blocked so every slot/tile address is integer
+    # indexing ([B, N, nqb, bq, D] is the same memory as [B, N, S, D]);
+    # rank-3 stats ride in pallas_flash's packed [.., rows, lp] layout
+    q_in = q.reshape(b, n, nqb, bq, d)
+    do_in = do.reshape(b, n, nqb, bq, d)
+    lse_in = _pack(lse.astype(jnp.float32), lp).reshape(b, n, nqb, rows, lp)
+    if cfg.optimize_bwd_comm:
+        delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                        axis=-1)
+        first_in = _pack(delta, lp).reshape(b, n, nqb, rows, lp)
+        first_slot_shape = (b, n, nqb, rows, lp)
+        first_tile_shape = (rows, lp)
+        first_dtype = jnp.float32
+    else:
+        # ring payload grows by a factor of head_dim; delta is recomputed
+        # per tile from the rotated (o, do) pair (reference parity)
+        first_in = o.reshape(b, n, nqb, bq, d)
+        first_slot_shape = (b, n, nqb, bq, d)
+        first_tile_shape = (bq, d)
+        first_dtype = o.dtype
+
+    kernel = functools.partial(
+        _fused_bwd_kernel, world=world, slots=slots, scale=scale, bq=bq,
+        bkv=bkv, lp=lp, nqb=nqb, nkb=nkb, group=group, n_b=b, n_h=n,
+        hw_sync=not interpret, collect=collect_stats,
+        opt_comm=cfg.optimize_bwd_comm,
+    )
+
+    out_specs = [
+        pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # dq
+        pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # dk
+        pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # dv
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, n, nqb, bq, d), jnp.float32),
+        jax.ShapeDtypeStruct((b, n_kv, s, d), jnp.float32),
+        jax.ShapeDtypeStruct((b, n_kv, s, d), jnp.float32),
+    ]
+    if collect_stats:
+        out_specs.append(
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.SMEM))
+        out_shape.append(jax.ShapeDtypeStruct((1, slots), jnp.int32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(world, b, n, nqb),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)] * 6,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.ANY((slots,) + first_slot_shape, first_dtype),  # firstbuf
+            pltpu.ANY((slots, b, n, nqb, bq, d), do.dtype),       # dobuf
+            pltpu.ANY((slots, b, n, nqb, bq, d), q.dtype),        # qbuf
+            pltpu.ANY((slots, b, n, nqb, rows, lp), jnp.float32),  # lsebuf
+            # dq ring slots + the dedicated return-home slot (index `slots`)
+            pltpu.ANY((slots + 1, b, n, nqb, bq, d), jnp.float32),  # dqbuf
+            pltpu.VMEM((s, d), k.dtype),                  # kchunk
+            pltpu.VMEM((s, d), v.dtype),                  # vchunk
+            pltpu.VMEM((s, d), jnp.float32),              # dk_acc
+            pltpu.VMEM((s, d), jnp.float32),              # dv_acc
+            pltpu.VMEM((bq, d), q.dtype),                 # q_t
+            pltpu.VMEM((bq, d), do.dtype),                # do_t
+            pltpu.VMEM(first_tile_shape, first_dtype),    # first_t
+            pltpu.VMEM((rows, lp), jnp.float32),          # lse_t
+            pltpu.VMEM((bq, d), jnp.float32),             # dq_arr
+            pltpu.VMEM((bq, d), jnp.float32),             # dq_scr
+            pltpu.SemaphoreType.DMA((4,)),                # cp_sem
+            pltpu.SemaphoreType.DMA((2,)),                # chunk_sem
+            pltpu.SemaphoreType.DMA((4,)),                # kvio_sem
+            pltpu.SemaphoreType.DMA((4,)),                # tile_sem
+            pltpu.SemaphoreType.DMA((2,)),                # dqio_sem
+            pltpu.SemaphoreType.DMA((slots,)),            # psend
+            pltpu.SemaphoreType.DMA((slots,)),            # precv
+            pltpu.SemaphoreType.DMA((slots,)),            # dqsend
+            pltpu.SemaphoreType.DMA((slots,)),            # dqrecv
+            pltpu.SemaphoreType.DMA((2,)),                # home_sem
+            pltpu.SemaphoreType.REGULAR,                  # free_pay
+            pltpu.SemaphoreType.REGULAR,                  # free_dq
+        ],
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        # sequential by construction: the ring choreography, the VMEM
+        # dk/dv accumulators and the dq stream all assume one core walks
+        # the grid in order — a megacore split would race them
+        compiler_params=tpu_compiler_params(
+            vmem_limit_bytes=VMEM_LIMIT,
+            dimension_semantics=("arbitrary",) * 4,
+            collective_id=_COLLECTIVE_ID,
+        ),
+        interpret=interpret,
+    )(sched, first_in, do_in, q_in, lse_in, k, v)
+    dq = outs[0].reshape(b, n, s, d)
+    if not collect_stats:
+        return dq, outs[1], outs[2]
+    return dq, outs[1], outs[2], outs[3]

@@ -51,6 +51,16 @@ class BlockTable(NamedTuple):
     # resident KV chunk + stats; above it the dispatch falls back to the
     # scan ring rather than risk a Mosaic allocation failure mid-ring.
     fused_vmem_budget: int = 96 * 1024 * 1024
+    # Fused ring BACKWARD kernel (ops/fused_ring_bwd.py): bundle/dq slot
+    # count and its grid blocks.  The bwd grid step keeps ~5 [bq, D] tiles
+    # plus two [bq, bkv] intermediates live on top of the resident KV chunk
+    # and the fp32 dk/dv accumulators, so its q block defaults one power of
+    # two below the forward's — mirroring the scan kernels' fwd/bwd block
+    # asymmetry.  Estimated until swept on hardware
+    # (benchmarks/ring_overlap.py --pass bwd reports per-config timings).
+    fused_bwd_slots: int = 2
+    fused_block_q_bwd: int = 256
+    fused_block_kv_bwd: int = 512
 
 
 class ResolvedBlocks(NamedTuple):
@@ -198,29 +208,44 @@ def _clamp_cliff(bq: int, bkv: int, area: int, which: str):
 
 
 class ResolvedFused(NamedTuple):
-    """resolve_fused() result: the fused ring kernel's static plan knobs."""
+    """resolve_fused() result: the fused ring kernels' static plan knobs
+    (forward KV ring AND backward bundle/dq ring — one resolution so the
+    two passes can never read different generation rows)."""
 
     block_q: int
     block_kv: int
     kv_slots: int
     vmem_budget: int
+    block_q_bwd: int
+    block_kv_bwd: int
+    bwd_slots: int
 
 
 def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
-                  device=None) -> ResolvedFused:
-    """Fill the fused ring kernel's knobs from the per-generation table.
+                  device=None, block_q_bwd=None, block_kv_bwd=None,
+                  bwd_slots=None) -> ResolvedFused:
+    """Fill the fused ring kernels' knobs from the per-generation table.
 
-    kv_slots < 2 cannot double-buffer (the send target would be the slot
-    being computed on) and is rejected rather than silently bumped — an
-    explicit wrong config should fail loudly, only the table default is
-    implicit."""
+    kv_slots / bwd_slots < 2 cannot double-buffer (the send target would
+    be the slot being computed on) and is rejected rather than silently
+    bumped — an explicit wrong config should fail loudly, only the table
+    default is implicit.  The bwd blocks never default LARGER than the
+    (resolved) fwd blocks, mirroring resolve_blocks: a caller who tunes
+    the fwd blocks down for VMEM keeps that budget in the backward."""
     t = block_defaults(device)
     bq = t.fused_block_q if block_q is None else block_q
     bkv = t.fused_block_kv if block_kv is None else block_kv
     slots = t.fused_kv_slots if kv_slots is None else kv_slots
+    bqb = min(t.fused_block_q_bwd, bq) if block_q_bwd is None else block_q_bwd
+    bkvb = (min(t.fused_block_kv_bwd, bkv) if block_kv_bwd is None
+            else block_kv_bwd)
+    bslots = t.fused_bwd_slots if bwd_slots is None else bwd_slots
     if slots < 2:
         raise ValueError(f"fused ring needs kv_slots >= 2, got {slots}")
-    return ResolvedFused(bq, bkv, slots, t.fused_vmem_budget)
+    if bslots < 2:
+        raise ValueError(f"fused ring bwd needs bwd_slots >= 2, got {bslots}")
+    return ResolvedFused(bq, bkv, slots, t.fused_vmem_budget,
+                         bqb, bkvb, bslots)
 
 
 def resolve_blocks(block_q=None, block_kv=None, block_q_bwd=None,

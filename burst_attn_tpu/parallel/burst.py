@@ -112,10 +112,15 @@ class BurstConfig:
     window: Optional[int] = None
     # Fused ring kernel knobs (backend="fused_ring" only): KV communication
     # slot count (>= 2) and the fused grid's q-row / kv-sweep blocks; None =
-    # the per-TPU-generation table (ops/tuning.py resolve_fused).
+    # the per-TPU-generation table (ops/tuning.py resolve_fused).  The
+    # *_bwd / bwd_slots trio tunes the fused BACKWARD kernel (bundle + dq
+    # ring, ops/fused_ring_bwd.py) independently.
     fused_kv_slots: Optional[int] = None
     fused_block_q: Optional[int] = None
     fused_block_kv: Optional[int] = None
+    fused_bwd_slots: Optional[int] = None
+    fused_block_q_bwd: Optional[int] = None
+    fused_block_kv_bwd: Optional[int] = None
     # Structural causal scheduling (reference burst_attn_interface.py:221-235,
     # :303-367): zigzag rounds dispatch through a 3-way lax.cond whose
     # branches run statically-sliced dense tiles (full q x half kv / half q x
@@ -449,7 +454,23 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
     returned home by one extra hop (burst_attn_interface.py:255-398).
     With packed sequences (`seg`), the q-side ids rotate with the payload
     while the resident kv side keeps the local ids.
+
+    This is the ONE backward dispatch point (all four custom_vjp twins
+    route here): with `backend="fused_ring"` both rotating streams run
+    inside a single Pallas kernel (ops/fused_ring_bwd.py) when the bwd
+    gate admits the config; declined configs fall through to the scan
+    ring below with the tile backend from _tile_backend.
     """
+    if cfg.backend == "fused_ring":
+        from ..ops import fused_ring, fused_ring_bwd
+
+        reason = fused_ring.supported(cfg, q.shape, k.shape, seg is not None,
+                                      pass_="bwd")
+        if reason is None:
+            return fused_ring_bwd.fused_ring_bwd(cfg, q, k, v, o, lse, do)
+        logger.info("fused_ring backward falling back to the scan ring: %s",
+                    reason)
+
     b, n, s, d = q.shape
     scale = cfg.scale if cfg.scale is not None else d**-0.5
     n_inter, n_intra = _sizes(cfg)
@@ -815,9 +836,20 @@ def _note_dispatch(cfg: BurstConfig, mesh, q_shape, k_shape, has_seg: bool,
                                       world=n_intra,
                                       extra_axes=extra_b + extra_h)
         path = "fused" if reason is None else "scan"
+        # the backward runs its own gate at _bwd_impl's dispatch point; a
+        # bwd-only decline (e.g. the bwd VMEM plan overflowing while the
+        # fwd fits) must be distinguishable in obs output, so the fallback
+        # counter is labeled by pass
+        reason_bwd = fused_ring.supported(cfg, q_local, k_local, has_seg,
+                                          world=n_intra,
+                                          extra_axes=extra_b + extra_h,
+                                          pass_="bwd")
+        if reason_bwd is not None:
+            _M_FALLBACK.inc(reason=_fallback_label(reason_bwd),
+                            **{"pass": "bwd"})
     _M_DISPATCH.inc(path=path, backend=cfg.backend, tile=_tile_backend(cfg))
     if reason is not None:
-        _M_FALLBACK.inc(reason=_fallback_label(reason))
+        _M_FALLBACK.inc(reason=_fallback_label(reason), **{"pass": "fwd"})
     r_live = _r_live(cfg, q_local[2], k_local[2], n_inter, n_intra)
     rounds, intra_hops, inter_hops = ring_round_counts(n_inter, n_intra,
                                                        r_live)
@@ -865,6 +897,9 @@ def burst_attn(
     fused_kv_slots: Optional[int] = None,
     fused_block_q: Optional[int] = None,
     fused_block_kv: Optional[int] = None,
+    fused_bwd_slots: Optional[int] = None,
+    fused_block_q_bwd: Optional[int] = None,
+    fused_block_kv_bwd: Optional[int] = None,
     collect_stats: bool = False,
 ) -> jax.Array:
     """Burst attention on global arrays [B, N, S, D]; S must already be in
@@ -914,6 +949,9 @@ def burst_attn(
         fused_kv_slots=fused_kv_slots,
         fused_block_q=fused_block_q,
         fused_block_kv=fused_block_kv,
+        fused_bwd_slots=fused_bwd_slots,
+        fused_block_q_bwd=fused_block_q_bwd,
+        fused_block_kv_bwd=fused_block_kv_bwd,
     )
     _note_dispatch(cfg, mesh, q.shape, k.shape, segment_ids is not None,
                    batch_axes, head_axes)

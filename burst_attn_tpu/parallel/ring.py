@@ -142,6 +142,37 @@ def fused_slot_schedule(world: int, slots: int):
     return np.arange(world, dtype=np.int64) % min(slots, world)
 
 
+def fused_bwd_slot_schedule(world: int, slots: int):
+    """Host-side slot schedule of the fused ring BACKWARD kernel
+    (ops/fused_ring_bwd.py): [world] int array where entry r is the
+    communication-buffer slot holding (a) the q-side bundle (delta|o, do,
+    q, lse) and (b) the arriving dq partial a device consumes at backward
+    ring round r.
+
+    The two concurrent streams share one slot cycle but live in DISJOINT
+    buffers with disjoint semaphores, and their sends are phase-shifted:
+    the bundle for round r+1 leaves at round r's FIRST grid step (like the
+    forward's KV rotation), while the dq partial for round r+1 streams out
+    block-by-block DURING round r, each block sent as soon as its local
+    contribution is folded in — "one hop behind the bundle".  Round world-1
+    does not send the bundle onward; its dq blocks take the final
+    return-home hop into the right neighbor's dedicated home slot (index
+    `min(slots, world)`, outside this cycle) instead.
+
+    burstlint re-derives this schedule independently and proves both
+    streams by simulation (analysis/oracle.verify_fused_ring_bwd):
+    neighbor-only sends, world-1 ring hops per bundle, every dq partial
+    arriving home exactly once with all `world` contributions, and no slot
+    overwritten before its last read under the capacity handshake.
+    """
+    import numpy as np
+
+    if world < 1 or slots < 2:
+        raise ValueError(f"need world >= 1 and slots >= 2, got "
+                         f"world={world}, slots={slots}")
+    return np.arange(world, dtype=np.int64) % min(slots, world)
+
+
 def partition_at_round(r, intra_axis: str, inter_axis):
     """Global partition id of the KV (fwd) / query-side (bwd) payload held at
     0-indexed ring round r under the (double-)ring schedule.

@@ -7,5 +7,6 @@ python bench.py
 python -m benchmarks.benchmark --methods burst,flash --causal "$@"
 # perf-regression gate: fail the bench when the fresh headline drops below
 # the best prior BENCH/BASELINE value for the same metric (exit 1) — catch
-# a regression at bench time, not three rounds later
-python scripts/check_regression.py --tolerance 0.1
+# a regression at bench time, not three rounds later.  Cached replays older
+# than a day additionally get a STALE-CACHE warning (never a gate failure).
+python scripts/check_regression.py --tolerance 0.1 --max-cached-age 24

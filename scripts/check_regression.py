@@ -18,6 +18,11 @@ Matching is by the exact `metric` string (configs self-describe:
 higher-is-better.  Metrics with no history PASS with a note — a brand-new
 config cannot regress.  Cached headline replays still gate: a cached record
 IS a prior on-chip measurement, and history only moves when fresh runs land.
+Cached provenance (`cached` / `cached_age_hours` from bench.py's replay
+path) is surfaced on every verdict line, and `--max-cached-age HOURS` adds
+a STALE-CACHE warning — warn only, never a gate failure: a stale replay is
+an honest old number, not a regression, but a driver round gating on a
+58-hour-old record should say so out loud.
 
 Exit status: 0 clean (or --dry-run), 1 regression, 2 internal error
 (missing/unparseable current headline counts as 2 — the gate cannot run).
@@ -40,7 +45,8 @@ def _load_json(path):
 
 
 def load_headlines(patterns):
-    """[(path, metric, value)] from headline-style records."""
+    """[(path, metric, value, record)] from headline-style records — the
+    full record rides along so the verdicts can surface cached provenance."""
     out = []
     for pat in patterns:
         for path in sorted(glob.glob(pat)):
@@ -50,7 +56,7 @@ def load_headlines(patterns):
                 raise RuntimeError(f"unreadable headline {path}: {e}")
             if not isinstance(rec, dict) or "metric" not in rec:
                 raise RuntimeError(f"{path}: not a headline record")
-            out.append((path, str(rec["metric"]), float(rec["value"])))
+            out.append((path, str(rec["metric"]), float(rec["value"]), rec))
     return out
 
 
@@ -95,27 +101,49 @@ def load_history(patterns, baseline_path):
     return best
 
 
-def check(headlines, history, tolerance):
-    """[(status, line)] verdicts; status in PASS/REGRESSION/NO-HISTORY."""
+def _cached_note(rec):
+    """' [cached, NNh old]' provenance suffix for replayed records."""
+    if not rec.get("cached"):
+        return ""
+    age = rec.get("cached_age_hours")
+    if age is None:
+        return " [cached, age unknown]"
+    return f" [cached, {float(age):.1f}h old]"
+
+
+def check(headlines, history, tolerance, max_cached_age=None):
+    """[(status, line)] verdicts; status in PASS/REGRESSION/NO-HISTORY/
+    STALE-CACHE.  STALE-CACHE entries are warnings riding NEXT TO the
+    metric's real verdict — they never gate."""
     verdicts = []
-    for path, metric, value in headlines:
+    for path, metric, value, rec in headlines:
+        note = _cached_note(rec)
         prior = history.get(metric)
         if prior is None:
             verdicts.append(("NO-HISTORY",
                              f"NO-HISTORY  {metric}: {value:g} "
-                             f"({os.path.basename(path)}) — nothing to "
-                             "compare against"))
-            continue
-        best, source = prior
-        floor = best * (1.0 - tolerance)
-        ratio = value / best if best else float("inf")
-        line = (f"{metric}: current {value:g} vs best {best:g} "
-                f"[{source}] = {ratio:.4f} (floor {floor:g} at "
-                f"tolerance {tolerance:g})")
-        if value < floor:
-            verdicts.append(("REGRESSION", f"REGRESSION  {line}"))
+                             f"({os.path.basename(path)}){note} — nothing "
+                             "to compare against"))
         else:
-            verdicts.append(("PASS", f"PASS        {line}"))
+            best, source = prior
+            floor = best * (1.0 - tolerance)
+            ratio = value / best if best else float("inf")
+            line = (f"{metric}: current {value:g}{note} vs best {best:g} "
+                    f"[{source}] = {ratio:.4f} (floor {floor:g} at "
+                    f"tolerance {tolerance:g})")
+            if value < floor:
+                verdicts.append(("REGRESSION", f"REGRESSION  {line}"))
+            else:
+                verdicts.append(("PASS", f"PASS        {line}"))
+        if (max_cached_age is not None and rec.get("cached")
+                and float(rec.get("cached_age_hours", float("inf")))
+                > max_cached_age):
+            age = rec.get("cached_age_hours", "unknown")
+            verdicts.append((
+                "STALE-CACHE",
+                f"STALE-CACHE {metric}: replayed record is {age}h old "
+                f"(> --max-cached-age {max_cached_age:g}) — warn only; "
+                "land a fresh on-chip run to refresh the cache"))
     return verdicts
 
 
@@ -136,6 +164,10 @@ def main(argv=None) -> int:
     ap.add_argument("--tolerance", type=float, default=0.1,
                     help="allowed fractional drop below the best prior "
                          "value (default: 0.10)")
+    ap.add_argument("--max-cached-age", type=float, default=None,
+                    metavar="HOURS",
+                    help="warn (never gate) when a cached headline replay "
+                         "is older than this many hours")
     ap.add_argument("--dry-run", action="store_true",
                     help="report verdicts but always exit 0 (CI smoke lane)")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -153,25 +185,30 @@ def main(argv=None) -> int:
                 f"no headline records match {headline_pats!r} — "
                 "run bench.py first")
         history = load_history(history_pats, args.baseline)
-        verdicts = check(headlines, history, args.tolerance)
+        verdicts = check(headlines, history, args.tolerance,
+                         max_cached_age=args.max_cached_age)
     except RuntimeError as e:
         print(f"check_regression: {e}", file=sys.stderr)
         return 2
 
     regressed = [line for st, line in verdicts if st == "REGRESSION"]
+    stale = [line for st, line in verdicts if st == "STALE-CACHE"]
     if args.as_json:
         print(json.dumps({
             "tolerance": args.tolerance,
             "dry_run": args.dry_run,
             "n_regressions": len(regressed),
+            "n_stale_cached": len(stale),
             "verdicts": [{"status": st, "detail": line}
                          for st, line in verdicts],
         }, indent=1))
     else:
         for _, line in verdicts:
             print(line)
-        print(f"check_regression: {len(regressed)} regression(s) across "
-              f"{len(verdicts)} metric(s), tolerance {args.tolerance:g}"
+        print(f"check_regression: {len(regressed)} regression(s), "
+              f"{len(stale)} stale-cache warning(s) across "
+              f"{len(verdicts) - len(stale)} metric(s), tolerance "
+              f"{args.tolerance:g}"
               + (" [dry-run]" if args.dry_run else ""))
     if regressed and not args.dry_run:
         return 1

@@ -48,9 +48,12 @@ PY
   python -m burst_attn_tpu.obs --merge "$obs_tmp/obs*.jsonl" > /dev/null
   python scripts/check_regression.py --dry-run
 elif [[ $fused == 1 ]]; then
-  # focused lane for the fused RDMA-ring kernel's interpret-mode parity
-  # tests (the same tests also run in the default/fast lanes — this is the
-  # quick iteration loop while working on ops/fused_ring.py)
+  # focused lane for the fused RDMA-ring kernels' interpret-mode parity
+  # tests — forward (tests/test_fused_ring.py), backward
+  # (tests/test_fused_ring_bwd.py), devstats bit-identity, and the
+  # fused-rule burstlint mutations in tests/test_analysis.py all carry the
+  # fused_ring marker.  The same tests also run in the default/fast lanes —
+  # this is the quick iteration loop while working on ops/fused_ring*.py
   python -m pytest tests/ -q -m "fused_ring" ${filtered[@]+"${filtered[@]}"}
 elif [[ $fast == 1 ]]; then
   python -m pytest tests/ -q -m "not slow" ${filtered[@]+"${filtered[@]}"}

@@ -554,3 +554,71 @@ def test_fused_schedule_mutation_fires(monkeypatch):
     findings = ringcheck.verify_fused_ring()
     assert "fused-ring-schedule" in _rules_of(findings), [
         f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# fused ring BACKWARD schedule/fusion rules (ISSUE 5): reordered dq hop,
+# extra collective, fp16 accum each fire
+
+
+@pytest.mark.fused_ring
+def test_fused_bwd_oracle_proves_itself():
+    for world, slots in [(2, 2), (4, 2), (8, 2), (8, 3), (8, 8)]:
+        oracle.verify_fused_ring_bwd(world, slots)
+    # no double buffering: every round's bundle AND dq stream share slot 0,
+    # so a sender one round ahead overwrites an unconsumed version
+    with pytest.raises(AssertionError):
+        oracle.verify_fused_ring_bwd(8, 2, [0] * 8)
+    # reordered dq hop: consecutive rounds sharing a slot mean the dq
+    # partial streamed during round 1 lands in the slot round 2 still has
+    # to read — overwritten before read under the capacity credits
+    with pytest.raises(AssertionError):
+        oracle.verify_fused_ring_bwd(8, 2, [0, 1, 1, 0, 0, 1, 1, 0])
+
+
+@pytest.mark.fused_ring
+def test_fused_bwd_schedule_mutation_fires(monkeypatch):
+    from burst_attn_tpu.parallel import ring
+
+    monkeypatch.setattr(ring, "fused_bwd_slot_schedule",
+                        lambda world, slots: np.zeros(world, dtype=np.int64))
+    findings = ringcheck.verify_fused_ring()
+    assert "fused-ring-schedule" in _rules_of(findings), [
+        f.format() for f in findings]
+    assert any("bwd" in f.message for f in findings
+               if f.rule == "fused-ring-schedule")
+
+
+@pytest.mark.fused_ring
+def test_fused_bwd_extra_collective_fires():
+    """A dq hop smuggled OUTSIDE the kernel (an XLA collective in a trace
+    claiming to be the fused backward) fires fused-ring-fused — as does the
+    starved remote-copy census of the same seeded program."""
+    mesh = _mesh4()
+    spec = P(None, None, "sp", None)
+    fn = shard_map(lambda dq: ppermute_by(dq, "sp", 1), mesh=mesh,
+                   in_specs=spec, out_specs=spec, check_vma=False)
+    jx = jax.make_jaxpr(fn)(
+        jax.ShapeDtypeStruct((1, 2, 64, 8), jnp.float32))
+    findings = ringcheck.verify_fused_bwd_trace(jx, where="seeded bwd",
+                                                anchor=ANCHOR)
+    msgs = [f.message for f in findings if f.rule == "fused-ring-fused"]
+    assert any("collectives" in m for m in msgs), msgs
+    assert any("6 remote dma_starts" in m for m in msgs), msgs
+    assert findings[0].file == "seeded.py" and findings[0].line == 7
+
+
+@pytest.mark.fused_ring
+def test_fused_bwd_fp16_accum_fires():
+    """A bf16 dot without the f32 accumulator inside a bwd-shaped trace is
+    reported through the same verifier the bwd rule family runs."""
+    S = jax.ShapeDtypeStruct
+    q = S((1, 2, 64, 16), jnp.bfloat16)
+
+    def bad(q, k):
+        return jax.lax.dot_general(q[0, 0], k[0, 0], (((1,), (1,)), ((), ())))
+
+    jx = jax.make_jaxpr(bad)(q, q)
+    findings = ringcheck.verify_fused_bwd_trace(jx, where="seeded bwd kernel",
+                                                anchor=ANCHOR)
+    assert "fp32-accum" in _rules_of(findings)

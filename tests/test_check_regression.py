@@ -1,0 +1,72 @@
+"""scripts/check_regression.py gate semantics — pure-stdlib script, tested
+through its main() so the argparse surface (--tolerance, --max-cached-age,
+--dry-run) is exercised exactly as scripts/bench.sh invokes it."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "check_regression",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "check_regression.py"))
+cr = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(cr)
+
+
+def _write(path, obj):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(obj))
+
+
+def _argv(tmp_path, *extra):
+    return ["--headline", str(tmp_path / "results" / "headline*.json"),
+            "--history", str(tmp_path / "BENCH_*.json"),
+            "--baseline", str(tmp_path / "BASELINE.json"), *extra]
+
+
+@pytest.mark.parametrize("value,want_exit", [(128.0, 0), (90.0, 1)])
+def test_gate_pass_and_regression(tmp_path, capsys, value, want_exit):
+    _write(tmp_path / "results" / "headline.json",
+           {"metric": "m1", "value": value})
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"metric": "m1", "value": 130.0}})
+    assert cr.main(_argv(tmp_path)) == want_exit
+    out = capsys.readouterr().out
+    assert ("REGRESSION" in out) == bool(want_exit)
+
+
+def test_cached_provenance_and_stale_warn_never_gate(tmp_path, capsys):
+    """A cached replay surfaces its age on the verdict line, and
+    --max-cached-age adds a STALE-CACHE warning WITHOUT failing the gate —
+    an honest old number is not a regression (BENCH_r05's 58 h replay)."""
+    _write(tmp_path / "results" / "headline.json",
+           {"metric": "m1", "value": 130.0, "cached": True,
+            "cached_age_hours": 58.3})
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"metric": "m1", "value": 130.0}})
+    assert cr.main(_argv(tmp_path, "--max-cached-age", "24")) == 0
+    out = capsys.readouterr().out
+    assert "[cached, 58.3h old]" in out
+    assert "STALE-CACHE" in out and "1 stale-cache warning(s)" in out
+    # fresh enough -> no warning; no flag -> no warning
+    for extra in (("--max-cached-age", "72"), ()):
+        assert cr.main(_argv(tmp_path, *extra)) == 0
+        assert "STALE-CACHE" not in capsys.readouterr().out
+
+
+def test_stale_warning_rides_next_to_a_regression(tmp_path, capsys):
+    """STALE-CACHE is additive: a genuinely regressed cached record still
+    exits 1, with both lines and the age in the JSON verdict stream."""
+    _write(tmp_path / "results" / "headline.json",
+           {"metric": "m1", "value": 100.0, "cached": True,
+            "cached_age_hours": 58.3})
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"metric": "m1", "value": 130.0}})
+    assert cr.main(_argv(tmp_path, "--max-cached-age", "24", "--json")) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["n_regressions"] == 1 and rep["n_stale_cached"] == 1
+    statuses = {v["status"] for v in rep["verdicts"]}
+    assert statuses == {"REGRESSION", "STALE-CACHE"}

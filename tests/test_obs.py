@@ -509,14 +509,19 @@ def test_fused_dispatch_fallback_counter(monkeypatch):
     scan0 = obs.counter("burst.dispatch").get(path="scan",
                                               backend="fused_ring",
                                               tile="jnp")
-    fb0 = obs.counter("burst.fused_fallback").get(reason="off-tpu")
+    fwd_lab = {"reason": "off-tpu", "pass": "fwd"}
+    bwd_lab = {"reason": "off-tpu", "pass": "bwd"}
+    fb0 = obs.counter("burst.fused_fallback").get(**fwd_lab)
+    fb0b = obs.counter("burst.fused_fallback").get(**bwd_lab)
     o = bat.burst_attn(ql, ql, ql, mesh=mesh, causal=True, layout="zigzag",
                        backend="fused_ring")
     jax.block_until_ready(o)
     assert obs.counter("burst.dispatch").get(
         path="scan", backend="fused_ring", tile="jnp") == scan0 + 1
-    assert obs.counter("burst.fused_fallback").get(
-        reason="off-tpu") == fb0 + 1
+    # fallback reasons are split by pass: this dispatch declined BOTH the
+    # fused forward and the fused backward (same off-TPU reason)
+    assert obs.counter("burst.fused_fallback").get(**fwd_lab) == fb0 + 1
+    assert obs.counter("burst.fused_fallback").get(**bwd_lab) == fb0b + 1
 
 
 def test_ring_round_counts_double_ring():
