@@ -35,7 +35,14 @@ TPU ring.
 
 Usage:  python -m benchmarks.ring_overlap [--seqs 16384,65536]
         [--mesh 8] [--layout zigzag] [--heads 32] [--dim 128]
-        [--pass fwd|bwd|fwd+bwd|all] [--out results/ring_overlap.jsonl]
+        [--pass fwd|bwd|fwd+bwd|all] [--topology uni|bidi|double|all]
+        [--out results/ring_overlap.jsonl]
+
+--topology selects the compiled fused-ring schedule (parallel/schedule.py):
+"bidi" runs the counter-rotating ring and also records the per-direction
+comm floors (t_comm_uni_s vs the split t_comm_only_s — the reclaimable
+hop latency), "double" factors the flat mesh inter-major and times the
+prefetched inter hop in its floor.
 """
 
 import argparse
@@ -101,11 +108,55 @@ def _shard_fwd(mesh, cfg, no_rotate=False):
     return jax.jit(lambda q, k, v: fn(q, k, v))
 
 
-def _comm_only(mesh, world):
-    """W-1 payload rotations of the (k, v) pair, no compute."""
+def _comm_only(mesh, world, topology="uni", factor=None):
+    """Comm-only floor of one forward topology, no compute.
+
+    uni     W-1 full-payload rotations of the (k, v) pair.
+    bidi    the counter-rotating split: each round moves HALF the payload
+            clockwise and half counter-clockwise concurrently, for
+            max(ceil, floor)((W-1)/2) rounds — both ICI directions carry
+            traffic at once, so on a comm-bound ring this floor is the
+            headroom the bidirectional schedule can claim.
+    double  factored (n_inter, n_intra): per cycle, n_intra-1 intra unit
+            hops plus (except the last cycle) one inter hop of n_intra
+            positions along the flat axis.
+    """
     spec4 = P(None, None, "sp", None)
 
+    def rot(t, hops):
+        from burst_attn_tpu.utils.compat import axis_size
+        import jax.lax as lax
+
+        n = axis_size("sp")
+        perm = [(i, (i + hops) % n) for i in range(n)]
+        return jax.tree_util.tree_map(
+            lambda x: lax.ppermute(x, "sp", perm), t)
+
     def f(k, v):
+        if topology == "bidi":
+            h_cw = (world - 1 + 1) // 2
+            h_ccw = (world - 1) // 2
+            half = k.shape[2] // 2
+            cw = (k[:, :, :half], v[:, :, :half])
+            ccw = (k[:, :, half:], v[:, :, half:])
+            for j in range(max(h_cw, h_ccw)):
+                if j < h_cw:
+                    cw = rot(cw, 1)
+                if j < h_ccw:
+                    ccw = rot(ccw, -1)
+            return sum(jnp.sum(t.astype(jnp.float32))
+                       for pair in (cw, ccw) for t in pair)
+        if topology == "double":
+            n_i, n_s = factor
+            kv = (k, v)
+            acc = jnp.float32(0.0)
+            for c in range(n_i):
+                for _ in range(n_s - 1):
+                    kv = rot(kv, 1)
+                if c < n_i - 1:
+                    kv = rot(kv, n_s)  # the prefetched inter hop
+                acc = acc + jnp.sum(kv[0].astype(jnp.float32))
+            return acc + jnp.sum(kv[1].astype(jnp.float32))
         kv = (k, v)
         for _ in range(world - 1):
             kv = ppermute_next(kv, "sp")
@@ -204,9 +255,24 @@ def _shard_fwdbwd(mesh, cfg):
     return jax.jit(lambda *a: fn(*a))
 
 
-def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd"):
+def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
+               topology="uni"):
     on_tpu = jax.default_backend() == "tpu"
     mesh = _mesh(world)
+    # topology -> fused-dispatch config + the factored double-ring shape
+    factor = None
+    topo_kw = {}
+    if topology == "bidi":
+        topo_kw = {"fused_topology": "bidi"}
+    elif topology == "double":
+        n_i = 2
+        while world % n_i or (world // n_i) < 2:
+            n_i += 1
+            if n_i > world // 2:
+                raise SystemExit(f"--topology double needs a composite "
+                                 f"mesh, got {world}")
+        factor = (n_i, world // n_i)
+        topo_kw = {"fused_seq_factor": factor}
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     key = jax.random.PRNGKey(0)
     kq, kk, kv, kg = jax.random.split(key, 4)
@@ -221,16 +287,30 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd"):
     scan_cfg = burst.BurstConfig(causal=causal, layout=layout,
                                  intra_axis="sp", backend=tile_backend)
     fused_cfg = burst.BurstConfig(causal=causal, layout=layout,
-                                  intra_axis="sp", backend="fused_ring")
+                                  intra_axis="sp", backend="fused_ring",
+                                  **topo_kw)
 
     bench_kw = dict(warmup=2, iters=3, reps=2) if not on_tpu else {}
     os.environ["BURST_FUSED_INTERPRET"] = "1"  # fused legs off-TPU
+    dir_floors = {}
     if pass_ == "fwd":
         t_scan = bench_fn(_shard_fwd(mesh, scan_cfg), q, k, v, **bench_kw)
         t_fused = bench_fn(_shard_fwd(mesh, fused_cfg), q, k, v, **bench_kw)
         t_compute = bench_fn(_shard_fwd(mesh, scan_cfg, no_rotate=True),
                              q, k, v, **bench_kw)
-        t_comm = bench_fn(_comm_only(mesh, world), k, v, **bench_kw)
+        t_comm = bench_fn(_comm_only(mesh, world, topology, factor),
+                          k, v, **bench_kw)
+        if topology == "bidi":
+            # per-direction floors: what each ICI direction costs alone —
+            # the gap between t_comm_uni and t_comm is the latency the
+            # counter-rotating split reclaims on comm-bound configs
+            dir_floors["t_comm_uni_s"] = round(
+                bench_fn(_comm_only(mesh, world), k, v, **bench_kw), 6)
+            dir_floors["dir_hops"] = {"cw": (world - 1 + 1) // 2,
+                                      "ccw": (world - 1) // 2}
+        elif topology == "double":
+            dir_floors["dir_hops"] = {"intra": factor[0] * (factor[1] - 1),
+                                      "inter": factor[0] - 1}
     elif pass_ == "bwd":
         # residuals once, outside the timed region — both legs consume the
         # identical (o, lse)
@@ -271,8 +351,10 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd"):
         "bench": "ring_overlap",
         "backend": jax.default_backend(),
         "pass": pass_,
+        "topology": topology,
         "seq": seq, "world": world, "layout": layout, "heads": n, "dim": d,
         "causal": causal,
+        **dir_floors,
         "t_scan_s": round(t_scan, 6),
         "t_fused_s": round(t_fused, 6),
         "fused_speedup": round(t_scan / t_fused, 4),
@@ -300,7 +382,8 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd"):
     # dispatch counters the measured programs just advanced
     from burst_attn_tpu import obs
 
-    labels = {"seq": seq, "world": world, "layout": layout, "pass": pass_}
+    labels = {"seq": seq, "world": world, "layout": layout, "pass": pass_,
+              "topology": topology}
     for key in ("overlap_scan", "overlap_fused", "fused_speedup",
                 "tflops_scan", "tflops_fused"):
         if key in rec:
@@ -322,16 +405,26 @@ def main():
                     choices=["fwd", "bwd", "fwd+bwd", "all"],
                     help="which pass(es) to measure; 'all' runs the three "
                          "modes back to back per seq")
+    ap.add_argument("--topology", default="uni",
+                    choices=["uni", "bidi", "double", "all"],
+                    help="fused-ring schedule topology (parallel/schedule."
+                         "py); bidi records per-direction comm floors, "
+                         "double factors the flat mesh inter-major; 'all' "
+                         "sweeps the three")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "results", "ring_overlap.jsonl"))
     args = ap.parse_args()
     passes = (["fwd", "bwd", "fwd+bwd"] if args.pass_ == "all"
               else [args.pass_])
+    topologies = (["uni", "bidi", "double"] if args.topology == "all"
+                  else [args.topology])
     for seq in [int(s) for s in args.seqs.split(",")]:
-        for p in passes:
-            run_config(seq, args.mesh, args.layout, args.heads, args.dim,
-                       not args.noncausal, args.out, pass_=p)
+        for topo in topologies:
+            for p in passes:
+                run_config(seq, args.mesh, args.layout, args.heads,
+                           args.dim, not args.noncausal, args.out,
+                           pass_=p, topology=topo)
     # one obs export per invocation, beside the jsonl results
     from burst_attn_tpu import obs
 

@@ -485,3 +485,314 @@ def encode_runs(events: List[Event]) -> List[Tuple[str, str, int, int]]:
         else:
             out.append((*ev, 1))
     return out
+
+
+# ---------------------------------------------------------------------------
+# compiled ring programs (parallel/schedule.py)
+#
+# The schedule compiler emits arbitrary topologies (uni, bidi, double);
+# instead of re-deriving each one here, the oracle PROVES every emitted
+# program by direct simulation on host integers — delivery of the declared
+# rotation schedule, exactly-once consumption, per-bank overwrite-before-
+# read safety under the compiled credit schedule with a maximally-ahead
+# sender, the double ring's prefetch-distance obligation, and (backward)
+# the dq streams' exactly-once return-home with all `world` contributions.
+# The program arrives as a plain dict (RingProgram.export()) so the proof
+# runs on the raw op table, trusting nothing about how it was built.
+
+
+def _neighbor(prog, d, direction, hops=1):
+    """Flat id of the device `hops` forward of d along a channel dir."""
+    n_i, n_s = prog["n_inter"], prog["n_intra"]
+    ci, si = divmod(d, n_s)
+    if direction == "cw":
+        return ci * n_s + (si + hops) % n_s
+    if direction == "ccw":
+        return ci * n_s + (si - hops) % n_s
+    if direction == "inter":
+        return ((ci + hops) % n_i) * n_s + si
+    raise AssertionError(f"unknown channel dir {direction!r}")
+
+
+def _expected_part(prog, d, r):
+    n_i, n_s = prog["n_inter"], prog["n_intra"]
+    ci, si = divmod(d, n_s)
+    return (((ci - prog["rot_inter"][r]) % n_i) * n_s
+            + (si - prog["rot_intra"][r]) % n_s)
+
+
+def _prove_payload_delivery(prog) -> None:
+    """Lockstep simulation of the payload banks: every consume sees the
+    partition the rotation schedule declares, every send is a single
+    channel hop, and (full rings) every device consumes every partition
+    exactly once."""
+    rows = prog["rows"]
+    world = prog["n_inter"] * prog["n_intra"]
+    n_rounds = len(prog["rot_intra"])
+    banks = [dict() for _ in range(world)]  # (bank, slot) -> partition
+    seen = [set() for _ in range(world)]
+    for d in range(world):
+        for bank, slot in prog["copy_in"]:
+            banks[d][(bank, slot)] = d
+    channels = prog["channels"]
+    for r in range(n_rounds):
+        key = (rows["consume_bank"][r], rows["consume_slot"][r])
+        for d in range(world):
+            assert key in banks[d], (
+                f"device {d} round {r}: bank/slot {key} never written")
+            part = banks[d][key]
+            want = _expected_part(prog, d, r)
+            assert part == want, (
+                f"device {d} round {r}: holds partition {part}, the "
+                f"program's rotation says {want}")
+            assert part not in seen[d], (
+                f"device {d} consumes partition {part} twice (round {r})")
+            seen[d].add(part)
+        sends = []
+        for ch, direction in enumerate(channels):
+            if not rows[f"send{ch}"][r]:
+                continue
+            src_bank = rows["src_bank0"][r] if ch == 0 else 1
+            src_slot = rows[f"src_slot{ch}"][r]
+            dst_slot = rows[f"dst_slot{ch}"][r]
+            for d in range(world):
+                src_key = (src_bank, src_slot)
+                assert src_key in banks[d], (
+                    f"device {d} round {r}: channel {ch} sends from "
+                    f"unwritten {src_key}")
+                sends.append((_neighbor(prog, d, direction), ch,
+                              dst_slot, banks[d][src_key]))
+        for dst, ch, dst_slot, part in sends:  # all transfers in flight
+            banks[dst][(ch, dst_slot)] = part
+    if n_rounds == world:
+        for d in range(world):
+            assert seen[d] == set(range(world)), (
+                f"device {d} consumed {sorted(seen[d])}, not all of "
+                f"0..{world - 1}")
+
+
+def _prove_bank_safety(prog, bank: int) -> None:
+    """Maximally-ahead sender vs slowest receiver for one payload bank,
+    under the compiled credit schedule: the sender issues every write as
+    early as its credits allow; no read may ever see a version other than
+    the one the lockstep schedule intends."""
+    rows = prog["rows"]
+    n_rounds = len(prog["rot_intra"])
+    # channel ch writes its own bank (channel index == dst bank id)
+    writes = [(r, rows[f"dst_slot{bank}"][r]) for r in range(n_rounds)
+              if rows[f"send{bank}"][r]]
+    copy_slots = [slot for b, slot in prog["copy_in"] if b == bank]
+    reads = []  # (receiver round, slot)
+    for r in range(n_rounds):
+        if rows["consume_bank"][r] == bank:
+            reads.append((r, rows["consume_slot"][r]))
+        for ch, _dir in enumerate(prog["channels"]):
+            if rows[f"send{ch}"][r]:
+                src_bank = rows["src_bank0"][r] if ch == 0 else 1
+                if src_bank == bank:
+                    reads.append((r, rows[f"src_slot{ch}"][r]))
+    grants = [rows[f"grant{bank}"][r] for r in range(n_rounds)]
+    takes = [rows[f"take{bank}"][r] for r in range(n_rounds)]
+    _prove_async_safety(n_rounds, writes, reads, grants, takes, copy_slots,
+                        what=f"payload bank {bank}")
+
+
+def _prove_async_safety(n_rounds, writes, reads, grants, takes, copy_slots,
+                        what: str) -> None:
+    """Shared async proof: writes (sender round order) land the moment
+    credits allow; the receiver walks its rounds in order and every read
+    must see exactly the version the lockstep schedule intends (the last
+    write issued at a sender round strictly before the reading round,
+    counting round-0 copy-ins as version 0).  Credits are per slot
+    (grants[r] carries slot + 1, a take consumes the written slot's own
+    credit) — a fungible pool would let a grant meant for one slot
+    license an early overwrite of another."""
+    per_slot = {s: [-1] for s in copy_slots}  # write rounds; -1 = copy-in
+    for r, s in writes:
+        per_slot.setdefault(s, []).append(r)
+
+    def expected_version(r, s):
+        vi = -1
+        for j, wr in enumerate(per_slot.get(s, [])):
+            if wr < r:
+                vi = j
+        return vi
+
+    reads_by_round = {}
+    for r, s in reads:
+        reads_by_round.setdefault(r, []).append(s)
+
+    version = {s: 0 for s in copy_slots}  # current version INDEX per slot
+    windex = {s: (1 if s in copy_slots else 0) for s in per_slot}
+    consumed = 0  # receiver's completed rounds
+    credits = {}  # slot -> available credits
+
+    def receiver_step():
+        nonlocal consumed
+        t = consumed
+        for s in reads_by_round.get(t, []):
+            want = expected_version(t, s)
+            got = version.get(s)
+            assert got == want, (
+                f"{what}: receiver reads slot {s} at round {t} holding "
+                f"version {got}, schedule intends {want} — overwritten "
+                "before read")
+        if grants[t]:
+            s = grants[t] - 1
+            credits[s] = credits.get(s, 0) + 1
+        consumed += 1
+
+    for wr in sorted(set(r for r, _ in writes)):
+        slots_here = [s for r, s in writes if r == wr]
+        if takes[wr]:
+            assert len(slots_here) == 1 or len(set(slots_here)) == 1, (
+                f"{what}: take at round {wr} is ambiguous over slots "
+                f"{slots_here}")
+            s = slots_here[0]
+            while credits.get(s, 0) < takes[wr]:
+                assert consumed < n_rounds, (
+                    f"{what}: sender starves at round {wr} waiting a slot-"
+                    f"{s} credit — receiver drained (deadlock)")
+                receiver_step()
+            credits[s] -= takes[wr]
+        for s in slots_here:
+            version[s] = windex.get(s, 0)
+            windex[s] = windex.get(s, 0) + 1
+    while consumed < n_rounds:
+        receiver_step()
+
+
+def _prove_prefetch_distance(prog) -> None:
+    """Double-ring obligation: the inter-prefetch payload must be in
+    flight for at least one full intra cycle before its consume."""
+    if "inter" not in prog["channels"]:
+        return
+    ch = prog["channels"].index("inter")
+    rows = prog["rows"]
+    n_rounds = len(prog["rot_intra"])
+    n_intra = prog["n_intra"]
+    for r in range(n_rounds):
+        if not rows[f"send{ch}"][r]:
+            continue
+        dst_slot = rows[f"dst_slot{ch}"][r]
+        consumes = [t for t in range(r + 1, n_rounds)
+                    if rows["consume_bank"][t] == ch
+                    and rows["consume_slot"][t] == dst_slot]
+        assert consumes, (
+            f"inter prefetch sent at round {r} into slot {dst_slot} is "
+            "never consumed")
+        dist = consumes[0] - r
+        assert dist >= n_intra, (
+            f"inter prefetch distance {dist} rounds < one intra cycle "
+            f"({n_intra}) — the slow hop cannot hide (sent round {r}, "
+            f"consumed round {consumes[0]})")
+
+
+def _prove_dq_return_home(prog) -> None:
+    """Backward streams: simulate the per-direction add-and-forward dq
+    rings (one hop behind their bundles), the double ring's boundary folds
+    into the inter accumulator, and every return-home hop — every
+    partition's gradient must land on its owner exactly once carrying all
+    `world` contributions."""
+    rows = prog["rows"]
+    world = prog["n_inter"] * prog["n_intra"]
+    n_rounds = len(prog["rot_intra"])
+    n_banks = len(prog["dq_slots"])
+    cur = [[None] * n_banks for _ in range(world)]      # current partials
+    pend = [[None] * n_banks for _ in range(world)]     # in-flight ring hops
+    inter_held = [None] * world                         # double: dqi register
+    inter_pend = [None] * world
+    home = [set() for _ in range(world)]
+    homes_written = [0] * world
+    for r in range(n_rounds):
+        bank = rows["dq_bank"][r]
+        kind = rows["dq_send"][r]
+        moves = []
+        for d in range(world):
+            if rows["dq_recv"][r]:
+                assert pend[d][bank] is not None, (
+                    f"device {d} round {r}: dq partial expected but none "
+                    "in flight")
+                cur[d][bank] = pend[d][bank]
+                pend[d][bank] = None
+            else:
+                cur[d][bank] = set()
+            part = _expected_part(prog, d, r)
+            cur[d][bank] = cur[d][bank] | {(d, part)}
+            parts = {p for _, p in cur[d][bank]}
+            assert parts == {part}, (
+                f"device {d} round {r}: dq partial mixes partitions "
+                f"{sorted(parts)}")
+            if rows["dqi_recv"][r]:
+                assert inter_pend[d] is not None, (
+                    f"device {d} round {r}: inter dq partial expected")
+                inter_held[d] = inter_pend[d]
+                inter_pend[d] = None
+            if kind == 1:  # ring hop, one hop behind the bundle
+                direction = prog["channels"][bank] if bank < len(
+                    prog["channels"]) else ("ccw" if bank else "cw")
+                moves.append(("ring", d, _neighbor(prog, d, direction),
+                              bank, cur[d][bank]))
+            elif kind == 2:  # direct return-home hop
+                h_i, h_s = prog["home_offsets"][bank]
+                tgt = _neighbor(prog, _neighbor(prog, d, "inter", h_i),
+                                "cw", h_s)
+                moves.append(("home", d, tgt, bank, cur[d][bank]))
+            elif kind == 3:  # boundary: fold inter_held, hop inter
+                val = cur[d][bank] | (inter_held[d] or set())
+                inter_held[d] = None
+                moves.append(("inter", d, _neighbor(prog, d, "inter"),
+                              bank, val))
+            elif kind == 4:  # final: fold + composed home hop
+                val = cur[d][bank] | (inter_held[d] or set())
+                inter_held[d] = None
+                h_i, h_s = prog["home_offsets"][0]
+                tgt = _neighbor(prog, _neighbor(prog, d, "inter", h_i),
+                                "cw", h_s)
+                moves.append(("home", d, tgt, bank, val))
+        for what, src, dst, bank_, val in moves:
+            if what == "ring":
+                pend[dst][bank_] = val
+            elif what == "inter":
+                assert inter_pend[dst] is None, (
+                    f"device {dst}: inter dq partial overwritten in flight")
+                inter_pend[dst] = val
+            else:
+                homes_written[dst] += 1
+                home[dst] |= val
+    expected_homes = sum(
+        1 for r in range(n_rounds) if rows["dq_send"][r] in (2, 4))
+    for d in range(world):
+        assert homes_written[d] == expected_homes, (
+            f"device {d}: {homes_written[d]} home arrivals, expected "
+            f"{expected_homes}")
+        want = {(src, d) for src in range(world)}
+        assert home[d] == want, (
+            f"device {d}: home dq carries {sorted(home[d])}, expected all "
+            f"{world} contributions of partition {d}")
+
+
+def verify_ring_program(prog: dict) -> None:
+    """Prove one compiled ring program (RingProgram.export() dict) by
+    simulation; raises AssertionError with a specific message on the first
+    violated obligation.  Called by burstlint's fused-ring-schedule rule
+    for every topology the compiler can emit, and by the mutation tests
+    with deliberately-corrupted programs (flipped direction, shortened
+    prefetch distance, aliased slot) to prove the proof has teeth."""
+    assert prog["n_inter"] >= 1 and prog["n_intra"] >= 1
+    world = prog["n_inter"] * prog["n_intra"]
+    rows = prog["rows"]
+    n_rounds = len(prog["rot_intra"])
+    assert n_rounds <= world, (n_rounds, world)
+    for r in range(n_rounds):
+        b = rows["consume_bank"][r]
+        assert 0 <= b < len(prog["slots"]), f"round {r}: bad bank {b}"
+        assert 0 <= rows["consume_slot"][r] < prog["slots"][b], (
+            f"round {r}: consume slot {rows['consume_slot'][r]} out of "
+            f"range for bank {b} ({prog['slots'][b]} slots)")
+    _prove_payload_delivery(prog)
+    for bank in range(len(prog["slots"])):
+        _prove_bank_safety(prog, bank)
+    _prove_prefetch_distance(prog)
+    if prog["kind"] == "bwd":
+        _prove_dq_return_home(prog)

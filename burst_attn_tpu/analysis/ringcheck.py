@@ -50,13 +50,16 @@ rule("dq-return-home", "jaxpr",
 rule("window-truncation", "jaxpr",
      "windowed ring truncation matches the dense band-mask live set")(None)
 rule("fused-ring-schedule", "jaxpr",
-     "fused fwd AND bwd slot schedules match the oracle; delivery, "
-     "hop-count, dq exactly-once return-home and overwrite-before-read "
-     "safety proven by simulation")(None)
+     "every schedule the compiler emits (uni, bidi, double; fwd AND bwd) "
+     "is simulation-proven: delivery of the declared rotation, hop "
+     "counts, per-slot overwrite-before-read safety per direction, "
+     "prefetch distance >= one intra cycle, dq exactly-once return-home; "
+     "the legacy uni slot views still match the independent derivation")(None)
 rule("fused-ring-fused", "jaxpr",
-     "fused fwd/bwd issue zero XLA collectives and exactly the expected "
-     "remote-copy census (fwd: k+v pair; bwd: 4-operand bundle + dq ring "
-     "+ dq return-home), with fp32-accum numerics")(None)
+     "fused fwd/bwd issue zero XLA collectives and exactly the compiled "
+     "program's remote-copy census (schedule.expected_remote_dma: per-"
+     "direction payload channels, dq rings, return-home hops), with "
+     "fp32-accum numerics — for uni, bidi, double and multi-axis meshes")(None)
 
 
 @dataclass
@@ -311,17 +314,54 @@ def _remote_dma_starts(closed_jaxpr):
             and "LOGICAL" in str(e.params["device_id_type"]).upper()]
 
 
-def verify_fused_bwd_trace(closed_jaxpr, *, where: str, anchor
-                           ) -> List[Finding]:
+def verify_fused_fwd_trace(closed_jaxpr, *, where: str, anchor,
+                           expected_dma: int = 2) -> List[Finding]:
+    """fused-ring-fused checks on one traced fused FORWARD shard program.
+
+    The trace must contain ZERO XLA collectives (the ring lives entirely
+    inside the kernel) and exactly `expected_dma` remote dma_start call
+    sites — schedule.expected_remote_dma of the compiled program (the
+    classic uni ring's k+v pair is 2; a bidi ring doubles it, the double
+    ring adds the inter-prefetch channel); more would double-send, fewer
+    would starve a stream — and the kernel's dots must pass the
+    fp32-accum/lse-fp32 contract."""
+    from . import numerics
+
+    findings: List[Finding] = []
+    path, line = anchor
+    colls = [e for e in collect_collectives(closed_jaxpr)
+             if e.prim in ("ppermute", "all_to_all")]
+    if colls:
+        findings.append(Finding(
+            rule="fused-ring-fused", file=path, line=line,
+            message=f"{where}: fused forward issues XLA collectives "
+                    f"{[(e.prim, e.axis) for e in colls]} — the ring "
+                    "must live entirely inside the kernel"))
+    remote = _remote_dma_starts(closed_jaxpr)
+    if len(remote) != expected_dma:
+        findings.append(Finding(
+            rule="fused-ring-fused", file=path, line=line,
+            message=f"{where}: expected exactly {expected_dma} remote "
+                    f"dma_starts (the compiled program's census), traced "
+                    f"{len(remote)}"))
+    findings += numerics.check_trace(closed_jaxpr, where=where, anchor=anchor)
+    return findings
+
+
+def verify_fused_bwd_trace(closed_jaxpr, *, where: str, anchor,
+                           expected_dma: int = 6) -> List[Finding]:
     """fused-ring-fused checks on one traced fused BACKWARD shard program.
 
     Shared by verify_fused_ring (tracing the real dispatch) and the
     mutation tests (tracing seeded-bad programs): the trace must contain
     ZERO XLA collectives (the two rotating streams live entirely inside
-    the kernel) and exactly 6 remote dma_starts — 4 for the q-side bundle
-    (delta|o, do, q, lse), 1 for the streamed dq ring hop, 1 for the dq
-    return-home hop; more would double-send, fewer would starve a stream —
-    and the kernel's dots must pass the fp32-accum/lse-fp32 contract."""
+    the kernel) and exactly `expected_dma` remote dma_starts — for the
+    classic uni ring 6: 4 for the q-side bundle (delta|o, do, q, lse),
+    1 for the streamed dq ring hop, 1 for the dq return-home hop; other
+    topologies derive theirs from schedule.expected_remote_dma of the
+    compiled program.  More would double-send, fewer would starve a
+    stream — and the kernel's dots must pass the fp32-accum/lse-fp32
+    contract."""
     from . import numerics
 
     findings: List[Finding] = []
@@ -335,13 +375,71 @@ def verify_fused_bwd_trace(closed_jaxpr, *, where: str, anchor
                     f"{[(e.prim, e.axis) for e in colls]} — both the "
                     "bundle and the dq ring must live inside the kernel"))
     remote = _remote_dma_starts(closed_jaxpr)
-    if len(remote) != 6:
+    if len(remote) != expected_dma:
         findings.append(Finding(
             rule="fused-ring-fused", file=path, line=line,
-            message=f"{where}: expected exactly 6 remote dma_starts (4 "
-                    "bundle operands + dq ring hop + dq return-home), "
-                    f"traced {len(remote)}"))
+            message=f"{where}: expected exactly {expected_dma} remote "
+                    f"dma_starts (bundle operands + dq ring/boundary + "
+                    f"return-home), traced {len(remote)}"))
     findings += numerics.check_trace(closed_jaxpr, where=where, anchor=anchor)
+    return findings
+
+
+# (topology, n_inter, n_intra, compile kwargs) matrix of compiler-emitted
+# programs burstlint simulation-proves on every run — fwd AND bwd for each.
+# The proof obligation rides the compiler: any new topology must land here.
+IR_PROOF_CONFIGS = (
+    ("uni", 1, 2, {}),
+    ("uni", 1, 4, {}),
+    ("uni", 1, 8, {}),
+    ("uni", 1, 8, {"slots": 3}),
+    ("uni", 1, 8, {"slots": 8}),
+    ("bidi", 1, 3, {}),
+    ("bidi", 1, 4, {}),
+    ("bidi", 1, 5, {}),
+    ("bidi", 1, 8, {}),
+    ("bidi", 1, 8, {"slots": 3, "slots1": 2}),
+    ("double", 2, 2, {}),
+    ("double", 2, 4, {}),
+    ("double", 4, 2, {}),
+    ("double", 2, 4, {"slots": 3, "slots1": 3}),
+    ("double", 3, 3, {}),
+)
+
+
+def verify_ring_programs() -> List[Finding]:
+    """fused-ring-schedule, IR family: every program the schedule compiler
+    emits across the topology matrix is proven by direct simulation
+    (analysis/oracle.verify_ring_program) — payload delivery of the
+    declared rotation, per-slot overwrite-before-read safety per direction
+    under a maximally-ahead sender, the double ring's >= one-intra-cycle
+    prefetch distance, and (bwd) the dq streams' exactly-once return-home
+    with all `world` contributions."""
+    from ..parallel import schedule as sched
+
+    findings: List[Finding] = []
+    anchor_ir = _anchor(sched.compile_fwd)
+    for topology, n_inter, n_intra, kw in IR_PROOF_CONFIGS:
+        for kind, compiler in (("fwd", sched.compile_fwd),
+                               ("bwd", sched.compile_bwd)):
+            tag = (f"{kind} {topology} {n_inter}x{n_intra}"
+                   f"{' ' + str(kw) if kw else ''}")
+            try:
+                prog = compiler(topology, n_intra, n_inter, **kw)
+            except sched.ScheduleError as e:
+                findings.append(Finding(
+                    rule="fused-ring-schedule", file=anchor_ir[0],
+                    line=anchor_ir[1],
+                    message=f"{tag}: compiler refused a supported "
+                            f"topology: {e}"))
+                continue
+            try:
+                oracle.verify_ring_program(prog.export())
+            except AssertionError as e:
+                findings.append(Finding(
+                    rule="fused-ring-schedule", file=anchor_ir[0],
+                    line=anchor_ir[1],
+                    message=f"{tag}: simulation proof failed: {e}"))
     return findings
 
 
@@ -372,8 +470,6 @@ def verify_fused_ring() -> List[Finding]:
     from ..ops import fused_ring as fr
     from ..parallel import burst, ring
     from ..utils.compat import shard_map
-    from . import numerics
-    from .jaxpr_tools import iter_eqns
 
     findings: List[Finding] = []
     anchor_plan = _anchor(ring.fused_slot_schedule)
@@ -446,22 +542,8 @@ def verify_fused_ring() -> List[Finding]:
                             out_specs=(spec4, spec3), check_vma=False)
             jx = jax.make_jaxpr(fwd)(q, q, q)
             where = f"fused-{layout}{'-causal' if causal else ''}"
-            colls = [e for e in collect_collectives(jx)
-                     if e.prim in ("ppermute", "all_to_all")]
-            if colls:
-                findings.append(Finding(
-                    rule="fused-ring-fused", file=anchor[0], line=anchor[1],
-                    message=f"{where}: fused forward issues XLA collectives "
-                            f"{[(e.prim, e.axis) for e in colls]} — the ring "
-                            "must live entirely inside the kernel"))
-            remote = _remote_dma_starts(jx)
-            if len(remote) != 2:
-                findings.append(Finding(
-                    rule="fused-ring-fused", file=anchor[0], line=anchor[1],
-                    message=f"{where}: expected exactly 2 remote dma_starts "
-                            f"(k and v, one hop each per round), traced "
-                            f"{len(remote)}"))
-            findings += numerics.check_trace(jx, where=where, anchor=anchor)
+            findings += verify_fused_fwd_trace(jx, where=where,
+                                               anchor=anchor)
 
         # ---- traced structure of the fused backward ----
         from ..ops import fused_ring_bwd as frb
@@ -516,6 +598,110 @@ def verify_fused_ring() -> List[Finding]:
     return findings
 
 
+def verify_fused_topologies() -> List[Finding]:
+    """fused-ring-fused, schedule-IR topologies: the configs the hand-built
+    schedules could never express trace fused with ZERO XLA collectives and
+    exactly the compiled program's remote-DMA census
+    (schedule.expected_remote_dma) — fwd AND bwd each:
+
+      bidi         counter-rotating flat ring (both ICI directions)
+      double-flat  hierarchical double ring factored onto one ring axis
+      double-2ax   the real two-axis ("inter", "intra") double ring
+      multi-axis   pp x tp x sp training mesh, ring on "sp" with
+                   cfg.mesh_axes proving the extra axes never alias
+                   ring traffic
+
+    bidi and double-flat are single-named-axis programs, so they trace
+    under the interpret opt-in like the uni checks; the two-axis double
+    ring and the multi-axis mesh cannot be discharged by the interpreter
+    at all — BURST_FUSED_ASSUME_TPU forces the HARDWARE trace (full
+    semaphore choreography, never executed), which is exactly the program
+    a TPU would run, so the acceptance-criterion traces are checked
+    off-TPU on every burstlint run."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..ops import fused_ring as fr
+    from ..parallel import burst, schedule as sched
+    from ..utils.compat import shard_map
+
+    findings: List[Finding] = []
+    anchor_fwd = _anchor(fr.fused_ring_fwd)
+    devs = jax.devices()
+    if len(devs) < 8:
+        raise RuntimeError(
+            "analysis needs 8 simulated devices "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=8); "
+            f"have {len(devs)}")
+    b, n, d, s_local = 1, 2, 8, 16
+    S = jax.ShapeDtypeStruct
+
+    # (name, env flag, mesh axes+sizes, ring axes, cfg extras, q specs)
+    CASES = (
+        ("bidi-4", "BURST_FUSED_INTERPRET", (("sp", 4),), ("sp", None),
+         {"fused_topology": "bidi"}),
+        ("double-flat-2x2", "BURST_FUSED_INTERPRET", (("sp", 4),),
+         ("sp", None), {"fused_seq_factor": (2, 2)}),
+        ("double-2ax-2x4", "BURST_FUSED_ASSUME_TPU",
+         (("inter", 2), ("intra", 4)), ("intra", "inter"), {}),
+        ("multiaxis-pp2-tp2-sp2", "BURST_FUSED_ASSUME_TPU",
+         (("pp", 2), ("tp", 2), ("sp", 2)), ("sp", None),
+         {"mesh_axes": (("pp", 2), ("tp", 2), ("sp", 2))}),
+    )
+    for name, env, axes, (intra_axis, inter_axis), extras in CASES:
+        names = tuple(a for a, _ in axes)
+        sizes = tuple(sz for _, sz in axes)
+        mesh = Mesh(np.asarray(devs[:int(np.prod(sizes))]).reshape(sizes),
+                    names)
+        cfg = burst.BurstConfig(
+            causal=True, layout="zigzag", intra_axis=intra_axis,
+            inter_axis=inter_axis, backend="fused_ring", **extras)
+        ring_names = tuple(a for a in (inter_axis, intra_axis) if a)
+        world = int(np.prod([dict(axes)[a] for a in ring_names]))
+        seq = world * s_local
+        q = S((b, n, seq, d), jnp.bfloat16)
+        lse = S((b, n, seq), jnp.float32)
+        seq_spec = ring_names if len(ring_names) > 1 else ring_names[0]
+        spec4 = P(None, None, seq_spec, None)
+        spec3 = P(None, None, seq_spec)
+        n_inter = dict(axes).get(inter_axis, 1) if inter_axis else 1
+        topo, t_i, t_s = fr.resolve_topology(cfg, world // n_inter, n_inter)
+        prev = os.environ.get(env)
+        os.environ[env] = "1"
+        try:
+            prog_f = fr._compile_for(cfg, topo, t_i, t_s, "fwd")
+            fwd = shard_map(lambda q, k, v: burst._fwd_impl(q, k, v, cfg),
+                            mesh=mesh, in_specs=(spec4,) * 3,
+                            out_specs=(spec4, spec3), check_vma=False)
+            findings += verify_fused_fwd_trace(
+                jax.make_jaxpr(fwd)(q, q, q), where=f"fused-{name}-fwd",
+                anchor=anchor_fwd,
+                expected_dma=sched.expected_remote_dma(prog_f, 2))
+
+            from ..ops import fused_ring_bwd as frb
+
+            prog_b = fr._compile_for(cfg, topo, t_i, t_s, "bwd")
+            bwd = shard_map(
+                lambda q, k, v, o, l, do: burst._bwd_impl(
+                    cfg, q, k, v, o, l, do),
+                mesh=mesh, in_specs=(spec4,) * 4 + (spec3, spec4),
+                out_specs=(spec4,) * 3, check_vma=False)
+            findings += verify_fused_bwd_trace(
+                jax.make_jaxpr(bwd)(q, q, q, q, lse, q),
+                where=f"fused-{name}-bwd", anchor=_anchor(frb.fused_ring_bwd),
+                expected_dma=sched.expected_remote_dma(prog_b, 4))
+        finally:
+            if prev is None:
+                os.environ.pop(env, None)
+            else:
+                os.environ[env] = prev
+    return findings
+
+
 def verify_ulysses() -> List[Finding]:
     """Ulysses a2a contract: exactly 4 all_to_alls (q, k, v in; o out) on
     the sequence axis, no ppermutes, none conditional."""
@@ -566,6 +752,8 @@ def check_all() -> List[Finding]:
     findings: List[Finding] = []
     for entry in ENTRIES:
         findings += verify_ring_entry(entry)
+    findings += verify_ring_programs()
     findings += verify_fused_ring()
+    findings += verify_fused_topologies()
     findings += verify_ulysses()
     return findings

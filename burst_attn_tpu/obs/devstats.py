@@ -88,6 +88,14 @@ class DevStats(NamedTuple):
     fused_rounds: jnp.ndarray    # i32
     slot_use: jnp.ndarray        # i32[MAX_SLOTS]
     slot_use_bwd: jnp.ndarray    # i32[MAX_SLOTS]
+    # second-direction banks of the schedule-IR kernels: the ccw ring of a
+    # counter-rotating (bidi) topology, or the double ring's inter
+    # prefetch bank.  Zeros for uni schedules and on the scan path; the
+    # published counter labels these rows dir="ccw" next to the primary
+    # banks' dir="cw" so the bidirectional traffic split is verifiable on
+    # device (docs/observability.md).
+    slot_use_ccw: jnp.ndarray      # i32[MAX_SLOTS]
+    slot_use_bwd_ccw: jnp.ndarray  # i32[MAX_SLOTS]
 
     def publish(self, registry=None, *, labels: Optional[dict] = None):
         """Fold concrete (post-step) stats into a host metrics registry.
@@ -146,15 +154,18 @@ class DevStats(NamedTuple):
         reg.counter("devstats.fused_rounds",
                     "ring rounds executed inside the fused RDMA kernel").inc(
             float(leaves["fused_rounds"].sum()), **base)
-        for field, pass_ in (("slot_use", "fwd"), ("slot_use_bwd", "bwd")):
+        for field, pass_, dir_ in (("slot_use", "fwd", "cw"),
+                                   ("slot_use_bwd", "bwd", "cw"),
+                                   ("slot_use_ccw", "fwd", "ccw"),
+                                   ("slot_use_bwd_ccw", "bwd", "ccw")):
             slot_tot = leaves[field].sum(axis=0)
             for j in range(slot_tot.shape[0]):
                 if slot_tot[j]:
                     reg.counter(
                         "devstats.slot_use",
                         "fused-ring chunk/bundle consumes per comm slot, "
-                        "by pass").inc(
-                        float(slot_tot[j]), slot=j, **base,
+                        "by pass and ring direction").inc(
+                        float(slot_tot[j]), slot=j, dir=dir_, **base,
                         **{"pass": pass_})
         reg.counter("devstats.publishes",
                     "DevStats pytrees folded into the registry").inc()
@@ -172,7 +183,8 @@ def _slot_vec(slot_use):
 
 def ring_stats(rounds, rounds_live, attn_pairs, total_pairs, head_dim,
                m, lse, acc, fused_rounds=0, slot_use=None,
-               slot_use_bwd=None) -> DevStats:
+               slot_use_bwd=None, slot_use_ccw=None,
+               slot_use_bwd_ccw=None) -> DevStats:
     """Assemble a per-shard DevStats from ring results (traced context).
 
     `m` may be None (fused kernel: the row max never leaves the kernel);
@@ -203,6 +215,8 @@ def ring_stats(rounds, rounds_live, attn_pairs, total_pairs, head_dim,
         fused_rounds=jnp.asarray(fused_rounds, i32),
         slot_use=_slot_vec(slot_use),
         slot_use_bwd=_slot_vec(slot_use_bwd),
+        slot_use_ccw=_slot_vec(slot_use_ccw),
+        slot_use_bwd_ccw=_slot_vec(slot_use_bwd_ccw),
     )
     # telemetry is non-differentiable by definition: zero the tangents here
     # so downstream cross_reduce/merge arithmetic never asks autodiff for

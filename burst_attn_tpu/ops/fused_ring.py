@@ -1,4 +1,4 @@
-"""Fused on-device ring attention: the whole W-round forward ring in ONE
+"""Fused on-device ring attention: the whole R-round forward ring in ONE
 Pallas kernel, with neighbor KV rotation done by in-kernel inter-chip RDMA
 (`pltpu.make_async_remote_copy`) instead of per-round `lax.ppermute`
 collectives between per-round kernel launches.
@@ -8,56 +8,65 @@ comm/compute overlap as "XLA hopefully schedules the async collective-permute
 behind the next round's pallas_call" — every round pays a kernel relaunch plus
 an XLA collective boundary, and the overlap is a compiler scheduling outcome,
 not a property of the program.  Here the overlap is owned by the kernel by
-construction:
+construction.
 
-  * KV communication buffers are a rotating set of `slots` (>= 2, from the
-    per-generation table in ops/tuning.py) HBM slots per operand; the slot a
-    round reads and the slot a send writes come from ONE exported schedule
-    (parallel/ring.fused_slot_schedule), delivered to the kernel via scalar
-    prefetch — burstlint re-derives and proves that schedule independently
-    (analysis/oracle.verify_fused_ring) and matches it against this module.
-  * At the first grid step of round r the kernel waits the slot's recv
-    semaphore (round r's chunk has LANDED), then immediately starts the RDMA
-    of that chunk to the right neighbor's slot[r+1].  The transfer is in
-    flight for the entire round-r compute sweep — one full round of
-    FlashAttention tiles across every (batch, head, q-block) — before round
-    r+1 waits on it.  No collective barrier ever splits the instruction
-    stream.
-  * Double-buffer safety is a semaphore protocol, not a compiler contract:
-    DMA send/recv semaphores per slot, plus (hardware only) a capacity
-    handshake — a device signals its LEFT neighbor's free semaphore when a
-    slot's last reader is done, and a sender must take one free credit before
-    overwriting a previously-used remote slot.  All semaphores provably
-    drain to zero (counts are matched per round; see the choreography notes
-    in _fused_fwd_kernel).
+Schedule IR.  Since the schedule-compiler refactor this kernel contains NO
+topology logic of its own: it interprets a compiled `RingProgram`
+(parallel/schedule.py), delivered as an int32 scalar-prefetch table whose
+per-round rows say which (bank, slot) compute consumes, whether its recv
+semaphores must be awaited, which send channels fire (src bank/slot, dst
+slot), and the per-slot capacity-credit ops.  One kernel body therefore runs
+every topology the compiler can emit:
+
+  uni     the classic single ring (one slot bank, chunks travel W-1 cw hops)
+  bidi    counter-rotating bidirectional ring: chunks for offsets
+          1..ceil((W-1)/2) arrive clockwise, 1..floor((W-1)/2) counter-
+          clockwise, interleaved — per-DIRECTION slot banks and DMA
+          semaphores, both ICI directions carrying traffic concurrently,
+          and every transfer gets TWO rounds of compute to hide under.
+  double  the hierarchical double ring: the next cycle's base chunk leaves
+          on the inter channel ONE FULL INTRA-CYCLE before its consume,
+          into a dedicated prefetch bank — BurstAttention's signature
+          trick, previously scan-only.  Runs on a two-axis
+          ("inter", "intra") mesh or factored onto a flat ring axis.
+
+Every program is simulation-proven by burstlint before trust (analysis/
+oracle.verify_ring_program: delivery of the declared rotation, exactly-once
+consumption, per-slot overwrite-before-read safety against a maximally-
+ahead sender, prefetch distance >= one intra cycle).
+
+Slot choreography per round (first grid step): wait the consume slot's recv
+semaphores if the table says a chunk landed remotely, then start every
+flagged channel send — the transfer is in flight for the entire round-r
+compute sweep.  Capacity credits are PER SLOT (`free` is a semaphore array
+per bank): a send whose dst slot is being reused takes that slot's credit;
+the slot's last reader granted it to the bank's writer at its own round
+end.  Multi-axis meshes are safe because every RDMA target is a full
+LOGICAL device id computed from ALL mesh axis indices with only the ring
+coordinate varied (parallel/ring.device_roles) — extra pp/tp/dp axes can
+never alias ring traffic.
 
 Compute path.  Per grid step (r, b, h, i) the kernel folds q-block i against
 the WHOLE resident KV chunk: the chunk is copied HBM-slot -> VMEM once per
-(round, batch, kv-head) and every q-block sweeps it from VMEM — KV streaming
-traffic is per-chunk, not per-(q-block, kv-block) as in the scan path's
-per-round grids.  The online-softmax state is split by size: m/l row stats
-live VMEM-resident for the entire kernel (packed [B, N, S/lp, lp] exactly
-like pallas_flash's packed-stats layout), while the [bq, D] f32 accumulator
-round-trips an HBM scratch between rounds via manual async copies (the same
-traffic the scan path pays implicitly via its m/lse/acc in/out operands).
-Rounds merge by the standard two-state softmax combine (split-k style), so
-the acc load overlaps the whole local sweep.
+(round, batch, kv-head) and every q-block sweeps it from VMEM.  m/l row
+stats live VMEM-resident for the entire kernel (packed [B, N, S/lp, lp]),
+the [bq, D] f32 accumulator round-trips an HBM scratch between rounds with
+the load overlapped, rounds merge split-k style.  Masks reuse the SAME
+per-round `ops/masks.round_spec` scalars the scan ring computes — the
+partition each round holds comes from the program's rotation schedule.
 
 Interpret mode.  jax's dma_start discharge rule emulates remote copies over
-a single named mesh axis, so THIS kernel — same slots, same schedule, same
-masks — runs on a simulated CPU mesh (tests/test_fused_ring.py).  Remote
-semaphore signals are not emulated, so the hardware-only capacity handshake
-and the startup barrier are statically gated on `interpret` (in the
-discharged program every copy lands synchronously at issue, so the hazards
-those guards exist for cannot occur).
+a single named mesh axis, so THIS kernel — same banks, same compiled
+schedule, same masks — runs on a simulated CPU mesh (tests/
+test_fused_ring.py, tests/test_fused_topologies.py; double-ring schedules
+run factored onto the flat axis there).  Remote semaphore signals are not
+emulated, so the capacity handshake and the startup barrier are statically
+gated on `interpret`; a TWO-axis mesh cannot be discharged at all, which is
+why `supported` declines multi-axis/two-axis-double configs in interpret
+mode only — on hardware they run fused.
 
-Supported: single ring (no inter axis), equal q/kv shard lengths, no sliding
-window, no packed segments, world >= 2, ring axis the only size>1 named axis
-in scope.  Everything else falls back to the scan ring in parallel/burst.py
-(see `supported`).  The BACKWARD has its own fused kernel
-(ops/fused_ring_bwd.py: the q-side bundle plus a concurrent dq ring rotate
-while K, V stay resident), gated by the same predicate with pass_="bwd" —
-configs either kernel declines take the scan ring for that pass only.
+The BACKWARD has its own kernel (ops/fused_ring_bwd.py) interpreting the
+compiled backward program, gated by the same predicate with pass_="bwd".
 """
 
 import functools
@@ -82,12 +91,8 @@ from .pallas_flash import (
     _unpack,
 )
 from .tuning import resolve_fused
-from ..parallel.ring import (
-    fused_slot_schedule,
-    my_partition,
-    neighbor_ids,
-    partition_at_round,
-)
+from ..parallel import schedule as sched_ir
+from ..parallel.ring import device_roles, ring_coords
 from ..utils.compat import axis_size, tpu_compiler_params
 
 # barrier-semaphore namespace for the startup neighbor barrier; any stable
@@ -104,50 +109,109 @@ def interpret_enabled() -> bool:
         "", "0", "false")
 
 
-def _extra_named_axes(intra_axis: str):
+def hw_trace_forced() -> bool:
+    """BURST_FUSED_ASSUME_TPU=1 makes the dispatch/kernels TRACE the
+    hardware program off-TPU (full semaphore choreography, no interpret
+    gate) — for burstlint's structural checks of topologies the interpret
+    discharge cannot execute (two-axis double rings, multi-axis meshes).
+    Tracing never runs the program; executing such a trace off-TPU fails."""
+    return os.environ.get("BURST_FUSED_ASSUME_TPU", "").strip().lower() not in (
+        "", "0", "false")
+
+
+def _extra_named_axes(intra_axis: str, inter_axis=None):
     """Other size>1 named axes bound in the current trace (shard_map scope).
 
-    The kernel addresses its neighbor by LOGICAL device id computed from the
-    ring axis index alone, which is only the right address when the ring
-    axis is the sole partitioned axis; jax's interpret-mode DMA discharge
-    has the same single-axis restriction.  Returns None when the axis-env
-    API is unavailable (treated as unknown -> unsupported, fail safe)."""
+    Ring traffic addresses neighbors by LOGICAL device id; with extra
+    partitioned axes that id must be computed from every axis index
+    (parallel/ring.device_roles), which needs the mesh's axis order — so
+    the gate below requires `cfg.mesh_axes` whenever this returns a
+    non-empty list.  Returns None when the axis-env API is unavailable
+    (reported as its own distinct reason, not as a multi-axis decline)."""
     try:
         from jax._src.core import get_axis_env
 
         sizes = dict(get_axis_env().axis_sizes)
     except Exception:  # noqa: BLE001 — private-API probe; absence != error
         return None
+    skip = {intra_axis, inter_axis}
     return [a for a, sz in sizes.items()
-            if a is not None and a != intra_axis and sz and sz > 1]
+            if a is not None and a not in skip and sz and sz > 1]
+
+
+def resolve_topology(cfg, n_intra: int, n_inter: int = 1):
+    """(topology, n_inter, n_intra) the fused kernels will run for cfg.
+
+    A real inter axis (or cfg.fused_seq_factor on a flat ring) selects the
+    double ring; `fused_topology="bidi"` opts the flat ring into the
+    counter-rotating schedule (worlds < 3 degrade to uni — there is no
+    second direction to use); default is uni."""
+    if cfg.fused_seq_factor is not None:
+        f_i, f_s = cfg.fused_seq_factor
+        if n_inter > 1:
+            raise ValueError("fused_seq_factor is for flat ring axes; this "
+                             "config already has an inter axis")
+        if f_i * f_s != n_intra:
+            raise ValueError(
+                f"fused_seq_factor {cfg.fused_seq_factor} does not tile the "
+                f"ring axis ({n_intra} devices)")
+        return ("double", f_i, f_s) if f_i > 1 else ("uni", 1, n_intra)
+    if n_inter > 1:
+        return "double", n_inter, n_intra
+    topo = cfg.fused_topology
+    if topo in ("auto", "uni"):
+        return "uni", 1, n_intra
+    if topo == "bidi":
+        return ("bidi" if n_intra >= 3 else "uni"), 1, n_intra
+    if topo == "double":
+        # double requested without an inter axis or factor: nothing to nest
+        return "uni", 1, n_intra
+    raise ValueError(f"unknown fused_topology {topo!r}")
+
+
+def _compile_for(cfg, topology: str, n_inter: int, n_intra: int,
+                 pass_: str = "fwd"):
+    rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
+                       cfg.fused_kv_slots,
+                       block_q_bwd=getattr(cfg, "fused_block_q_bwd", None),
+                       block_kv_bwd=getattr(cfg, "fused_block_kv_bwd", None),
+                       bwd_slots=getattr(cfg, "fused_bwd_slots", None),
+                       ccw_slots=getattr(cfg, "fused_ccw_slots", None),
+                       bwd_ccw_slots=getattr(cfg, "fused_bwd_ccw_slots",
+                                             None))
+    if pass_ == "fwd":
+        return sched_ir.compile_fwd(topology, n_intra, n_inter,
+                                    slots=rf.kv_slots, slots1=rf.ccw_slots)
+    return sched_ir.compile_bwd(topology, n_intra, n_inter,
+                                slots=rf.bwd_slots, slots1=rf.bwd_ccw_slots,
+                                dq_slots=rf.bwd_slots)
 
 
 def supported(cfg, q_shape, k_shape, has_segments: bool, *,
-              interpret=None, world=None, extra_axes=None, pass_="fwd"):
+              interpret=None, world=None, extra_axes=None, n_inter=None,
+              pass_="fwd"):
     """None if the fused ring can run this config, else a reason string the
     dispatch logs / the tests assert on.  By default must be called at
     trace time (inside shard_map) — the axis-env and mesh-size probes read
-    the trace context.  Passing `world` (ring axis size) and `extra_axes`
-    (other partitioned mesh axes) explicitly makes the predicate host-
-    callable with PER-SHARD shapes: the obs dispatch instrumentation
-    (parallel/burst._note_dispatch) evaluates the same gate the traced
-    dispatch runs, so the `burst.dispatch`/`burst.fused_fallback` counters
-    cannot drift from the real decision logic.
+    the trace context.  Passing `world` (ring axis size), `n_inter`
+    (inter axis size) and `extra_axes` (other partitioned mesh axes)
+    explicitly makes the predicate host-callable with PER-SHARD shapes:
+    the obs dispatch instrumentation (parallel/burst._note_dispatch)
+    evaluates the same gate the traced dispatch runs, so the
+    `burst.dispatch`/`burst.fused_fallback` counters cannot drift from the
+    real decision logic.
 
     `pass_` ("fwd" | "bwd") selects which kernel's gate to evaluate: the
     structural constraints are shared, but each pass has its own blocks and
-    VMEM plan (the bwd keeps fp32 dk/dv accumulators resident where the fwd
-    keeps packed m/l stats), so a shard can be fused in one pass and fall
-    back in the other — parallel/burst._bwd_impl runs this with
-    pass_="bwd" at its single dispatch point."""
+    VMEM plan, so a shard can be fused in one pass and fall back in the
+    other — parallel/burst._bwd_impl runs this with pass_="bwd" at its
+    single dispatch point."""
     if pass_ not in ("fwd", "bwd"):
         raise ValueError(f"pass_ must be 'fwd' or 'bwd', got {pass_!r}")
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = jax.default_backend() != "tpu" and not hw_trace_forced()
     if interpret and not interpret_enabled():
         return "off-TPU (set BURST_FUSED_INTERPRET=1 to run interpreted)"
-    if cfg.inter_axis is not None:
-        return "double ring (inter axis) not fused yet"
     if cfg.window is not None:
         return "sliding window not fused yet"
     if has_segments:
@@ -157,25 +221,64 @@ def supported(cfg, q_shape, k_shape, has_segments: bool, *,
         return "cross-attention shard lengths"
     if world is None:
         world = axis_size(cfg.intra_axis)
-    if world < 2:
+    if n_inter is None:
+        if cfg.inter_axis is None:
+            n_inter = 1
+        else:
+            try:
+                n_inter = axis_size(cfg.inter_axis)
+            except Exception:  # noqa: BLE001 — axis not bound in this trace
+                return (f"double ring inter axis {cfg.inter_axis!r} is not "
+                        "bound in this trace")
+    if world * n_inter < 2:
         return "world < 2 (nothing to rotate)"
-    extra = _extra_named_axes(cfg.intra_axis) if extra_axes is None \
-        else list(extra_axes)
-    if extra is None or extra:
-        return (f"ring axis must be the only partitioned axis in scope "
-                f"(found {extra})")
+    try:
+        topology, t_inter, t_intra = resolve_topology(cfg, world, n_inter)
+    except ValueError as e:
+        return f"topology config invalid: {e}"
+    if interpret and cfg.inter_axis is not None and n_inter > 1:
+        # jax's dma_start discharge emulates a single named axis only; the
+        # two-axis double ring runs fused on hardware (or factored onto a
+        # flat axis in tests) but must decline under emulation
+        return ("interpret-mode remote DMA is single-axis (two-axis double "
+                "ring runs fused on hardware)")
+    extra = _extra_named_axes(cfg.intra_axis, cfg.inter_axis) \
+        if extra_axes is None else list(extra_axes)
+    if extra is None:
+        # distinct from the multi-axis decline: the axis env could not be
+        # probed at all, so ring isolation is unprovable — misattributing
+        # this as "multi-axis" would skew the fallback counters
+        return "axis env unavailable (cannot prove ring isolation)"
+    if extra:
+        if interpret:
+            return ("interpret-mode remote DMA is single-axis (multi-axis "
+                    "mesh runs fused on hardware)")
+        mesh_names = {a for a, _ in (cfg.mesh_axes or ())}
+        missing = [a for a in extra if a not in mesh_names]
+        if missing:
+            return (f"ring axis must be the only partitioned axis in scope "
+                    f"(found {extra}; pass mesh_axes via burst_attn to "
+                    "prove ring isolation)")
+    try:
+        prog = _compile_for(cfg, topology, t_inter, t_intra, pass_)
+    except sched_ir.ScheduleError as e:
+        return f"schedule compiler declined: {e}"
     rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
                        cfg.fused_kv_slots,
                        block_q_bwd=getattr(cfg, "fused_block_q_bwd", None),
                        block_kv_bwd=getattr(cfg, "fused_block_kv_bwd", None),
-                       bwd_slots=getattr(cfg, "fused_bwd_slots", None))
+                       bwd_slots=getattr(cfg, "fused_bwd_slots", None),
+                       ccw_slots=getattr(cfg, "fused_ccw_slots", None),
+                       bwd_ccw_slots=getattr(cfg, "fused_bwd_ccw_slots",
+                                             None))
+    del prog
     if pass_ == "bwd":
         # VMEM plan, bwd roles: resident k+v chunk, fp32 dk/dv accumulators,
         # the per-step bundle tiles (q, do, delta|o, lse, arriving dq, local
-        # dq) — 4-byte worst case, so an oversized shard falls back instead
-        # of failing Mosaic allocation mid-ring
+        # dq, inter-held dq) — 4-byte worst case, so an oversized shard
+        # falls back instead of failing Mosaic allocation mid-ring
         bqb = _pick_block(s, rf.block_q_bwd)
-        vmem = 2 * s * d * 4 + 2 * s * d * 4 + 6 * bqb * d * 4
+        vmem = 2 * s * d * 4 + 2 * s * d * 4 + 7 * bqb * d * 4
         if vmem > rf.vmem_budget:
             return (f"VMEM plan {vmem} bytes exceeds fused budget "
                     f"{rf.vmem_budget} (bwd)")
@@ -214,6 +317,54 @@ def _stat_write(ref, b_, h, i, col, bq, lp):
     ref[b_, h, pl.ds(i * rows, rows), :] = jnp.reshape(col, (rows, lp))
 
 
+def dma_sem_wait(sem_view, ref):
+    """Retire one completed DMA on a DMA semaphore: `tpu.wait_dma` with the
+    transfer-sized ref (descriptor form — `pltpu.semaphore_wait` only
+    admits REGULAR/barrier semaphore avals at trace time, so a DMA-sem
+    wait spelled that way traces under the interpreter's int16 stand-in
+    but fails the hardware trace; burstlint's BURST_FUSED_ASSUME_TPU
+    census caught exactly that).  The ref must cover the same elements as
+    the transfer(s) being retired — wait_dma blocks until the semaphore
+    holds the ref's size, then decrements by it, which is also what the
+    interpret discharge rules do (dma_start adds sizes, dma_wait
+    subtracts them)."""
+    pltpu.make_async_copy(ref, ref, sem_view).wait()
+
+
+# ---------------------------------------------------------------------------
+# static program views the kernel codegen branches on
+
+
+def kernel_statics(prog):
+    """The compiled program's static structure: which banks are consumed,
+    which channels send (and from which src banks), where credits flow.
+    Python-level — this decides which code the kernel EMITS, so the traced
+    program (and burstlint's remote-DMA census, schedule.expected_remote_
+    dma) is a function of the program alone."""
+    rows = prog.rows
+    R = prog.n_rounds
+    consume_banks = tuple(sorted({rows["consume_bank"][r] for r in range(R)}))
+    ch_active = tuple(ch for ch in range(prog.n_banks)
+                      if any(rows[f"send{ch}"][r] for r in range(R)))
+    src_banks0 = tuple(sorted({rows["src_bank0"][r] for r in range(R)
+                               if rows["send0"][r]})) or (0,)
+    grant_banks = tuple(b for b in range(prog.n_banks)
+                        if any(rows[f"grant{b}"][r] for r in range(R)))
+    take_chs = tuple(ch for ch in ch_active
+                     if any(rows[f"take{ch}"][r] for r in range(R)))
+    return dict(consume_banks=consume_banks, ch_active=ch_active,
+                src_banks0=src_banks0, grant_banks=grant_banks,
+                take_chs=take_chs)
+
+
+_SENDC = {0: (sched_ir.SEND0, sched_ir.SRC_SLOT0, sched_ir.DST_SLOT0,
+              sched_ir.TAKE0, sched_ir.META_CH0_DST),
+          1: (sched_ir.SEND1, sched_ir.SRC_SLOT1, sched_ir.DST_SLOT1,
+              sched_ir.TAKE1, sched_ir.META_CH1_DST)}
+_GRANTC = {0: (sched_ir.GRANT0, sched_ir.META_CH0_SRC),
+           1: (sched_ir.GRANT1, sched_ir.META_CH1_SRC)}
+
+
 # ---------------------------------------------------------------------------
 # kernel
 
@@ -223,48 +374,64 @@ def _fused_fwd_kernel(
     q_ref, k_hbm, v_hbm,
     o_ref, lse_ref,
     *rest,
-    world, slots, scale, bq, bkv, lp, nqb, nkb, group, n_b, n_h, hw_sync,
+    prog, statics, scale, bq, bkv, lp, nqb, nkb, group, n_b, n_h, hw_sync,
     collect,
 ):
     """One grid step = q-block i of head h, batch b_, ring round r.
 
-    sched_ref is the [world + 1, 6] prefetch table: rows 0..world-1 hold the
-    per-round (q_lo, q_hi, kv_hi, causal, offset, slot) — mask scalars from
-    ops/masks.round_spec plus the exported slot schedule — and row `world`
-    holds (me, right, left, 0, 0, 0) neighbor ids.
+    sched_ref is the [R + 1, FWD_COLS] prefetch table: rows 0..R-1 hold the
+    per-round mask scalars (cols 0..4, ops/masks.round_spec) plus the
+    compiled program's op columns (parallel/schedule.py col constants);
+    row R holds the traced neighbor ids (META_* slots).
 
     `collect` (static) appends one more OUTPUT before the scratch refs: a
-    [1, slots] int32 SMEM array counting, per communication slot, how many
-    rounds consumed a chunk out of that slot — the devstats slot-reuse
-    counter (obs/devstats.py).  Pure scalar writes at the first grid step
-    of each round; the compute/DMA choreography is untouched, so stats-off
-    and stats-on kernels produce bit-identical o/lse.
+    [n_banks, max_slots] int32 SMEM array counting, per (bank, slot), how
+    many rounds consumed a chunk there — the devstats slot-reuse counter
+    with its per-direction rows (obs/devstats.py, dir=cw|ccw labels).
+    Pure scalar writes at round boundaries; the compute/DMA choreography
+    is untouched, so stats-off and stats-on kernels produce bit-identical
+    o/lse.
 
-    Semaphore ledger (everything drains to zero):
-      krecv/vrecv[slot]  +1 per arriving send (left neighbor, rounds 1..W-1)
-                         -1 at the round's first grid step
-      ksend/vsend[slot]  +1 per outgoing send (rounds 0..W-2)
-                         -1 at the same round's last grid step (drain)
-      free_sem (hw only) +1 from the right neighbor when our send's target
-                         slot is reusable; sends at rounds >= slots-1 take
-                         one credit; we grant the LEFT neighbor a credit at
-                         the end of rounds 0..W-1-slots.  Credits granted ==
-                         credits taken == max(0, W-1-(slots-1)).
+    Semaphore ledger (everything drains to zero; DMA sems count transfer
+    sizes — dma_sem_wait retires a slot-sized transfer):
+      krecv/vrecv[bank][slot]  +1 transfer per arriving send, -1 at the
+                               consuming round's first grid step
+      ksend/vsend[bank][slot]  +1 transfer per outgoing send (by dst
+                               slot), -1 at the same round's last grid
+                               step (drain)
+      free[bank][slot] (hw)    per-SLOT capacity credit: the slot's last
+                               reader signals the bank's writer (GRANT
+                               column = slot + 1); a send whose TAKE flag
+                               is set waits its dst slot's credit first.
+                               Grants emitted == takes consumed, per slot
+                               (compiler-checked, oracle-proven).
     """
+    R = prog.n_rounds
+    n_banks = prog.n_banks
+    rest = list(rest)
     if collect:
-        slot_use_ref = rest[0]
-        rest = rest[1:]
-    (kbuf, vbuf, kchunk, vchunk, mstat, lstat, accbuf, acc_in, acc_scr,
-     m_sw, l_sw, cp_sem, chunk_sem, acc_sem, ksend, krecv, vsend, vrecv,
-     free_sem) = rest
+        slot_use_ref = rest.pop(0)
+    kbufs, vbufs = [], []
+    for _ in range(n_banks):
+        kbufs.append(rest.pop(0))
+        vbufs.append(rest.pop(0))
+    (kchunk, vchunk, mstat, lstat, accbuf, acc_in, acc_scr, m_sw, l_sw,
+     cp_sem, chunk_sem, acc_sem) = rest[:12]
+    rest = rest[12:]
+    ksend, krecv, vsend, vrecv, free = [], [], [], [], []
+    for _ in range(n_banks):
+        ksend.append(rest.pop(0))
+        krecv.append(rest.pop(0))
+        vsend.append(rest.pop(0))
+        vrecv.append(rest.pop(0))
+        free.append(rest.pop(0))
 
     r = pl.program_id(0)
     b_ = pl.program_id(1)
     h = pl.program_id(2)
     i = pl.program_id(3)
-    right = sched_ref[world, 1]
-    left = sched_ref[world, 2]
-    slot = sched_ref[r, 5]
+    bank = sched_ref[r, sched_ir.CONSUME_BANK]
+    slot = sched_ref[r, sched_ir.CONSUME_SLOT]
     first_of_round = (b_ == 0) & (h == 0) & (i == 0)
     last_of_round = (b_ == n_b - 1) & (h == n_h - 1) & (i == nqb - 1)
 
@@ -272,79 +439,119 @@ def _fused_fwd_kernel(
         @pl.when(first_of_round)
         def _slot_tally():
             # devstats slot-reuse counter: zero once at round 0, then one
-            # scalar SMEM increment per round for the slot being consumed
+            # scalar SMEM increment per round for the (bank, slot) consumed
             @pl.when(r == 0)
             def _zero():
-                for j in range(slots):
-                    slot_use_ref[0, j] = 0
+                for bb in range(slot_use_ref.shape[0]):
+                    for j in range(slot_use_ref.shape[1]):
+                        slot_use_ref[bb, j] = 0
 
-            slot_use_ref[0, slot] = slot_use_ref[0, slot] + 1
+            slot_use_ref[bank, slot] = slot_use_ref[bank, slot] + 1
 
     # ---- round choreography (first grid step of the round only) ----
     @pl.when(first_of_round & (r == 0))
     def _copy_in():
-        # local chunk -> slot[0]: one HBM->HBM copy so every later round
-        # (compute reads, RDMA sends) addresses kbuf/vbuf slots uniformly
-        ck = pltpu.make_async_copy(k_hbm, kbuf.at[slot], cp_sem.at[0])
-        cv = pltpu.make_async_copy(v_hbm, vbuf.at[slot], cp_sem.at[1])
-        ck.start()
-        cv.start()
-        ck.wait()
-        cv.wait()
+        # local chunk -> its program-designated slot(s): one HBM->HBM copy
+        # per bank the schedule launches from, so every later round
+        # (compute reads, RDMA sends) addresses the banks uniformly
+        cps = []
+        for idx, (cb, cslot) in enumerate(prog.copy_in):
+            cps.append(pltpu.make_async_copy(k_hbm, kbufs[cb].at[cslot],
+                                             cp_sem.at[2 * idx]))
+            cps.append(pltpu.make_async_copy(v_hbm, vbufs[cb].at[cslot],
+                                             cp_sem.at[2 * idx + 1]))
+        for c in cps:
+            c.start()
+        for c in cps:
+            c.wait()
 
     if hw_sync:
         @pl.when(first_of_round & (r == 0))
         def _barrier():
-            # neighbors must have entered the kernel (buffers live) before
-            # any RDMA writes their slots
+            # every RDMA peer must have entered the kernel (buffers live)
+            # before any send targets its slots
             bar = pltpu.get_barrier_semaphore()
-            pltpu.semaphore_signal(bar, inc=1, device_id=left,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
-            pltpu.semaphore_signal(bar, inc=1, device_id=right,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
-            pltpu.semaphore_wait(bar, 2)
+            n_sig = 0
+            for ch in statics["ch_active"]:
+                _, _, _, _, meta_dst = _SENDC[ch]
+                pltpu.semaphore_signal(
+                    bar, inc=1, device_id=sched_ref[R, meta_dst],
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                pltpu.semaphore_signal(
+                    bar, inc=1, device_id=sched_ref[R, _GRANTC[ch][1]],
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                n_sig += 2
+            pltpu.semaphore_wait(bar, n_sig)
 
-    @pl.when(first_of_round & (r > 0))
+    @pl.when(first_of_round & (sched_ref[r, sched_ir.RECV] == 1))
     def _recv_wait():
-        # round r's chunk must have LANDED in slot[r] before compute or the
-        # onward send may read it
-        pltpu.semaphore_wait(krecv.at[slot], 1)
-        pltpu.semaphore_wait(vrecv.at[slot], 1)
+        # round r's chunk must have LANDED in its slot before compute or
+        # the onward send may read it
+        for b in statics["consume_banks"]:
+            @pl.when(bank == b)
+            def _wait_bank(b=b):
+                dma_sem_wait(krecv[b].at[slot], kbufs[b].at[slot])
+                dma_sem_wait(vrecv[b].at[slot], vbufs[b].at[slot])
 
-    @pl.when(first_of_round & (r < world - 1))
-    def _send_onward():
-        dst_slot = sched_ref[r + 1, 5]
-        if hw_sync:
-            @pl.when(r >= slots - 1)
-            def _capacity():
-                # target slot was last read by the neighbor at round
-                # r + 1 - slots; take one free credit proving it finished
-                pltpu.semaphore_wait(free_sem, 1)
-        sk = pltpu.make_async_remote_copy(
-            src_ref=kbuf.at[slot], dst_ref=kbuf.at[dst_slot],
-            send_sem=ksend.at[dst_slot], recv_sem=krecv.at[dst_slot],
-            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
-        sv = pltpu.make_async_remote_copy(
-            src_ref=vbuf.at[slot], dst_ref=vbuf.at[dst_slot],
-            send_sem=vsend.at[dst_slot], recv_sem=vrecv.at[dst_slot],
-            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
-        sk.start()
-        sv.start()
-        # no wait here: the transfer overlaps this whole round's sweep; the
-        # drain wait sits at the round's LAST grid step below
+    for ch in statics["ch_active"]:
+        send_c, src_c, dst_c, take_c, meta_dst = _SENDC[ch]
+
+        @pl.when(first_of_round & (sched_ref[r, send_c] == 1))
+        def _send_onward(ch=ch, send_c=send_c, src_c=src_c, dst_c=dst_c,
+                         take_c=take_c, meta_dst=meta_dst):
+            dst_slot = sched_ref[r, dst_c]
+            src_slot = sched_ref[r, src_c]
+            dst_dev = sched_ref[R, meta_dst]
+            if hw_sync and ch in statics["take_chs"]:
+                @pl.when(sched_ref[r, take_c] == 1)
+                def _capacity():
+                    # dst slot is being reused: take ITS credit, granted by
+                    # the receiver after the slot's previous last read
+                    pltpu.semaphore_wait(free[ch].at[dst_slot], 1)
+
+            def _emit(sb):
+                sk = pltpu.make_async_remote_copy(
+                    src_ref=kbufs[sb].at[src_slot],
+                    dst_ref=kbufs[ch].at[dst_slot],
+                    send_sem=ksend[ch].at[dst_slot],
+                    recv_sem=krecv[ch].at[dst_slot],
+                    device_id=dst_dev,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                sv = pltpu.make_async_remote_copy(
+                    src_ref=vbufs[sb].at[src_slot],
+                    dst_ref=vbufs[ch].at[dst_slot],
+                    send_sem=vsend[ch].at[dst_slot],
+                    recv_sem=vrecv[ch].at[dst_slot],
+                    device_id=dst_dev,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                sk.start()
+                sv.start()
+                # no wait here: the transfer overlaps this whole round's
+                # sweep; the drain wait sits at the round's LAST grid step
+
+            src_banks = statics["src_banks0"] if ch == 0 else (1,)
+            if len(src_banks) == 1:
+                _emit(src_banks[0])
+            else:
+                for sb in src_banks:
+                    pl.when(sched_ref[r, sched_ir.SRC_BANK0] == sb)(
+                        functools.partial(_emit, sb))
 
     # ---- per-(round, batch, kv-head) chunk load: HBM slot -> VMEM ----
     @pl.when((i == 0) & (h % group == 0))
     def _chunk_load():
         kvh = h // group
-        lk = pltpu.make_async_copy(kbuf.at[slot, b_, kvh], kchunk,
-                                   chunk_sem.at[0])
-        lv = pltpu.make_async_copy(vbuf.at[slot, b_, kvh], vchunk,
-                                   chunk_sem.at[1])
-        lk.start()
-        lv.start()
-        lk.wait()
-        lv.wait()
+        for b in statics["consume_banks"]:
+            @pl.when(bank == b)
+            def _load_bank(b=b):
+                lk = pltpu.make_async_copy(kbufs[b].at[slot, b_, kvh],
+                                           kchunk, chunk_sem.at[0])
+                lv = pltpu.make_async_copy(vbufs[b].at[slot, b_, kvh],
+                                           vchunk, chunk_sem.at[1])
+                lk.start()
+                lv.start()
+                lk.wait()
+                lv.wait()
 
     # ---- start the acc carry load early: it overlaps the whole sweep ----
     @pl.when(r > 0)
@@ -413,14 +620,14 @@ def _fused_fwd_kernel(
         _stat_write(mstat, b_, h, i, m, bq, lp)
         _stat_write(lstat, b_, h, i, l1 * a1 + l2 * a2, bq, lp)
 
-    @pl.when(r < world - 1)
+    @pl.when(r < R - 1)
     def _acc_store():
         st = pltpu.make_async_copy(acc_scr, accbuf.at[b_, h, i],
                                    acc_sem.at[1])
         st.start()
         st.wait()
 
-    @pl.when(r == world - 1)
+    @pl.when(r == R - 1)
     def _finalize():
         # fused finalize: o = acc / l in the caller's dtype; lse back to the
         # natural-log domain, packed rows into the resident lse out block
@@ -434,27 +641,82 @@ def _fused_fwd_kernel(
             lse, (rows, lp))
 
     # ---- round epilogue (last grid step of the round only) ----
-    @pl.when(last_of_round & (r < world - 1))
-    def _send_drain():
-        # our outgoing RDMA read slot[r]; it must be out the door before the
-        # left neighbor may overwrite that slot (free credit below) and
-        # before the kernel may exit with a live DMA
-        dst_slot = sched_ref[r + 1, 5]
-        pltpu.semaphore_wait(ksend.at[dst_slot], 1)
-        pltpu.semaphore_wait(vsend.at[dst_slot], 1)
+    for ch in statics["ch_active"]:
+        send_c, _, dst_c, _, _ = _SENDC[ch]
+
+        @pl.when(last_of_round & (sched_ref[r, send_c] == 1))
+        def _send_drain(ch=ch, dst_c=dst_c):
+            # our outgoing RDMA read its src slot; it must be out the door
+            # before the writer may overwrite that slot (free credit below)
+            # and before the kernel may exit with a live DMA
+            dst_slot = sched_ref[r, dst_c]
+            dma_sem_wait(ksend[ch].at[dst_slot], kbufs[ch].at[dst_slot])
+            dma_sem_wait(vsend[ch].at[dst_slot], vbufs[ch].at[dst_slot])
 
     if hw_sync:
-        @pl.when(last_of_round & (r <= world - 1 - slots))
-        def _grant_free():
-            # slot[r] has no further readers here: every q-block consumed it
-            # and our own onward send drained — the LEFT neighbor (writer of
-            # our slots) may now target it at its round r + slots - 1
-            pltpu.semaphore_signal(free_sem, inc=1, device_id=left,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+        for b in statics["grant_banks"]:
+            grant_c, meta_src = _GRANTC[b]
+
+            @pl.when(last_of_round & (sched_ref[r, grant_c] > 0))
+            def _grant_free(b=b, grant_c=grant_c, meta_src=meta_src):
+                # the named slot has no further readers here — its writer
+                # (the bank's upstream neighbor) may target it again
+                pltpu.semaphore_signal(
+                    free[b].at[sched_ref[r, grant_c] - 1], inc=1,
+                    device_id=sched_ref[R, meta_src],
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
 
 
 # ---------------------------------------------------------------------------
 # shard-level entry point
+
+
+def build_sched_table(cfg, prog, s_q: int, s_kv: int, *, swap_roles=False):
+    """The [R + 1, cols] traced prefetch table for a compiled program:
+    per-round mask-spec scalars (the partition each round holds comes from
+    the program's rotation applied to this device's ring coordinates) next
+    to the program's op columns, plus the META neighbor-id row from
+    parallel/ring.device_roles.  `swap_roles` builds backward-orientation
+    specs (the rotating payload is the q side, the resident chunk the kv
+    side).  Returns (table, specs) — the per-round MaskSpecs are reused
+    for devstats occupancy tallies."""
+    inter_rank, intra_rank, _, _ = ring_coords(
+        cfg.intra_axis, cfg.inter_axis, cfg.fused_seq_factor)
+    me_part = inter_rank * prog.n_intra + intra_rank
+    op_table = prog.to_table()
+    ncols = op_table.shape[1]
+    rows = []
+    specs = []
+    for r in range(prog.n_rounds):
+        part_r = sched_ir.partition_for_round(prog, r, inter_rank,
+                                              intra_rank)
+        if swap_roles:
+            sp = round_spec(part_r, me_part, s_q, s_kv, cfg.causal,
+                            cfg.layout)
+        else:
+            sp = round_spec(me_part, part_r, s_q, s_kv, cfg.causal,
+                            cfg.layout)
+        specs.append(sp)
+        rows.append(jnp.concatenate(
+            [_spec_array(sp), jnp.asarray(op_table[r, 5:], jnp.int32)]))
+    roles = device_roles(cfg.intra_axis, cfg.inter_axis,
+                         mesh_axes=cfg.mesh_axes,
+                         factor=cfg.fused_seq_factor,
+                         home_offsets=prog.home_offsets)
+    dirs = prog.channels
+    meta = [roles["me"]]
+    meta.append(roles[f"{dirs[0]}_dst"])
+    meta.append(roles[f"{dirs[0]}_src"])
+    if len(dirs) > 1:
+        meta.append(roles[f"{dirs[1]}_dst"])
+        meta.append(roles[f"{dirs[1]}_src"])
+    else:
+        meta += [jnp.int32(0), jnp.int32(0)]
+    for j in range(2):
+        meta.append(roles.get(f"home{j}", jnp.int32(0)))
+    meta += [jnp.int32(0)] * (ncols - len(meta))
+    rows.append(jnp.stack([jnp.asarray(x, jnp.int32) for x in meta]))
+    return jnp.stack(rows), specs
 
 
 def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
@@ -465,52 +727,40 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
     order.  Returns (o [B, N, S, D] in q.dtype, lse [B, N, S] f32) — plus a
     per-shard obs.devstats.DevStats when `collect_stats`: mask occupancy and
     liveness are derived in-graph from the SAME sched-table specs the kernel
-    masks by, slot-reuse counts come out of the kernel itself as an extra
-    scalar (SMEM) output, and lse/o health is computed on the results.  The
-    stats-off call emits the identical kernel (no extra output), so traces
-    without stats are bit-identical to pre-devstats builds.
-    Callers must have checked `supported` first.
+    masks by, per-(bank, slot) reuse counts come out of the kernel itself as
+    an extra scalar (SMEM) output, and lse/o health is computed on the
+    results.  The stats-off call emits the identical kernel (no extra
+    output), so traces without stats are bit-identical to pre-devstats
+    builds.  Callers must have checked `supported` first.
     """
     b, n, s, d = q.shape
     n_kv = k.shape[1]
     assert n % n_kv == 0, f"GQA needs Nq % Nk == 0, got {n} % {n_kv}"
     group = n // n_kv
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = jax.default_backend() != "tpu" and not hw_trace_forced()
     scale = cfg.scale if cfg.scale is not None else d ** -0.5
-    world = axis_size(cfg.intra_axis)
+    n_intra_ax = axis_size(cfg.intra_axis)
+    n_inter_ax = (axis_size(cfg.inter_axis)
+                  if cfg.inter_axis is not None else 1)
+    topology, t_inter, t_intra = resolve_topology(cfg, n_intra_ax,
+                                                  n_inter_ax)
+    prog = _compile_for(cfg, topology, t_inter, t_intra, "fwd")
+    statics = kernel_statics(prog)
+    R = prog.n_rounds
     rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
-                       cfg.fused_kv_slots)
-    slots = min(rf.kv_slots, world)
+                       cfg.fused_kv_slots,
+                       ccw_slots=getattr(cfg, "fused_ccw_slots", None))
     bq = _pick_block(s, rf.block_q)
     bkv = _pick_block(s, rf.block_kv)
     lp = _pick_block(bq, 128)
     nqb = s // bq
     nkb = s // bkv
 
-    # [world + 1, 6] schedule table (see _fused_fwd_kernel docstring): mask
-    # scalars reuse the SAME per-round specs the scan ring computes, so the
-    # two paths mask identically by construction
-    part_me = my_partition(cfg.intra_axis, None)
-    slot_sched = fused_slot_schedule(world, slots)
-    rows = []
-    specs = []  # per-round MaskSpecs, reused for devstats occupancy tallies
-    for r in range(world):
-        sp = round_spec(part_me, partition_at_round(r, cfg.intra_axis, None),
-                        s, s, cfg.causal, cfg.layout)
-        specs.append(sp)
-        rows.append(jnp.concatenate(
-            [_spec_array(sp),
-             jnp.asarray([int(slot_sched[r])], jnp.int32)]))
-    me, right, left = neighbor_ids(cfg.intra_axis)
-    rows.append(jnp.stack([jnp.asarray(me, jnp.int32),
-                           jnp.asarray(right, jnp.int32),
-                           jnp.asarray(left, jnp.int32),
-                           jnp.int32(0), jnp.int32(0), jnp.int32(0)]))
-    sched = jnp.stack(rows)
+    sched, specs = build_sched_table(cfg, prog, s, s)
 
     kernel = functools.partial(
-        _fused_fwd_kernel, world=world, slots=slots, scale=scale, bq=bq,
+        _fused_fwd_kernel, prog=prog, statics=statics, scale=scale, bq=bq,
         bkv=bkv, lp=lp, nqb=nqb, nkb=nkb, group=group, n_b=b, n_h=n,
         hw_sync=not interpret, collect=collect_stats,
     )
@@ -529,43 +779,53 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
         jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
         jax.ShapeDtypeStruct((b, n, s // lp, lp), jnp.float32),
     ]
+    max_slots = max(prog.slots)
     if collect_stats:
         # devstats slot-reuse counts: whole-array SMEM output, scalar writes
-        # only at round boundaries (see _fused_fwd_kernel)
+        # only at round boundaries (see _fused_fwd_kernel); one row per
+        # bank/direction (dir=cw|ccw in the published counter)
         out_specs.append(
             pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.SMEM))
-        out_shape.append(jax.ShapeDtypeStruct((1, slots), jnp.int32))
+        out_shape.append(
+            jax.ShapeDtypeStruct((prog.n_banks, max_slots), jnp.int32))
+
+    scratch = []
+    for bank in range(prog.n_banks):
+        scratch.append(pltpu.ANY((prog.slots[bank], b, n_kv, s, d), k.dtype))
+        scratch.append(pltpu.ANY((prog.slots[bank], b, n_kv, s, d), v.dtype))
+    scratch += [
+        pltpu.VMEM((s, d), k.dtype),                  # kchunk
+        pltpu.VMEM((s, d), v.dtype),                  # vchunk
+        pltpu.VMEM((b, n, s // lp, lp), jnp.float32),  # mstat (base-2)
+        pltpu.VMEM((b, n, s // lp, lp), jnp.float32),  # lstat (linear)
+        pltpu.ANY((b, n, nqb, bq, d), jnp.float32),   # accbuf (carry)
+        pltpu.VMEM((bq, d), jnp.float32),             # acc_in
+        pltpu.VMEM((bq, d), jnp.float32),             # acc_scr
+        pltpu.VMEM((bq, 1), jnp.float32),             # m_sw
+        pltpu.VMEM((bq, 1), jnp.float32),             # l_sw
+        pltpu.SemaphoreType.DMA((2 * len(prog.copy_in),)),  # cp_sem
+        pltpu.SemaphoreType.DMA((2,)),                # chunk_sem
+        pltpu.SemaphoreType.DMA((2,)),                # acc_sem
+    ]
+    for bank in range(prog.n_banks):
+        scratch += [
+            pltpu.SemaphoreType.DMA((prog.slots[bank],)),   # ksend[bank]
+            pltpu.SemaphoreType.DMA((prog.slots[bank],)),   # krecv[bank]
+            pltpu.SemaphoreType.DMA((prog.slots[bank],)),   # vsend[bank]
+            pltpu.SemaphoreType.DMA((prog.slots[bank],)),   # vrecv[bank]
+            pltpu.SemaphoreType.REGULAR((prog.slots[bank],)),  # free[bank]
+        ]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(world, b, n, nqb),
+        grid=(R, b, n, nqb),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), q_map),
             pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
             pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
         ],
         out_specs=out_specs,
-        scratch_shapes=[
-            pltpu.ANY((slots, b, n_kv, s, d), k.dtype),   # kbuf
-            pltpu.ANY((slots, b, n_kv, s, d), v.dtype),   # vbuf
-            pltpu.VMEM((s, d), k.dtype),                  # kchunk
-            pltpu.VMEM((s, d), v.dtype),                  # vchunk
-            pltpu.VMEM((b, n, s // lp, lp), jnp.float32),  # mstat (base-2)
-            pltpu.VMEM((b, n, s // lp, lp), jnp.float32),  # lstat (linear)
-            pltpu.ANY((b, n, nqb, bq, d), jnp.float32),   # accbuf (carry)
-            pltpu.VMEM((bq, d), jnp.float32),             # acc_in
-            pltpu.VMEM((bq, d), jnp.float32),             # acc_scr
-            pltpu.VMEM((bq, 1), jnp.float32),             # m_sw
-            pltpu.VMEM((bq, 1), jnp.float32),             # l_sw
-            pltpu.SemaphoreType.DMA((2,)),                # cp_sem
-            pltpu.SemaphoreType.DMA((2,)),                # chunk_sem
-            pltpu.SemaphoreType.DMA((2,)),                # acc_sem
-            pltpu.SemaphoreType.DMA((slots,)),            # ksend
-            pltpu.SemaphoreType.DMA((slots,)),            # krecv
-            pltpu.SemaphoreType.DMA((slots,)),            # vsend
-            pltpu.SemaphoreType.DMA((slots,)),            # vrecv
-            pltpu.SemaphoreType.REGULAR,                  # free_sem
-        ],
+        scratch_shapes=scratch,
     )
     outs = pl.pallas_call(
         kernel,
@@ -592,9 +852,11 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
     # rounds run fully masked instead of being cond-skipped)
     pairs = sum(spec_pair_count(sp, s, s) for sp in specs)
     live = sum(spec_live(sp).astype(jnp.int32) for sp in specs)
+    slot_use = outs[2]
     stats = devstats.ring_stats(
-        rounds=world, rounds_live=live, attn_pairs=pairs,
-        total_pairs=float(world) * s * s, head_dim=d,
+        rounds=R, rounds_live=live, attn_pairs=pairs,
+        total_pairs=float(R) * s * s, head_dim=d,
         m=None,  # the running row max never leaves the kernel
-        lse=lse, acc=o, fused_rounds=world, slot_use=outs[2])
+        lse=lse, acc=o, fused_rounds=R, slot_use=slot_use[0],
+        slot_use_ccw=slot_use[1] if prog.n_banks > 1 else None)
     return o, lse, stats

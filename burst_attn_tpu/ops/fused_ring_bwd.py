@@ -1,70 +1,76 @@
-"""Fused on-device ring attention BACKWARD: all W backward rounds in ONE
-Pallas kernel — the comm-optimized BurstAttention backward (SURVEY §3.2)
-with both of its concurrent streams carried by in-kernel inter-chip RDMA
-instead of per-round `lax.ppermute` collectives between kernel launches.
+"""Fused on-device ring attention BACKWARD: all R backward rounds in ONE
+Pallas kernel — the comm-optimized BurstAttention backward with both of its
+concurrent streams carried by in-kernel inter-chip RDMA instead of
+per-round `lax.ppermute` collectives between kernel launches.
 
 Roles flip versus the fused forward (ops/fused_ring.py): K and V stay
-RESIDENT on their home device for the whole kernel (dk/dv accumulate in
-fp32 locally and never move), while two streams rotate concurrently:
+RESIDENT on their home device for the whole kernel (fp32 dk/dv accumulate
+in VMEM per (batch, kv-head) segment and never move), while the q-side
+BUNDLE — (delta|o, do, q, lse) in `optimize_bwd_comm` form — rotates
+exactly like the forward's KV, and the dq partial gradients ride
+accumulating rings ONE HOP BEHIND their bundles: a block's dq cannot leave
+until the local contribution is folded in, so each [bq, D] row-block
+streams out the moment its grid step finishes.
 
-  * the q-side BUNDLE — (delta, do, q, lse) in `optimize_bwd_comm` form
-    (delta = sum(o * do) [B, N, S] f32; with the optimization off, o rides
-    instead and delta is recomputed per tile, reproducing the reference's
-    payload trade) — rotates exactly like the forward's KV: the round-r+1
-    send leaves at round r's FIRST grid step from the slot the exported
-    schedule names, and is in flight for the entire round-r compute sweep.
-  * the dq RING — the fp32 partial gradient of whichever partition's bundle
-    a device holds — follows ONE HOP BEHIND: a block's dq cannot leave until
-    the local contribution is folded in, so each [bq, D] row-block streams
-    out the moment its grid step finishes, arriving at the right neighbor
-    before that neighbor's next round needs it.  At the last round the
-    stream takes its return-home hop into a dedicated HOME slot on the
-    right neighbor (one extra hop, exactly the scan backward's final
-    ppermute), which the epilogue copies into the dq output.
+Schedule IR.  Like the forward, this kernel interprets a compiled
+`RingProgram` (parallel/schedule.compile_bwd) and contains no topology
+logic of its own.  The bundle movement reuses the forward program's
+channel/bank/credit columns; the dq plan adds per-round columns saying
+which dq ring the local contribution folds into, whether a partial
+arrives, and the send kind:
 
-Slot choreography for both streams comes from ONE exported schedule
-(parallel/ring.fused_bwd_slot_schedule, scalar-prefetched into the kernel);
-burstlint re-derives it independently and PROVES delivery, hop counts,
-exactly-once dq return-home and overwrite-before-read safety by simulation
-(analysis/oracle.verify_fused_ring_bwd), then checks the traced program
-contains zero XLA collectives and the expected remote-copy census
-(fused-ring-schedule / fused-ring-fused, bwd families).
+  RING      onward hop to the bank's direction neighbor (cw / ccw / intra)
+  HOME      the direction's terminal round: the completed partial takes ONE
+            direct RDMA to its partition's owner (`home_offsets` away — the
+            uni ring's right neighbor; a bidi ring's two directions end
+            ceil/floor((W-1)/2) hops out on opposite sides)
+  BOUNDARY  double ring, end of a non-final cycle: fold the held inter
+            partial, hop the sum one inter step (the scan backward's
+            dq_inter add-and-forward), into a 2-slot ping/pong bank
+  FINAL     double ring, end of the last cycle: fold and take the composed
+            (inter+1, intra+1) home hop — one RDMA where the scan path
+            pays two ppermutes
+
+Topologies: uni (exactly the hand-built PR-5 choreography), bidi (two
+counter-rotating bundle+dq ring pairs, per-direction banks/semaphores; the
+owner receives its gradient as TWO complementary partials — cw carrying
+contributions from the self round and the clockwise visitors, ccw from the
+counter-clockwise visitors — summed outside the kernel), and double (the
+hierarchical ring with the bundle's inter prefetch one full intra-cycle
+early).  Every program is simulation-proven by burstlint before trust
+(analysis/oracle.verify_ring_program: bundle delivery + slot safety per
+bank and the dq streams' exactly-once return-home with all `world`
+contributions).
 
 Compute path.  Per grid step (r, b, h, i) the kernel folds bundle q-block i
-against the WHOLE resident KV chunk (copied HBM -> VMEM once per (round,
-batch, kv-head), as in the forward): per kv block j it forms
+against the WHOLE resident KV chunk: per kv block j it forms
 p = exp2(s·scale·log2e − lse·log2e) from the FINAL lse riding the bundle
-(no online softmax in the backward — p is the true probability), then
-dv += pᵀ·do, ds = p·(dp − delta), dk += dsᵀ·q, dq_local += ds·k, all f32
-accumulated with the trailing *scale of ds deferred exactly like
-pallas_flash's backward kernels.  dk/dv live in VMEM for a (b, kv-head)
-segment and round-trip the output buffers between rounds (zero-initialized
-at round 0, final at round W-1); masks reuse the SAME per-round
-ops/masks.round_spec scalars the scan backward computes, with q/kv roles
-swapped, so the two paths mask identically by construction.
-
-Interpret mode, supported matrix, and fallback behavior mirror the forward
-(docs/fused_ring.md): `fused_ring.supported(..., pass_="bwd")` gates the
-dispatch in parallel/burst._bwd_impl, and any declined config takes the
-scan-ring backward for that pass only.
+(no online softmax — p is the true probability), then dv += pᵀ·do,
+ds = p·(dp − delta), dk += dsᵀ·q, dq_local += ds·k, all f32 accumulated
+with the trailing *scale of ds deferred exactly like pallas_flash's
+backward kernels.  Masks reuse the SAME per-round ops/masks.round_spec
+scalars the scan backward computes, with q/kv roles swapped, so the two
+paths mask identically by construction.
 
 Semaphore ledger (everything drains to zero; N = B*Nq*nqb grid steps per
-round, C = slot count, world = W):
+round):
 
-  precv[slot]   +4 per arriving bundle (left, rounds 1..W-1: one increment
-                per operand), -4 at the round's first grid step
-  psend[slot]   +4 per outgoing bundle send (rounds 0..W-2), -4 at the same
-                round's last grid step (drain)
-  dqrecv[slot]  +N from the left neighbor's streamed round-(r-1) dq blocks,
-                -N at round r's first grid step
-  dqsend[slot]  +N per round's streamed sends (rounds 0..W-2), -N at that
-                round's last grid step
-  home_sem[0/1] +N each during round W-1 (our sends out / left's blocks
-                in), both -N at the globally last grid step before the
-                HOME-slot -> dq output copy
-  free_pay/free_dq (hw only)  capacity handshake per stream, the forward's
-                formula: grants at the end of rounds 0..W-1-C, one credit
-                taken per send round >= C-1; granted == taken == max(0, W-C).
+  precv[bank][slot]   +4 per arriving bundle, -4 at the consuming round's
+                      first grid step
+  psend[bank][slot]   +4 per outgoing bundle send, -4 at the same round's
+                      last grid step (drain)
+  dqrecv[bank][slot]  +N from the writer's streamed previous-serving
+                      blocks, -N at the serving round's first grid step
+  dqsend[bank][slot]  +N per round's streamed ring sends, -N at that
+                      round's last grid step
+  dqi send/recv[slot] (double) +N per boundary stream, drained at the
+                      boundary's last step / waited at the next boundary's
+                      first step
+  home{b} send/recv   +N each around a HOME/FINAL round; drained/waited at
+                      the terminal epilogue before the output copy
+  free_pay[bank][slot], free_dq[bank][slot], free_dqi[slot] (hw only)
+                      per-SLOT capacity credits, compiler-assigned
+                      (GRANT columns carry slot+1, takes ride the sends)
 """
 
 import functools
@@ -74,7 +80,6 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .masks import round_spec
 from .pallas_flash import (
     BIG_LSE,
     LOG2E,
@@ -85,20 +90,18 @@ from .pallas_flash import (
     _block_mask,
     _pack,
     _pick_block,
-    _spec_array,
 )
 from .tuning import resolve_fused
-from ..parallel.ring import (
-    fused_bwd_slot_schedule,
-    my_partition,
-    neighbor_ids,
-    partition_at_round,
-)
+from .fused_ring import (build_sched_table, dma_sem_wait, kernel_statics,
+                         _SENDC, _GRANTC)
+from ..parallel import schedule as sched_ir
 from ..utils.compat import axis_size, tpu_compiler_params
 
 # barrier-semaphore namespace, distinct from the fused forward's (13) so a
 # program tracing both kernels never aliases their startup barriers
 _COLLECTIVE_ID = 14
+
+_LOGICAL = None  # filled lazily to keep module import light
 
 
 def _col_from_pack(pack, bq, lp):
@@ -113,6 +116,35 @@ def _col_from_pack(pack, bq, lp):
     return jnp.sum(jnp.where(t_lane == c_idx, rep, 0.0), axis=1, keepdims=True)
 
 
+def bwd_statics(prog):
+    """Static dq-plan structure of a compiled backward program: which dq
+    ring banks exist, where each home send happens, whether the double
+    ring's inter (dqi) machinery is present.  Like fused_ring.kernel_
+    statics this decides which code the kernel EMITS, so the remote-DMA
+    census is a function of the program alone."""
+    rows = prog.rows
+    R = prog.n_rounds
+    ring_banks = tuple(sorted({rows["dq_bank"][r] for r in range(R)
+                               if rows["dq_send"][r] == sched_ir.DQ_RING}))
+    serve_banks = tuple(sorted({rows["dq_bank"][r] for r in range(R)}))
+    home_rounds = {}
+    for r in range(R):
+        if rows["dq_send"][r] == sched_ir.DQ_HOME:
+            home_rounds[rows["dq_bank"][r]] = r
+        elif rows["dq_send"][r] == sched_ir.DQ_FINAL:
+            home_rounds[0] = r
+    has_dqi = any(rows["dqi_recv"][r] or
+                  rows["dq_send"][r] == sched_ir.DQ_BOUNDARY
+                  for r in range(R))
+    take_banks = tuple(b for b in range(2)
+                       if any(rows[f"dq_take{b}"][r] for r in range(R)))
+    grant_banks = tuple(b for b in range(2)
+                        if any(rows[f"dq_grant{b}"][r] for r in range(R)))
+    return dict(ring_banks=ring_banks, serve_banks=serve_banks,
+                home_rounds=home_rounds, has_dqi=has_dqi,
+                take_banks=take_banks, grant_banks=grant_banks)
+
+
 # ---------------------------------------------------------------------------
 # kernel
 
@@ -120,73 +152,115 @@ def _col_from_pack(pack, bq, lp):
 def _fused_bwd_kernel(
     sched_ref,
     first_hbm, do_hbm, q_hbm, lse_hbm, k_hbm, v_hbm,
-    dq_ref, dk_ref, dv_ref,
-    *rest,
-    world, slots, scale, bq, bkv, lp, nqb, nkb, group, n_b, n_h,
-    hw_sync, collect, opt_comm,
+    *refs,
+    prog, statics, dq_statics, scale, bq, bkv, lp, nqb, nkb, group,
+    n_b, n_h, hw_sync, collect, opt_comm,
 ):
     """One grid step = bundle q-block i of head h, batch b_, bwd ring round r.
 
-    sched_ref is the [world + 1, 6] prefetch table: rows 0..world-1 hold the
-    per-round (q_lo, q_hi, kv_hi, causal, offset, slot) — mask scalars from
-    ops/masks.round_spec with the q side being the ROTATING bundle and the
-    kv side the resident chunk — and row `world` holds (me, right, left,
-    0, 0, 0) neighbor ids.
+    sched_ref is the [R + 1, BWD_COLS] prefetch table (parallel/schedule.py
+    column constants): per-round mask scalars (q side = rotating bundle
+    partition, kv side = resident chunk), the bundle's bank/slot/send/credit
+    columns, and the dq plan; row R holds the traced neighbor/home ids.
 
-    `collect` (static) appends one more OUTPUT before the scratch refs: a
-    [1, slots] int32 SMEM array counting bundle consumes per communication
-    slot — the devstats bwd slot-reuse counter (obs/devstats.py), written
-    with pure scalar increments at round boundaries so the compute/DMA
-    choreography (and dq/dk/dv) is bit-identical to collect=off.
-
-    `opt_comm` (static) selects the bundle's first operand: delta in packed
-    [.., rows, lp] f32 form (on) or o in [.., bq, D] form (off, delta
-    recomputed per tile) — the reference's optimize_bwd_comm trade.
+    `collect` (static) appends one more OUTPUT after dq/dk/dv: a
+    [n_banks, max_slots] int32 SMEM array counting bundle consumes per
+    (bank, slot) — the devstats bwd slot-reuse counter with per-direction
+    rows, written with pure scalar increments at round boundaries so the
+    compute/DMA choreography (and dq/dk/dv) is bit-identical to
+    collect=off.
     """
+    R = prog.n_rounds
+    n_banks = prog.n_banks
+    dq_banks = prog.n_dq_banks if prog.topology != "double" else 1
+    home_banks = sorted(dq_statics["home_rounds"])
+    has_dqi = dq_statics["has_dqi"]
+    refs = list(refs)
+    # outputs first: dq per home bank, dk, dv, (slot_use)
+    dq_refs = [refs.pop(0) for _ in home_banks]
+    dk_ref = refs.pop(0)
+    dv_ref = refs.pop(0)
     if collect:
-        slot_use_ref = rest[0]
-        rest = rest[1:]
-    (firstbuf, dobuf, qbuf, lsebuf, dqbuf,
-     kchunk, vchunk, dk_acc, dv_acc,
-     q_t, do_t, first_t, lse_t, dq_arr, dq_scr,
-     cp_sem, chunk_sem, kvio_sem, tile_sem, dqio_sem,
-     psend, precv, dqsend, dqrecv, home_sem,
-     free_pay, free_dq) = rest
+        slot_use_ref = refs.pop(0)
+    firstbuf, dobuf, qbuf, lsebuf = [], [], [], []
+    for _ in range(n_banks):
+        firstbuf.append(refs.pop(0))
+        dobuf.append(refs.pop(0))
+        qbuf.append(refs.pop(0))
+        lsebuf.append(refs.pop(0))
+    dqbuf = [refs.pop(0) for _ in range(dq_banks)]
+    dqibuf = refs.pop(0) if has_dqi else None
+    (kchunk, vchunk, dk_acc, dv_acc,
+     q_t, do_t, first_t, lse_t, dq_arr, dqi_arr, dq_scr,
+     cp_sem, chunk_sem, kvio_sem, tile_sem, dqio_sem) = refs[:16]
+    refs = refs[16:]
+    psend, precv, free_pay = [], [], []
+    for _ in range(n_banks):
+        psend.append(refs.pop(0))
+        precv.append(refs.pop(0))
+        free_pay.append(refs.pop(0))
+    dqsend, dqrecv, free_dq = [], [], []
+    for _ in range(dq_banks):
+        dqsend.append(refs.pop(0))
+        dqrecv.append(refs.pop(0))
+        free_dq.append(refs.pop(0))
+    if has_dqi:
+        dqi_send = refs.pop(0)
+        dqi_recv = refs.pop(0)
+        free_dqi = refs.pop(0)
+    home_sems = {b: refs.pop(0) for b in home_banks}
+    assert not refs, f"{len(refs)} scratch refs left over"
 
+    LOGICAL = pltpu.DeviceIdType.LOGICAL
     r = pl.program_id(0)
     b_ = pl.program_id(1)
     h = pl.program_id(2)
     i = pl.program_id(3)
-    right = sched_ref[world, 1]
-    left = sched_ref[world, 2]
-    slot = sched_ref[r, 5]
+    bank = sched_ref[r, sched_ir.CONSUME_BANK]
+    slot = sched_ref[r, sched_ir.CONSUME_SLOT]
+    dq_bank_c = sched_ref[r, sched_ir.DQ_BANK]
+    dq_slot = sched_ref[r, sched_ir.DQ_SLOT]
+    dq_kind = sched_ref[r, sched_ir.DQ_SEND]
     first_of_round = (b_ == 0) & (h == 0) & (i == 0)
     last_of_round = (b_ == n_b - 1) & (h == n_h - 1) & (i == nqb - 1)
     n_steps = n_b * n_h * nqb  # dq blocks streamed per round
-    home = slots  # dedicated return-home slot, outside the ring cycle
+
+    def dq_banked(fn):
+        """Run fn(bank) under a pl.when for each dq ring bank."""
+        if prog.topology == "double":
+            fn(0)
+            return
+        for b in range(dq_banks):
+            pl.when(dq_bank_c == b)(functools.partial(fn, b))
 
     if collect:
         @pl.when(first_of_round)
         def _slot_tally():
             @pl.when(r == 0)
             def _zero():
-                for j in range(slots):
-                    slot_use_ref[0, j] = 0
+                for bb in range(slot_use_ref.shape[0]):
+                    for j in range(slot_use_ref.shape[1]):
+                        slot_use_ref[bb, j] = 0
 
-            slot_use_ref[0, slot] = slot_use_ref[0, slot] + 1
+            slot_use_ref[bank, slot] = slot_use_ref[bank, slot] + 1
 
     # ---- round choreography (first grid step of the round only) ----
     @pl.when(first_of_round & (r == 0))
     def _copy_in():
-        # local bundle -> slot[0]: one HBM->HBM copy per operand so every
-        # later round (compute reads, RDMA sends) addresses the slot
-        # buffers uniformly
-        cps = [
-            pltpu.make_async_copy(first_hbm, firstbuf.at[slot], cp_sem.at[0]),
-            pltpu.make_async_copy(do_hbm, dobuf.at[slot], cp_sem.at[1]),
-            pltpu.make_async_copy(q_hbm, qbuf.at[slot], cp_sem.at[2]),
-            pltpu.make_async_copy(lse_hbm, lsebuf.at[slot], cp_sem.at[3]),
-        ]
+        # local bundle -> its program-designated slot(s): one HBM->HBM copy
+        # per operand per launch bank
+        cps = []
+        for idx, (cb, cslot) in enumerate(prog.copy_in):
+            cps += [
+                pltpu.make_async_copy(first_hbm, firstbuf[cb].at[cslot],
+                                      cp_sem.at[4 * idx]),
+                pltpu.make_async_copy(do_hbm, dobuf[cb].at[cslot],
+                                      cp_sem.at[4 * idx + 1]),
+                pltpu.make_async_copy(q_hbm, qbuf[cb].at[cslot],
+                                      cp_sem.at[4 * idx + 2]),
+                pltpu.make_async_copy(lse_hbm, lsebuf[cb].at[cslot],
+                                      cp_sem.at[4 * idx + 3]),
+            ]
         for c in cps:
             c.start()
         for c in cps:
@@ -195,41 +269,80 @@ def _fused_bwd_kernel(
     if hw_sync:
         @pl.when(first_of_round & (r == 0))
         def _barrier():
-            # neighbors must have entered the kernel (buffers live) before
-            # any RDMA writes their slots
+            # every RDMA peer must have entered the kernel (buffers live)
+            # before any send targets its slots; home peers are covered by
+            # the ring peers' transitive barrier (the home hop happens
+            # R - 1 rounds later)
             bar = pltpu.get_barrier_semaphore()
-            pltpu.semaphore_signal(bar, inc=1, device_id=left,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
-            pltpu.semaphore_signal(bar, inc=1, device_id=right,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
-            pltpu.semaphore_wait(bar, 2)
+            n_sig = 0
+            for ch in statics["ch_active"]:
+                pltpu.semaphore_signal(
+                    bar, inc=1, device_id=sched_ref[R, _SENDC[ch][4]],
+                    device_id_type=LOGICAL)
+                pltpu.semaphore_signal(
+                    bar, inc=1, device_id=sched_ref[R, _GRANTC[ch][1]],
+                    device_id_type=LOGICAL)
+                n_sig += 2
+            pltpu.semaphore_wait(bar, n_sig)
 
-    @pl.when(first_of_round & (r > 0))
+    @pl.when(first_of_round & (sched_ref[r, sched_ir.RECV] == 1))
     def _recv_wait():
-        # round r's bundle (4 operands) and every streamed dq block of the
-        # left neighbor's previous round must have LANDED in slot[r]
-        pltpu.semaphore_wait(precv.at[slot], 4)
-        pltpu.semaphore_wait(dqrecv.at[slot], n_steps)
+        # round r's bundle (4 operands) must have LANDED in its slot
+        for b in statics["consume_banks"]:
+            @pl.when(bank == b)
+            def _w(b=b):
+                # one wait per operand transfer; together they retire the
+                # full bundle regardless of landing order
+                for bufs in (firstbuf, dobuf, qbuf, lsebuf):
+                    dma_sem_wait(precv[b].at[slot], bufs[b].at[slot])
 
-    @pl.when(first_of_round & (r < world - 1))
-    def _send_bundle():
-        dst_slot = sched_ref[r + 1, 5]
-        if hw_sync:
-            @pl.when(r >= slots - 1)
-            def _capacity():
-                # target slots were last read by the neighbor at round
-                # r + 1 - slots; take one credit per stream proving both
-                # the bundle slot and the dq slot finished
-                pltpu.semaphore_wait(free_pay, 1)
-                pltpu.semaphore_wait(free_dq, 1)
-        for src in (firstbuf, dobuf, qbuf, lsebuf):
-            pltpu.make_async_remote_copy(
-                src_ref=src.at[slot], dst_ref=src.at[dst_slot],
-                send_sem=psend.at[dst_slot], recv_sem=precv.at[dst_slot],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL).start()
-        # no wait here: the transfers overlap this whole round's sweep; the
-        # drain wait sits at the round's LAST grid step below
+    @pl.when(first_of_round & (sched_ref[r, sched_ir.DQ_RECV] == 1))
+    def _dq_recv_wait():
+        # every streamed dq block of the writer's previous serving round:
+        # the n_steps block transfers sum to exactly one slot entry
+        def _w(b):
+            dma_sem_wait(dqrecv[b].at[dq_slot], dqbuf[b].at[dq_slot])
+
+        dq_banked(_w)
+
+    if has_dqi:
+        @pl.when(first_of_round & (sched_ref[r, sched_ir.DQI_RECV] == 1))
+        def _dqi_recv_wait():
+            dqi_slot = sched_ref[r, sched_ir.DQI_SLOT]
+            dma_sem_wait(dqi_recv.at[dqi_slot], dqibuf.at[dqi_slot])
+
+    for ch in statics["ch_active"]:
+        send_c, src_c, dst_c, take_c, meta_dst = _SENDC[ch]
+
+        @pl.when(first_of_round & (sched_ref[r, send_c] == 1))
+        def _send_bundle(ch=ch, src_c=src_c, dst_c=dst_c, take_c=take_c,
+                         meta_dst=meta_dst):
+            dst_slot = sched_ref[r, dst_c]
+            src_slot = sched_ref[r, src_c]
+            dst_dev = sched_ref[R, meta_dst]
+            if hw_sync and ch in statics["take_chs"]:
+                @pl.when(sched_ref[r, take_c] == 1)
+                def _capacity():
+                    pltpu.semaphore_wait(free_pay[ch].at[dst_slot], 1)
+
+            def _emit(sb):
+                for bufs in (firstbuf, dobuf, qbuf, lsebuf):
+                    pltpu.make_async_remote_copy(
+                        src_ref=bufs[sb].at[src_slot],
+                        dst_ref=bufs[ch].at[dst_slot],
+                        send_sem=psend[ch].at[dst_slot],
+                        recv_sem=precv[ch].at[dst_slot],
+                        device_id=dst_dev, device_id_type=LOGICAL).start()
+                # no wait here: the transfers overlap this whole round's
+                # sweep; the drain wait sits at the round's LAST grid step
+
+            src_banks = statics["src_banks0"] if ch == 0 else (1,)
+            if len(src_banks) == 1:
+                _emit(src_banks[0])
+            else:
+                for sb in src_banks:
+                    pl.when(sched_ref[r, sched_ir.SRC_BANK0] == sb)(
+                        functools.partial(_emit, sb))
 
     # ---- per-(round, batch, kv-head) chunk load: HBM -> VMEM, plus the
     # fp32 dk/dv accumulator carry (outputs double as the between-round
@@ -261,27 +374,40 @@ def _fused_bwd_kernel(
         lk.wait()
         lv.wait()
 
-    # ---- per-step bundle tile loads: slot HBM -> VMEM ----
-    tl = [
-        pltpu.make_async_copy(qbuf.at[slot, b_, h, i], q_t, tile_sem.at[0]),
-        pltpu.make_async_copy(dobuf.at[slot, b_, h, i], do_t, tile_sem.at[1]),
-        pltpu.make_async_copy(firstbuf.at[slot, b_, h, i], first_t,
-                              tile_sem.at[2]),
-        pltpu.make_async_copy(lsebuf.at[slot, b_, h, i], lse_t,
-                              tile_sem.at[3]),
-    ]
-    for c in tl:
-        c.start()
+    # ---- per-step bundle tile loads: slot HBM -> VMEM (started in the
+    # consume bank's branch, awaited unconditionally so the arriving-dq
+    # load below overlaps them) ----
+    for b in statics["consume_banks"]:
+        @pl.when(bank == b)
+        def _tile_start(b=b):
+            pltpu.make_async_copy(qbuf[b].at[slot, b_, h, i], q_t,
+                                  tile_sem.at[0]).start()
+            pltpu.make_async_copy(dobuf[b].at[slot, b_, h, i], do_t,
+                                  tile_sem.at[1]).start()
+            pltpu.make_async_copy(firstbuf[b].at[slot, b_, h, i], first_t,
+                                  tile_sem.at[2]).start()
+            pltpu.make_async_copy(lsebuf[b].at[slot, b_, h, i], lse_t,
+                                  tile_sem.at[3]).start()
 
-    # start the arriving-dq load early: it is only needed at the merge,
+    # start the arriving-dq loads early: they are only needed at the merge,
     # after the whole local sweep
-    @pl.when(r > 0)
+    @pl.when(sched_ref[r, sched_ir.DQ_RECV] == 1)
     def _dq_arr_start():
-        pltpu.make_async_copy(dqbuf.at[slot, b_, h, i], dq_arr,
-                              dqio_sem.at[0]).start()
+        def _s(b):
+            pltpu.make_async_copy(dqbuf[b].at[dq_slot, b_, h, i], dq_arr,
+                                  dqio_sem.at[0]).start()
 
-    for c in tl:
-        c.wait()
+        dq_banked(_s)
+
+    if has_dqi:
+        @pl.when(sched_ref[r, sched_ir.DQI_RECV] == 1)
+        def _dqi_arr_start():
+            pltpu.make_async_copy(
+                dqibuf.at[sched_ref[r, sched_ir.DQI_SLOT], b_, h, i],
+                dqi_arr, dqio_sem.at[2]).start()
+
+    for j, tile in enumerate((q_t, do_t, first_t, lse_t)):
+        dma_sem_wait(tile_sem.at[j], tile)
 
     # ---- local sweep over the resident chunk (no online softmax: p is
     # the true probability from the bundle's final lse) ----
@@ -342,47 +468,104 @@ def _fused_bwd_kernel(
         def _masked(c0=c0):
             _fold(c0, _block_mask(spec_r, r0, c0, bq, bkv))
 
-    # ---- dq merge: arriving partial (one hop behind) + local contribution,
-    # staged back into the slot and streamed onward immediately ----
-    @pl.when(r > 0)
+    # ---- dq merge: arriving partial (one hop behind) + local contribution
+    # (+ the held inter partial at double-ring boundaries), staged back into
+    # the slot and streamed onward immediately ----
+    @pl.when(sched_ref[r, sched_ir.DQ_RECV] == 1)
     def _dq_merge():
-        pltpu.make_async_copy(dqbuf.at[slot, b_, h, i], dq_arr,
-                              dqio_sem.at[0]).wait()
+        dma_sem_wait(dqio_sem.at[0], dq_arr)
         dq_scr[:] = dq_arr[:] + dq_scr[:] * scale
 
-    @pl.when(r == 0)
-    def _dq_init():
-        # round 0 starts this partition's accumulation: no arrival to merge
+    @pl.when(sched_ref[r, sched_ir.DQ_RECV] == 0)
+    def _dq_seed():
+        # this direction's ring starts here: no arrival to merge
         dq_scr[:] = dq_scr[:] * scale
 
-    wb = pltpu.make_async_copy(dq_scr, dqbuf.at[slot, b_, h, i],
-                               dqio_sem.at[1])
-    wb.start()
-    wb.wait()
+    if has_dqi:
+        @pl.when(sched_ref[r, sched_ir.DQI_RECV] == 1)
+        def _dqi_merge():
+            dma_sem_wait(dqio_sem.at[2], dqi_arr)
+            dq_scr[:] = dq_scr[:] + dqi_arr[:]
 
-    @pl.when(r < world - 1)
-    def _dq_send_ring():
-        # the concurrent dq stream: this block's partial leaves NOW, while
-        # later blocks of the same round are still computing — it lands in
-        # the right neighbor's slot[r+1] before its round r+1 first-step wait
-        dst_slot = sched_ref[r + 1, 5]
-        pltpu.make_async_remote_copy(
-            src_ref=dqbuf.at[slot, b_, h, i],
-            dst_ref=dqbuf.at[dst_slot, b_, h, i],
-            send_sem=dqsend.at[dst_slot], recv_sem=dqrecv.at[dst_slot],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+    def _wb(b):
+        wb = pltpu.make_async_copy(dq_scr, dqbuf[b].at[dq_slot, b_, h, i],
+                                   dqio_sem.at[1])
+        wb.start()
+        wb.wait()
 
-    @pl.when(r == world - 1)
-    def _dq_send_home():
-        # return-home hop: the fully-accumulated partition gradient lands in
-        # the right neighbor's dedicated HOME slot (its owner)
-        pltpu.make_async_remote_copy(
-            src_ref=dqbuf.at[slot, b_, h, i],
-            dst_ref=dqbuf.at[home, b_, h, i],
-            send_sem=home_sem.at[0], recv_sem=home_sem.at[1],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+    dq_banked(_wb)
+
+    for b in dq_statics["ring_banks"]:
+        @pl.when((dq_kind == sched_ir.DQ_RING) & (dq_bank_c == b))
+        def _dq_send_ring(b=b):
+            # the concurrent dq stream: this block's partial leaves NOW,
+            # while later blocks of the same round are still computing —
+            # it lands in the bank's direction neighbor before that
+            # neighbor's next serving round needs it
+            dst_slot = sched_ref[r, sched_ir.DQ_DST_SLOT]
+            if hw_sync and b in dq_statics["take_banks"] \
+                    and prog.topology != "double":
+                @pl.when(first_of_round
+                         & (sched_ref[r, sched_ir.DQ_TAKE0 if b == 0 else
+                                      sched_ir.DQ_TAKE1] == 1))
+                def _cap():
+                    pltpu.semaphore_wait(free_dq[b].at[dst_slot], 1)
+            pltpu.make_async_remote_copy(
+                src_ref=dqbuf[b].at[dq_slot, b_, h, i],
+                dst_ref=dqbuf[b].at[dst_slot, b_, h, i],
+                send_sem=dqsend[b].at[dst_slot],
+                recv_sem=dqrecv[b].at[dst_slot],
+                device_id=sched_ref[R, _SENDC[b][4]],
+                device_id_type=LOGICAL).start()
+
+    if hw_sync and prog.topology == "double" and 0 in \
+            dq_statics["take_banks"]:
+        # double ring: the intra dq stream's takes (DQ_TAKE0) ride the
+        # ring-send rounds; emitted once at the round's first step
+        @pl.when(first_of_round & (sched_ref[r, sched_ir.DQ_TAKE0] == 1))
+        def _dq_cap_double():
+            pltpu.semaphore_wait(
+                free_dq[0].at[sched_ref[r, sched_ir.DQ_DST_SLOT]], 1)
+
+    for b in home_banks:
+        kinds = (sched_ir.DQ_HOME,) if prog.topology != "double" else \
+            (sched_ir.DQ_FINAL,)
+
+        @pl.when((dq_kind == kinds[0]) & (dq_bank_c == (b if prog.topology
+                                                        != "double" else 0)))
+        def _dq_send_home(b=b):
+            # return-home hop: the completed partial lands in its OWNER's
+            # dedicated home slot (index dq_slots[b], outside the ring
+            # cycle) — one direct RDMA, `home_offsets[b]` positions away
+            home_idx = prog.dq_slots[b if prog.topology != "double" else 0]
+            pltpu.make_async_remote_copy(
+                src_ref=dqbuf[b if prog.topology != "double" else 0]
+                .at[dq_slot, b_, h, i],
+                dst_ref=dqbuf[b if prog.topology != "double" else 0]
+                .at[home_idx, b_, h, i],
+                send_sem=home_sems[b].at[0], recv_sem=home_sems[b].at[1],
+                device_id=sched_ref[R, sched_ir.META_HOME0 if b == 0
+                                    else sched_ir.META_HOME1],
+                device_id_type=LOGICAL).start()
+
+    if has_dqi:
+        @pl.when(dq_kind == sched_ir.DQ_BOUNDARY)
+        def _dq_send_boundary():
+            # cycle boundary: the folded (inter_held + cycle partial) block
+            # hops one inter step into the ping/pong accumulator bank
+            dst_slot = sched_ref[r, sched_ir.DQI_DST_SLOT]
+            if hw_sync and 1 in dq_statics["take_banks"]:
+                @pl.when(first_of_round
+                         & (sched_ref[r, sched_ir.DQ_TAKE1] == 1))
+                def _cap():
+                    pltpu.semaphore_wait(free_dqi.at[dst_slot], 1)
+            pltpu.make_async_remote_copy(
+                src_ref=dqbuf[0].at[dq_slot, b_, h, i],
+                dst_ref=dqibuf.at[dst_slot, b_, h, i],
+                send_sem=dqi_send.at[dst_slot],
+                recv_sem=dqi_recv.at[dst_slot],
+                device_id=sched_ref[R, sched_ir.META_CH1_DST],
+                device_id_type=LOGICAL).start()
 
     # ---- dk/dv segment epilogue: stage the fp32 accumulators back to the
     # output buffers (final at the last round, with ds's deferred scale) ----
@@ -390,7 +573,7 @@ def _fused_bwd_kernel(
     def _kv_store():
         kvh = h // group
 
-        @pl.when(r == world - 1)
+        @pl.when(r == R - 1)
         def _final_scale():
             dk_acc[:] = dk_acc[:] * scale
 
@@ -402,35 +585,78 @@ def _fused_bwd_kernel(
         sv.wait()
 
     # ---- round epilogue (last grid step of the round only) ----
-    @pl.when(last_of_round & (r < world - 1))
-    def _send_drain():
-        # outgoing RDMA read slot[r]; everything must be out the door before
-        # the left neighbor may overwrite the slots (free credits below) and
-        # before the kernel may exit with a live DMA
-        dst_slot = sched_ref[r + 1, 5]
-        pltpu.semaphore_wait(psend.at[dst_slot], 4)
-        pltpu.semaphore_wait(dqsend.at[dst_slot], n_steps)
+    for ch in statics["ch_active"]:
+        send_c, _, dst_c, _, _ = _SENDC[ch]
 
-    @pl.when(last_of_round & (r == world - 1))
+        @pl.when(last_of_round & (sched_ref[r, send_c] == 1))
+        def _bundle_drain(ch=ch, dst_c=dst_c):
+            dst_slot = sched_ref[r, dst_c]
+            for bufs in (firstbuf, dobuf, qbuf, lsebuf):
+                dma_sem_wait(psend[ch].at[dst_slot], bufs[ch].at[dst_slot])
+
+    for b in dq_statics["ring_banks"]:
+        @pl.when(last_of_round & (dq_kind == sched_ir.DQ_RING)
+                 & (dq_bank_c == b))
+        def _dq_drain(b=b):
+            ds_ = sched_ref[r, sched_ir.DQ_DST_SLOT]
+            dma_sem_wait(dqsend[b].at[ds_], dqbuf[b].at[ds_])
+
+    if has_dqi:
+        @pl.when(last_of_round & (dq_kind == sched_ir.DQ_BOUNDARY))
+        def _dqi_drain():
+            ds_ = sched_ref[r, sched_ir.DQI_DST_SLOT]
+            dma_sem_wait(dqi_send.at[ds_], dqibuf.at[ds_])
+
+    for b in home_banks:
+        send_round = dq_statics["home_rounds"][b]
+
+        @pl.when(last_of_round & (r == send_round))
+        def _home_drain(b=b):
+            # our outgoing home blocks must be out the door before exit;
+            # the n_steps block sends sum to one home-slot entry
+            src_bank = b if prog.topology != "double" else 0
+            home_idx = prog.dq_slots[src_bank]
+            dma_sem_wait(home_sems[b].at[0], dqbuf[src_bank].at[home_idx])
+
+    @pl.when(last_of_round & (r == R - 1))
     def _home_epilogue():
-        # drain our own return-home sends, wait for the left neighbor's
-        # full set of home blocks, then land the HOME slot in the output
-        pltpu.semaphore_wait(home_sem.at[0], n_steps)
-        pltpu.semaphore_wait(home_sem.at[1], n_steps)
-        cp = pltpu.make_async_copy(dqbuf.at[home], dq_ref, cp_sem.at[0])
-        cp.start()
-        cp.wait()
+        # wait every home bank's arrivals, then land each home slot in its
+        # own dq output (multiple partials are summed OUTSIDE the kernel —
+        # one jnp add against one extra output, instead of a block loop in
+        # the final grid step)
+        for j, b in enumerate(home_banks):
+            src_bank = b if prog.topology != "double" else 0
+            home_idx = prog.dq_slots[src_bank]
+            dma_sem_wait(home_sems[b].at[1], dqbuf[src_bank].at[home_idx])
+            cp = pltpu.make_async_copy(dqbuf[src_bank].at[home_idx],
+                                       dq_refs[j], cp_sem.at[j])
+            cp.start()
+            cp.wait()
 
     if hw_sync:
-        @pl.when(last_of_round & (r <= world - 1 - slots))
-        def _grant_free():
-            # slot[r] of both streams has no further readers here: every
-            # grid step consumed its tiles, our onward sends drained — the
-            # LEFT neighbor (writer of our slots) may target them again
-            pltpu.semaphore_signal(free_pay, inc=1, device_id=left,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
-            pltpu.semaphore_signal(free_dq, inc=1, device_id=left,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+        for b in statics["grant_banks"]:
+            grant_c, meta_src = _GRANTC[b]
+
+            @pl.when(last_of_round & (sched_ref[r, grant_c] > 0))
+            def _grant_pay(b=b, grant_c=grant_c, meta_src=meta_src):
+                pltpu.semaphore_signal(
+                    free_pay[b].at[sched_ref[r, grant_c] - 1], inc=1,
+                    device_id=sched_ref[R, meta_src],
+                    device_id_type=LOGICAL)
+
+        for b in dq_statics["grant_banks"]:
+            grant_c = sched_ir.DQ_GRANT0 if b == 0 else sched_ir.DQ_GRANT1
+            is_dqi = has_dqi and b == 1
+
+            @pl.when(last_of_round & (sched_ref[r, grant_c] > 0))
+            def _grant_dq(b=b, grant_c=grant_c, is_dqi=is_dqi):
+                # the dq bank's writer is its channel's upstream neighbor
+                sem = free_dqi if is_dqi else free_dq[b]
+                meta_src = _GRANTC[1 if is_dqi else b][1]
+                pltpu.semaphore_signal(
+                    sem.at[sched_ref[r, grant_c] - 1], inc=1,
+                    device_id=sched_ref[R, meta_src],
+                    device_id_type=LOGICAL)
 
 
 # ---------------------------------------------------------------------------
@@ -446,25 +672,37 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
     [B, Nk, S, D], lse [B, N, S] f32 (the forward residuals in layout
     order).  Returns (dq, dk, dv) in float32 — the caller casts back to
     the input dtypes, exactly like the scan backward — plus the kernel's
-    [1, slots] int32 bundle slot-consume counters when `collect_stats`
-    (the devstats bwd slot-reuse channel; the stats-off call emits the
-    identical kernel with no extra output).  Callers must have checked
+    [n_banks, slots] int32 bundle slot-consume counters when
+    `collect_stats` (the devstats bwd slot-reuse channel, one row per
+    direction bank).  Callers must have checked
     `fused_ring.supported(..., pass_="bwd")` first.
     """
+    from .fused_ring import hw_trace_forced, resolve_topology, _compile_for
+
     b, n, s, d = q.shape
     n_kv = k.shape[1]
     assert n % n_kv == 0, f"GQA needs Nq % Nk == 0, got {n} % {n_kv}"
     group = n // n_kv
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = jax.default_backend() != "tpu" and not hw_trace_forced()
     scale = cfg.scale if cfg.scale is not None else d ** -0.5
-    world = axis_size(cfg.intra_axis)
+    n_intra_ax = axis_size(cfg.intra_axis)
+    n_inter_ax = (axis_size(cfg.inter_axis)
+                  if cfg.inter_axis is not None else 1)
+    topology, t_inter, t_intra = resolve_topology(cfg, n_intra_ax,
+                                                  n_inter_ax)
+    prog = _compile_for(cfg, topology, t_inter, t_intra, "bwd")
+    statics = kernel_statics(prog)
+    dq_statics = bwd_statics(prog)
+    R = prog.n_rounds
     rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
                        cfg.fused_kv_slots,
                        block_q_bwd=cfg.fused_block_q_bwd,
                        block_kv_bwd=cfg.fused_block_kv_bwd,
-                       bwd_slots=cfg.fused_bwd_slots)
-    slots = min(rf.bwd_slots, world)
+                       bwd_slots=cfg.fused_bwd_slots,
+                       ccw_slots=getattr(cfg, "fused_ccw_slots", None),
+                       bwd_ccw_slots=getattr(cfg, "fused_bwd_ccw_slots",
+                                             None))
     bq = _pick_block(s, rf.block_q_bwd)
     bkv = _pick_block(s, rf.block_kv_bwd)
     lp = _pick_block(bq, 128)
@@ -472,24 +710,9 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
     rows = bq // lp
     nkb = s // bkv
 
-    # [world + 1, 6] schedule table (see _fused_bwd_kernel docstring): mask
-    # scalars reuse the SAME per-round specs the scan backward computes —
-    # q side = rotating bundle partition, kv side = resident local chunk
-    part_me = my_partition(cfg.intra_axis, None)
-    slot_sched = fused_bwd_slot_schedule(world, slots)
-    table = []
-    for r in range(world):
-        sp = round_spec(partition_at_round(r, cfg.intra_axis, None), part_me,
-                        s, s, cfg.causal, cfg.layout)
-        table.append(jnp.concatenate(
-            [_spec_array(sp),
-             jnp.asarray([int(slot_sched[r])], jnp.int32)]))
-    me, right, left = neighbor_ids(cfg.intra_axis)
-    table.append(jnp.stack([jnp.asarray(me, jnp.int32),
-                            jnp.asarray(right, jnp.int32),
-                            jnp.asarray(left, jnp.int32),
-                            jnp.int32(0), jnp.int32(0), jnp.int32(0)]))
-    sched = jnp.stack(table)
+    # mask scalars with q/kv roles swapped: q side = rotating bundle
+    # partition, kv side = resident local chunk
+    sched, _specs = build_sched_table(cfg, prog, s, s, swap_roles=True)
 
     # bundle operands, pre-blocked so every slot/tile address is integer
     # indexing ([B, N, nqb, bq, D] is the same memory as [B, N, S, D]);
@@ -513,69 +736,107 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
         first_dtype = o.dtype
 
     kernel = functools.partial(
-        _fused_bwd_kernel, world=world, slots=slots, scale=scale, bq=bq,
-        bkv=bkv, lp=lp, nqb=nqb, nkb=nkb, group=group, n_b=b, n_h=n,
-        hw_sync=not interpret, collect=collect_stats,
-        opt_comm=cfg.optimize_bwd_comm,
+        _fused_bwd_kernel, prog=prog, statics=statics,
+        dq_statics=dq_statics, scale=scale, bq=bq, bkv=bkv, lp=lp, nqb=nqb,
+        nkb=nkb, group=group, n_b=b, n_h=n, hw_sync=not interpret,
+        collect=collect_stats, opt_comm=cfg.optimize_bwd_comm,
     )
 
-    out_specs = [
-        pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # dq
+    home_banks = sorted(dq_statics["home_rounds"])
+    dq_ring_banks = prog.n_dq_banks if topology != "double" else 1
+    has_dqi = dq_statics["has_dqi"]
+
+    out_specs = [pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
+                 for _ in home_banks]                      # dq partial(s)
+    out_specs += [
         pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # dk
         pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # dv
     ]
-    out_shape = [
-        jax.ShapeDtypeStruct((b, n, nqb, bq, d), jnp.float32),
+    out_shape = [jax.ShapeDtypeStruct((b, n, nqb, bq, d), jnp.float32)
+                 for _ in home_banks]
+    out_shape += [
         jax.ShapeDtypeStruct((b, n_kv, s, d), jnp.float32),
         jax.ShapeDtypeStruct((b, n_kv, s, d), jnp.float32),
     ]
     if collect_stats:
         out_specs.append(
             pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.SMEM))
-        out_shape.append(jax.ShapeDtypeStruct((1, slots), jnp.int32))
+        out_shape.append(jax.ShapeDtypeStruct(
+            (prog.n_banks, max(prog.slots)), jnp.int32))
+
+    scratch = []
+    for bank in range(prog.n_banks):
+        sl = prog.slots[bank]
+        scratch += [
+            pltpu.ANY((sl,) + first_slot_shape, first_dtype),   # firstbuf
+            pltpu.ANY((sl, b, n, nqb, bq, d), do.dtype),        # dobuf
+            pltpu.ANY((sl, b, n, nqb, bq, d), q.dtype),         # qbuf
+            pltpu.ANY((sl, b, n, nqb, rows, lp), jnp.float32),  # lsebuf
+        ]
+    for bank in range(dq_ring_banks):
+        # ring slots + (when this bank receives a home stream) the
+        # dedicated return-home slot just past them
+        extra = 1 if bank in home_banks or topology == "double" else 0
+        scratch.append(pltpu.ANY(
+            (prog.dq_slots[bank] + extra, b, n, nqb, bq, d), jnp.float32))
+    if has_dqi:
+        scratch.append(pltpu.ANY((prog.dq_slots[1], b, n, nqb, bq, d),
+                                 jnp.float32))              # dqibuf
+    scratch += [
+        pltpu.VMEM((s, d), k.dtype),                  # kchunk
+        pltpu.VMEM((s, d), v.dtype),                  # vchunk
+        pltpu.VMEM((s, d), jnp.float32),              # dk_acc
+        pltpu.VMEM((s, d), jnp.float32),              # dv_acc
+        pltpu.VMEM((bq, d), q.dtype),                 # q_t
+        pltpu.VMEM((bq, d), do.dtype),                # do_t
+        pltpu.VMEM(first_tile_shape, first_dtype),    # first_t
+        pltpu.VMEM((rows, lp), jnp.float32),          # lse_t
+        pltpu.VMEM((bq, d), jnp.float32),             # dq_arr
+        pltpu.VMEM((bq, d), jnp.float32),             # dqi_arr
+        pltpu.VMEM((bq, d), jnp.float32),             # dq_scr
+        pltpu.SemaphoreType.DMA((max(4 * len(prog.copy_in),
+                                     len(home_banks)),)),  # cp_sem
+        pltpu.SemaphoreType.DMA((2,)),                # chunk_sem
+        pltpu.SemaphoreType.DMA((4,)),                # kvio_sem
+        pltpu.SemaphoreType.DMA((4,)),                # tile_sem
+        pltpu.SemaphoreType.DMA((3,)),                # dqio_sem
+    ]
+    for bank in range(prog.n_banks):
+        sl = prog.slots[bank]
+        scratch += [
+            pltpu.SemaphoreType.DMA((sl,)),           # psend[bank]
+            pltpu.SemaphoreType.DMA((sl,)),           # precv[bank]
+            pltpu.SemaphoreType.REGULAR((sl,)),       # free_pay[bank]
+        ]
+    for bank in range(dq_ring_banks):
+        sl = prog.dq_slots[bank]
+        scratch += [
+            pltpu.SemaphoreType.DMA((sl,)),           # dqsend[bank]
+            pltpu.SemaphoreType.DMA((sl,)),           # dqrecv[bank]
+            pltpu.SemaphoreType.REGULAR((sl,)),       # free_dq[bank]
+        ]
+    if has_dqi:
+        scratch += [
+            pltpu.SemaphoreType.DMA((prog.dq_slots[1],)),   # dqi_send
+            pltpu.SemaphoreType.DMA((prog.dq_slots[1],)),   # dqi_recv
+            pltpu.SemaphoreType.REGULAR((prog.dq_slots[1],)),  # free_dqi
+        ]
+    for _ in home_banks:
+        scratch.append(pltpu.SemaphoreType.DMA((2,)))  # home_sems[b]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(world, b, n, nqb),
+        grid=(R, b, n, nqb),
         in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)] * 6,
         out_specs=out_specs,
-        scratch_shapes=[
-            pltpu.ANY((slots,) + first_slot_shape, first_dtype),  # firstbuf
-            pltpu.ANY((slots, b, n, nqb, bq, d), do.dtype),       # dobuf
-            pltpu.ANY((slots, b, n, nqb, bq, d), q.dtype),        # qbuf
-            pltpu.ANY((slots, b, n, nqb, rows, lp), jnp.float32),  # lsebuf
-            # dq ring slots + the dedicated return-home slot (index `slots`)
-            pltpu.ANY((slots + 1, b, n, nqb, bq, d), jnp.float32),  # dqbuf
-            pltpu.VMEM((s, d), k.dtype),                  # kchunk
-            pltpu.VMEM((s, d), v.dtype),                  # vchunk
-            pltpu.VMEM((s, d), jnp.float32),              # dk_acc
-            pltpu.VMEM((s, d), jnp.float32),              # dv_acc
-            pltpu.VMEM((bq, d), q.dtype),                 # q_t
-            pltpu.VMEM((bq, d), do.dtype),                # do_t
-            pltpu.VMEM(first_tile_shape, first_dtype),    # first_t
-            pltpu.VMEM((rows, lp), jnp.float32),          # lse_t
-            pltpu.VMEM((bq, d), jnp.float32),             # dq_arr
-            pltpu.VMEM((bq, d), jnp.float32),             # dq_scr
-            pltpu.SemaphoreType.DMA((4,)),                # cp_sem
-            pltpu.SemaphoreType.DMA((2,)),                # chunk_sem
-            pltpu.SemaphoreType.DMA((4,)),                # kvio_sem
-            pltpu.SemaphoreType.DMA((4,)),                # tile_sem
-            pltpu.SemaphoreType.DMA((2,)),                # dqio_sem
-            pltpu.SemaphoreType.DMA((slots,)),            # psend
-            pltpu.SemaphoreType.DMA((slots,)),            # precv
-            pltpu.SemaphoreType.DMA((slots,)),            # dqsend
-            pltpu.SemaphoreType.DMA((slots,)),            # dqrecv
-            pltpu.SemaphoreType.DMA((2,)),                # home_sem
-            pltpu.SemaphoreType.REGULAR,                  # free_pay
-            pltpu.SemaphoreType.REGULAR,                  # free_dq
-        ],
+        scratch_shapes=scratch,
     )
     outs = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=out_shape,
         # sequential by construction: the ring choreography, the VMEM
-        # dk/dv accumulators and the dq stream all assume one core walks
+        # dk/dv accumulators and the dq streams all assume one core walks
         # the grid in order — a megacore split would race them
         compiler_params=tpu_compiler_params(
             vmem_limit_bytes=VMEM_LIMIT,
@@ -584,7 +845,14 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
         ),
         interpret=interpret,
     )(sched, first_in, do_in, q_in, lse_in, k, v)
-    dq = outs[0].reshape(b, n, s, d)
+    # a bidi owner receives its gradient as two complementary directional
+    # partials; the sum is one fused XLA add — everything else already
+    # happened in-kernel
+    dq = outs[0]
+    for j in range(1, len(home_banks)):
+        dq = dq + outs[j]
+    dq = dq.reshape(b, n, s, d)
+    dk, dv = outs[len(home_banks)], outs[len(home_banks) + 1]
     if not collect_stats:
-        return dq, outs[1], outs[2]
-    return dq, outs[1], outs[2], outs[3]
+        return dq, dk, dv
+    return dq, dk, dv, outs[len(home_banks) + 2]

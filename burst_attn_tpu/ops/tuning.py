@@ -61,6 +61,16 @@ class BlockTable(NamedTuple):
     fused_bwd_slots: int = 2
     fused_block_q_bwd: int = 256
     fused_block_kv_bwd: int = 512
+    # Counter-rotating (bidi) / double-ring second bank: slots of the ccw
+    # direction (bidi) or the inter prefetch bank (double ring; the
+    # compiler clamps it to the cycle count).  Per ISSUE 6 these are
+    # per-DIRECTION knobs: the two ICI directions can be tuned
+    # independently when one carries more traffic (e.g. a torus wraparound
+    # link shared with another ring).  Estimated until swept on hardware
+    # (benchmarks/ring_overlap.py --topology bidi reports per-direction
+    # comm floors to retune).
+    fused_ccw_slots: int = 2
+    fused_bwd_ccw_slots: int = 2
 
 
 class ResolvedBlocks(NamedTuple):
@@ -219,11 +229,14 @@ class ResolvedFused(NamedTuple):
     block_q_bwd: int
     block_kv_bwd: int
     bwd_slots: int
+    ccw_slots: int
+    bwd_ccw_slots: int
 
 
 def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
                   device=None, block_q_bwd=None, block_kv_bwd=None,
-                  bwd_slots=None) -> ResolvedFused:
+                  bwd_slots=None, ccw_slots=None,
+                  bwd_ccw_slots=None) -> ResolvedFused:
     """Fill the fused ring kernels' knobs from the per-generation table.
 
     kv_slots / bwd_slots < 2 cannot double-buffer (the send target would
@@ -231,7 +244,9 @@ def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
     bumped — an explicit wrong config should fail loudly, only the table
     default is implicit.  The bwd blocks never default LARGER than the
     (resolved) fwd blocks, mirroring resolve_blocks: a caller who tunes
-    the fwd blocks down for VMEM keeps that budget in the backward."""
+    the fwd blocks down for VMEM keeps that budget in the backward.
+    ccw_slots / bwd_ccw_slots tune the SECOND slot bank (the ccw direction
+    of a bidi ring, or the double ring's inter prefetch bank) per pass."""
     t = block_defaults(device)
     bq = t.fused_block_q if block_q is None else block_q
     bkv = t.fused_block_kv if block_kv is None else block_kv
@@ -240,12 +255,20 @@ def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
     bkvb = (min(t.fused_block_kv_bwd, bkv) if block_kv_bwd is None
             else block_kv_bwd)
     bslots = t.fused_bwd_slots if bwd_slots is None else bwd_slots
+    cslots = t.fused_ccw_slots if ccw_slots is None else ccw_slots
+    bcslots = (t.fused_bwd_ccw_slots if bwd_ccw_slots is None
+               else bwd_ccw_slots)
     if slots < 2:
         raise ValueError(f"fused ring needs kv_slots >= 2, got {slots}")
     if bslots < 2:
         raise ValueError(f"fused ring bwd needs bwd_slots >= 2, got {bslots}")
+    if cslots < 2:
+        raise ValueError(f"fused ring needs ccw_slots >= 2, got {cslots}")
+    if bcslots < 2:
+        raise ValueError(
+            f"fused ring bwd needs bwd_ccw_slots >= 2, got {bcslots}")
     return ResolvedFused(bq, bkv, slots, t.fused_vmem_budget,
-                         bqb, bkvb, bslots)
+                         bqb, bkvb, bslots, cslots, bcslots)
 
 
 def resolve_blocks(block_q=None, block_kv=None, block_q_bwd=None,

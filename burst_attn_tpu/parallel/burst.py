@@ -121,6 +121,27 @@ class BurstConfig:
     fused_bwd_slots: Optional[int] = None
     fused_block_q_bwd: Optional[int] = None
     fused_block_kv_bwd: Optional[int] = None
+    # Schedule-IR topology selection (parallel/schedule.py): "auto" = uni
+    # on a flat ring, double when an inter axis (or fused_seq_factor) is
+    # present; "bidi" opts the flat ring into the counter-rotating
+    # bidirectional schedule (both ICI directions, per-direction slot
+    # banks; worlds < 3 degrade to uni).  fused_seq_factor = (n_inter,
+    # n_intra) grids the DOUBLE-ring schedule onto a flat ring axis
+    # (inter-major device order) — the schedule the reference's
+    # hierarchical ring runs, without needing a second mesh axis.
+    fused_topology: str = "auto"
+    fused_seq_factor: Optional[Tuple[int, int]] = None
+    # per-direction slot knobs for the second bank (bidi ccw / double
+    # inter prefetch); None = the per-generation table (ops/tuning.py)
+    fused_ccw_slots: Optional[int] = None
+    fused_bwd_ccw_slots: Optional[int] = None
+    # Ordered ((axis name, size), ...) of ALL mesh axes, host-filled by
+    # burst_attn: the fused kernels compute full LOGICAL RDMA ids from it
+    # (parallel/ring.device_roles), which is what makes multi-axis
+    # (pp x tp x sp) meshes safe to fuse.  None = the ring axes are the
+    # only axes in scope (direct burst_attn_shard users on bigger meshes
+    # fall back to the scan ring unless they fill this in).
+    mesh_axes: Optional[Tuple[Tuple[str, int], ...]] = None
     # Structural causal scheduling (reference burst_attn_interface.py:221-235,
     # :303-367): zigzag rounds dispatch through a 3-way lax.cond whose
     # branches run statically-sliced dense tiles (full q x half kv / half q x
@@ -144,6 +165,21 @@ class BurstConfig:
                 raise ValueError("window attention requires causal=True")
             if self.window < 1:
                 raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.fused_topology not in ("auto", "uni", "bidi", "double"):
+            raise ValueError(
+                f"fused_topology must be auto|uni|bidi|double, got "
+                f"{self.fused_topology!r}")
+        if self.fused_seq_factor is not None:
+            f = tuple(self.fused_seq_factor)
+            if len(f) != 2 or any(x < 1 for x in f):
+                raise ValueError(
+                    f"fused_seq_factor must be (n_inter, n_intra) positive "
+                    f"ints, got {self.fused_seq_factor!r}")
+            object.__setattr__(self, "fused_seq_factor", f)
+        if self.mesh_axes is not None:
+            object.__setattr__(self, "mesh_axes",
+                               tuple((str(a), int(sz))
+                                     for a, sz in self.mesh_axes))
 
     def resolved_blocks(self):
         """ResolvedBlocks with None fields filled from the
@@ -777,15 +813,22 @@ _burst_attn_shard_stats_seg.defvjp(_stats_seg_vjp_fwd, _stats_seg_vjp_bwd)
 
 # (reason-string prefix -> bounded label) for burst.fused_fallback: the
 # supported() reasons embed shapes/budgets, which would explode counter
-# cardinality if used as labels verbatim
+# cardinality if used as labels verbatim.  Since the schedule-IR refactor
+# the "double ring" and generic multi-axis rows only exist as
+# interpret-mode emulation limits (labelled interpret-*); on hardware both
+# trace fused.
 _FALLBACK_LABELS = (
     ("off-TPU", "off-tpu"),
-    ("double ring", "double-ring"),
+    ("interpret-mode remote DMA", "interpret-single-axis"),
+    ("double ring inter axis", "double-ring-axis-unbound"),
     ("sliding window", "window"),
     ("packed segments", "segments"),
     ("cross-attention", "cross-attn"),
     ("world < 2", "world-lt-2"),
-    ("ring axis", "multi-axis"),
+    ("ring axis", "multi-axis-no-mesh"),
+    ("axis env unavailable", "axis-env-unavailable"),
+    ("topology config invalid", "topology-invalid"),
+    ("schedule compiler declined", "schedule-compiler"),
     ("VMEM plan", "vmem-budget"),
 )
 
@@ -833,7 +876,7 @@ def _note_dispatch(cfg: BurstConfig, mesh, q_shape, k_shape, has_seg: bool,
     path, reason = "scan", None
     if cfg.backend == "fused_ring":
         reason = fused_ring.supported(cfg, q_local, k_local, has_seg,
-                                      world=n_intra,
+                                      world=n_intra, n_inter=n_inter,
                                       extra_axes=extra_b + extra_h)
         path = "fused" if reason is None else "scan"
         # the backward runs its own gate at _bwd_impl's dispatch point; a
@@ -841,7 +884,7 @@ def _note_dispatch(cfg: BurstConfig, mesh, q_shape, k_shape, has_seg: bool,
         # fwd fits) must be distinguishable in obs output, so the fallback
         # counter is labeled by pass
         reason_bwd = fused_ring.supported(cfg, q_local, k_local, has_seg,
-                                          world=n_intra,
+                                          world=n_intra, n_inter=n_inter,
                                           extra_axes=extra_b + extra_h,
                                           pass_="bwd")
         if reason_bwd is not None:
@@ -900,6 +943,10 @@ def burst_attn(
     fused_bwd_slots: Optional[int] = None,
     fused_block_q_bwd: Optional[int] = None,
     fused_block_kv_bwd: Optional[int] = None,
+    fused_topology: str = "auto",
+    fused_seq_factor: Optional[Tuple[int, int]] = None,
+    fused_ccw_slots: Optional[int] = None,
+    fused_bwd_ccw_slots: Optional[int] = None,
     collect_stats: bool = False,
 ) -> jax.Array:
     """Burst attention on global arrays [B, N, S, D]; S must already be in
@@ -952,6 +999,13 @@ def burst_attn(
         fused_bwd_slots=fused_bwd_slots,
         fused_block_q_bwd=fused_block_q_bwd,
         fused_block_kv_bwd=fused_block_kv_bwd,
+        fused_topology=fused_topology,
+        fused_seq_factor=fused_seq_factor,
+        fused_ccw_slots=fused_ccw_slots,
+        fused_bwd_ccw_slots=fused_bwd_ccw_slots,
+        # the host knows the mesh's full axis order: the fused kernels
+        # compute multi-axis LOGICAL RDMA ids from it (ring.device_roles)
+        mesh_axes=tuple((str(a), int(sz)) for a, sz in mesh.shape.items()),
     )
     _note_dispatch(cfg, mesh, q.shape, k.shape, segment_ids is not None,
                    batch_axes, head_axes)

@@ -57,11 +57,20 @@ def ring_round_counts(n_inter: int, n_intra: int, r_live=None):
     `r_live` live rounds (parallel/burst._r_live) — r_live-1 KV hops.
     Double ring: every cycle runs n_intra rounds with n_intra-1 intra hops
     (the last round of a cycle consumes without sending), plus one
-    prefetched inter hop per cycle boundary."""
+    prefetched inter hop per cycle boundary.
+
+    Derived from the schedule IR's scan lowering (parallel/schedule):
+    the counts reported here are the hop totals of the same compiled
+    program burstlint simulation-proves, not a hand-kept formula."""
+    from . import schedule
+
     if n_inter == 1:
         live = n_intra if r_live is None else r_live
-        return live, live - 1, 0
-    return n_inter * n_intra, n_inter * (n_intra - 1), n_inter - 1
+        prog = schedule.compile_fwd("uni", n_intra, r_live=live)
+    else:
+        prog = schedule.compile_fwd("double", n_intra, n_inter)
+    totals = schedule.hop_totals(prog)
+    return prog.n_rounds, totals["intra"], totals["inter"]
 
 
 def axis_ranks(intra_axis: str, inter_axis):
@@ -117,6 +126,96 @@ def neighbor_ids(axis_name: str):
     return me, (me + 1) % n, (me - 1) % n
 
 
+def device_roles(intra_axis: str, inter_axis=None, mesh_axes=None,
+                 factor=None, home_offsets=()):
+    """Traced LOGICAL device ids for the fused kernels' RDMA targets.
+
+    Mosaic linearizes LOGICAL ids over the mesh's axis order (row-major
+    strides over `mesh.axis_names`), so on a multi-axis mesh a neighbor id
+    must be computed from EVERY axis index, varying only the ring
+    coordinate — that is the structural proof that extra (batch/head/pp)
+    axes never alias ring traffic, and what lets the fused kernels run on
+    pp×tp×sp meshes.  `mesh_axes` is the host-provided ordered
+    ((name, size), ...) of all mesh axes (burst_attn passes
+    mesh.shape.items()); None = the ring axes are the only axes in scope
+    (the legacy single-axis contract).  `factor` = (n_inter, n_intra)
+    grids a DOUBLE-ring schedule onto a flat ring axis (inter-major) when
+    no separate inter axis exists.  Returns a dict of traced int32 ids:
+    me, cw_dst/cw_src (intra ring right/left), ccw_dst/ccw_src, and —
+    when an inter dimension exists — inter_dst/inter_src; `home{i}` ids
+    for each requested (inter_off, intra_off) in `home_offsets`.
+    """
+    if mesh_axes is None:
+        mesh_axes = ((intra_axis, axis_size(intra_axis)),)
+        if inter_axis is not None:
+            mesh_axes = ((inter_axis, axis_size(inter_axis)),) + mesh_axes
+    sizes = [int(sz) for _, sz in mesh_axes]
+    strides = [1] * len(sizes)
+    for a in range(len(sizes) - 2, -1, -1):
+        strides[a] = strides[a + 1] * sizes[a + 1]
+    idx = {name: lax.axis_index(name) for name, _ in mesh_axes}
+    me = jnp.int32(0)
+    for (name, _), st in zip(mesh_axes, strides):
+        me = me + idx[name] * jnp.int32(st)
+    names = [name for name, _ in mesh_axes]
+    ai = names.index(intra_axis)
+    st_intra, n_intra_ax = strides[ai], sizes[ai]
+
+    def _with_intra(new_idx):
+        return me + (new_idx - idx[intra_axis]) * jnp.int32(st_intra)
+
+    if factor is not None:
+        n_i, n_s = factor
+        if n_i * n_s != n_intra_ax:
+            raise ValueError(
+                f"factor {factor} does not tile the ring axis "
+                f"({n_intra_ax} devices)")
+        flat = idx[intra_axis]
+        ii, si = flat // n_s, flat % n_s
+
+        def ring_id(di, ds):
+            return _with_intra(((ii + di) % n_i) * n_s + (si + ds) % n_s)
+    elif inter_axis is not None:
+        bi = names.index(inter_axis)
+        st_inter, n_i = strides[bi], sizes[bi]
+        n_s = n_intra_ax
+
+        def ring_id(di, ds):
+            out = _with_intra((idx[intra_axis] + ds) % n_s)
+            return out + (((idx[inter_axis] + di) % n_i)
+                          - idx[inter_axis]) * jnp.int32(st_inter)
+    else:
+        n_i, n_s = 1, n_intra_ax
+
+        def ring_id(di, ds):
+            return _with_intra((idx[intra_axis] + ds) % n_s)
+
+    roles = {
+        "me": me,
+        "cw_dst": ring_id(0, 1), "cw_src": ring_id(0, -1),
+        "ccw_dst": ring_id(0, -1), "ccw_src": ring_id(0, 1),
+        "inter_dst": ring_id(1, 0), "inter_src": ring_id(-1, 0),
+    }
+    for j, (h_i, h_s) in enumerate(home_offsets):
+        roles[f"home{j}"] = ring_id(h_i, h_s)
+    return {k: jnp.asarray(v, jnp.int32) for k, v in roles.items()}
+
+
+def ring_coords(intra_axis: str, inter_axis=None, factor=None):
+    """Traced (inter_rank, intra_rank, n_inter, n_intra) of this device's
+    position in the (possibly factored) ring — the coordinates
+    schedule.partition_for_round consumes."""
+    if factor is not None:
+        n_i, n_s = factor
+        flat = lax.axis_index(intra_axis)
+        return flat // n_s, flat % n_s, n_i, n_s
+    if inter_axis is None:
+        return jnp.int32(0), lax.axis_index(intra_axis), 1, \
+            axis_size(intra_axis)
+    return (lax.axis_index(inter_axis), lax.axis_index(intra_axis),
+            axis_size(inter_axis), axis_size(intra_axis))
+
+
 def fused_slot_schedule(world: int, slots: int):
     """Host-side KV-slot schedule of the fused ring kernel: [world] int array
     where entry r is the communication-buffer slot holding the chunk a
@@ -133,13 +232,21 @@ def fused_slot_schedule(world: int, slots: int):
     With `slots` = 2 this is plain double buffering (slot parity r % 2);
     more slots deepen the pipeline so a send may run `slots - 1` rounds
     ahead of compute before the handshake blocks it.
+
+    Since the schedule-IR refactor this is a VIEW of the compiled "uni"
+    program (parallel/schedule.compile_fwd) — the same IR the kernels
+    scalar-prefetch — kept for its callers and as the legacy surface
+    burstlint's independent-derivation check pins.
     """
     import numpy as np
+
+    from . import schedule
 
     if world < 1 or slots < 2:
         raise ValueError(f"need world >= 1 and slots >= 2, got "
                          f"world={world}, slots={slots}")
-    return np.arange(world, dtype=np.int64) % min(slots, world)
+    prog = schedule.compile_fwd("uni", world, slots=slots)
+    return np.asarray(prog.col(schedule.CONSUME_SLOT), dtype=np.int64)
 
 
 def fused_bwd_slot_schedule(world: int, slots: int):
@@ -164,13 +271,19 @@ def fused_bwd_slot_schedule(world: int, slots: int):
     neighbor-only sends, world-1 ring hops per bundle, every dq partial
     arriving home exactly once with all `world` contributions, and no slot
     overwritten before its last read under the capacity handshake.
+
+    Like fused_slot_schedule, now a view of the compiled "uni" backward
+    program (parallel/schedule.compile_bwd).
     """
     import numpy as np
+
+    from . import schedule
 
     if world < 1 or slots < 2:
         raise ValueError(f"need world >= 1 and slots >= 2, got "
                          f"world={world}, slots={slots}")
-    return np.arange(world, dtype=np.int64) % min(slots, world)
+    prog = schedule.compile_bwd("uni", world, slots=slots, dq_slots=slots)
+    return np.asarray(prog.col(schedule.CONSUME_SLOT), dtype=np.int64)
 
 
 def partition_at_round(r, intra_axis: str, inter_axis):

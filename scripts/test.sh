@@ -7,13 +7,14 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 args=("$@")
 filtered=()
-fast=0; tpu=0; fused=0; obs=0
+fast=0; tpu=0; fused=0; obs=0; schedule=0
 for a in "${args[@]}"; do
   case "$a" in
     --fast) fast=1 ;;
     --tpu) tpu=1 ;;
     --fused) fused=1 ;;
     --obs) obs=1 ;;
+    --schedule) schedule=1 ;;
     *) filtered+=("$a") ;;
   esac
 done
@@ -47,6 +48,17 @@ for p in range(2):
 PY
   python -m burst_attn_tpu.obs --merge "$obs_tmp/obs*.jsonl" > /dev/null
   python scripts/check_regression.py --dry-run
+elif [[ $schedule == 1 ]]; then
+  # focused lane for the ring-schedule IR + compiler (parallel/schedule.py):
+  # compiler/oracle unit tests, interpret-mode parity of the bidi and
+  # double-ring fused schedules vs the scan ring + dense oracle, and the
+  # schedule-proof mutation suite (flipped direction, shortened prefetch,
+  # aliased slot — each must fire).  The burstlint gate above already
+  # simulation-proved the full emitted matrix + the hardware-trace census.
+  python -m pytest tests/test_schedule_ir.py tests/test_fused_topologies.py \
+    tests/test_schedule.py -q ${filtered[@]+"${filtered[@]}"}
+  python -m pytest tests/test_analysis.py -q -k "ring_program or fused" \
+    ${filtered[@]+"${filtered[@]}"}
 elif [[ $fused == 1 ]]; then
   # focused lane for the fused RDMA-ring kernels' interpret-mode parity
   # tests — forward (tests/test_fused_ring.py), backward

@@ -56,6 +56,11 @@ _SLOW = {
     ("test_devstats.py", "test_segments_collect_matches_plain"),
     ("test_devstats.py", "test_windowed_contig_truncation_visible_in_stats"),
     ("test_dist_decode.py", "test_dist_prefill_matches_single_device"),
+    ("test_fused_topologies.py", "test_bidi_fwd_parity"),
+    ("test_fused_topologies.py", "test_bidi_deeper_cw_bank"),
+    ("test_fused_topologies.py", "test_bidi_grad_parity"),
+    ("test_fused_topologies.py", "test_double_fwd_parity"),
+    ("test_fused_topologies.py", "test_double_grad_parity"),
     ("test_fused_ring_bwd.py", "test_causal_bwd_parity"),
     ("test_fused_ring_bwd.py", "test_rotate_o_bwd_parity"),
     ("test_fused_ring_bwd.py", "test_gqa_bf16_bwd_parity"),

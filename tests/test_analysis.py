@@ -622,3 +622,87 @@ def test_fused_bwd_fp16_accum_fires():
     findings = ringcheck.verify_fused_bwd_trace(jx, where="seeded bwd kernel",
                                                 anchor=ANCHOR)
     assert "fp32-accum" in _rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# schedule-IR program proofs (ISSUE 6): the compiler's emitted programs are
+# simulation-proven (ringcheck.verify_ring_programs); deliberately corrupted
+# programs — flipped direction, shortened prefetch distance, aliased slot —
+# must each fire, or the proof has no teeth
+
+
+def _export(prog):
+    return prog.export()
+
+
+@pytest.mark.fused_ring
+def test_ring_program_matrix_proves_clean():
+    findings = ringcheck.verify_ring_programs()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+@pytest.mark.fused_ring
+def test_ring_program_flipped_direction_fires():
+    """Swapping a channel's direction (cw -> ccw) delivers the mirror
+    rotation: every consume after round 0 holds the wrong partition."""
+    from burst_attn_tpu.parallel import schedule
+
+    prog = _export(schedule.compile_fwd("uni", 8))
+    prog["channels"] = ("ccw",)
+    with pytest.raises(AssertionError, match="rotation says"):
+        oracle.verify_ring_program(prog)
+
+    # and the bidi mirror: flip only the second channel
+    prog = _export(schedule.compile_fwd("bidi", 8))
+    prog["channels"] = ("cw", "cw")
+    with pytest.raises(AssertionError, match="rotation says"):
+        oracle.verify_ring_program(prog)
+
+
+@pytest.mark.fused_ring
+def test_ring_program_shortened_prefetch_fires():
+    """Moving the double ring's inter hop to the cycle's LAST round keeps
+    delivery intact but shrinks the prefetch distance below one intra
+    cycle — the slow hop can no longer hide behind compute."""
+    from burst_attn_tpu.parallel import schedule
+
+    prog = _export(schedule.compile_bwd("double", 4, 2))
+    rows = {k: list(v) for k, v in prog["rows"].items()}
+    assert rows["send1"][0] == 1
+    late = prog["n_intra"] - 1
+    for col in ("send1", "src_slot1", "dst_slot1"):
+        rows[col][late] = rows[col][0]
+        rows[col][0] = 0
+    prog["rows"] = {k: tuple(v) for k, v in rows.items()}
+    with pytest.raises(AssertionError, match="prefetch distance"):
+        oracle.verify_ring_program(prog)
+
+
+@pytest.mark.fused_ring
+def test_ring_program_aliased_slot_fires():
+    """Aiming a send at the slot another round still has to read is the
+    overwrite-before-read hazard the per-slot credits exist to prevent."""
+    from burst_attn_tpu.parallel import schedule
+
+    prog = _export(schedule.compile_fwd("uni", 8, slots=3))
+    rows = {k: list(v) for k, v in prog["rows"].items()}
+    rows["dst_slot0"][1] = rows["consume_slot"][1]  # round 2 reads it next
+    prog["rows"] = {k: tuple(v) for k, v in rows.items()}
+    with pytest.raises(AssertionError):
+        oracle.verify_ring_program(prog)
+
+
+@pytest.mark.fused_ring
+def test_ring_program_dropped_home_hop_fires():
+    """Turning a return-home hop into a plain ring hop strands the owner's
+    gradient: the exactly-once home delivery proof must fire."""
+    from burst_attn_tpu.parallel import schedule
+
+    prog = _export(schedule.compile_bwd("uni", 8))
+    rows = {k: list(v) for k, v in prog["rows"].items()}
+    last = max(r for r in range(len(rows["dq_send"]))
+               if rows["dq_send"][r] == schedule.DQ_HOME)
+    rows["dq_send"][last] = schedule.DQ_NONE
+    prog["rows"] = {k: tuple(v) for k, v in rows.items()}
+    with pytest.raises(AssertionError, match="home"):
+        oracle.verify_ring_program(prog)

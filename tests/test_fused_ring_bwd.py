@@ -259,12 +259,14 @@ def test_devstats_carries_bwd_slot_use():
     assert np.asarray(st.slot_use_bwd)[2:].sum() == 0
     reg = Registry()
     st.publish(reg)
+    # primary-bank counters publish under dir="cw" since the schedule-IR
+    # bidi refactor split slot_use by ring direction
     assert reg.counter("devstats.slot_use").get(
-        slot=0, **{"pass": "bwd"}) == 3
+        slot=0, dir="cw", **{"pass": "bwd"}) == 3
     assert reg.counter("devstats.slot_use").get(
-        slot=1, **{"pass": "bwd"}) == 1
+        slot=1, dir="cw", **{"pass": "bwd"}) == 1
     assert reg.counter("devstats.slot_use").get(
-        slot=0, **{"pass": "fwd"}) == 2
+        slot=0, dir="cw", **{"pass": "fwd"}) == 2
 
 
 # ---------------------------------------------------------------------------
