@@ -218,6 +218,152 @@ def dist_decode_step(params, token, position, cache: DistCache,
     return logits, cache
 
 
+def _page_partition(sp_axes):
+    """Linear shard index over the (possibly nested) sequence axes — the
+    same coordinate my_partition gives the ring."""
+    from ..parallel.ring import my_partition
+
+    intra = sp_axes[-1]
+    inter = sp_axes[0] if len(sp_axes) > 1 else None
+    return my_partition(intra, inter)
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
+def dist_paged_decode_step(params, tokens, state, cfg: ModelConfig, mesh):
+    """One decode step against a PAGE-SHARDED pool: the pools split over
+    the sequence axes along the page dimension (shard w owns global pages
+    [w·P/W, (w+1)·P/W)), each shard computes an online-softmax partial
+    over the table entries it owns, and the partials LSE-merge across the
+    axes — dist_decode_step's merge, reading serving pages instead of a
+    dense cache shard.
+
+    This is the decode half of the million-token handoff
+    (serving/handoff.py): ring prefill lands its K/V in pool pages in
+    LAYOUT order with no re-layout copy, which is correct here because a
+    decode token attends EVERY cached position (validity is "is this
+    table entry a real token", not an ordering) and full-visibility
+    attention is permutation-invariant.  cfg.window must be None for
+    exactly that reason.  The append itself is a global scatter (GSPMD
+    splits it along the pools' sharding); table/lengths ride replicated.
+
+    tokens [slots] int32 -> (fp32 logits [slots, vocab], new state).
+    n_pages must divide by the sequence-axis world size.
+    """
+    from .paged_decode import PagedState
+    from ..ops.paged_attention import quantize_tokens as _quant
+
+    if cfg.window is not None:
+        raise ValueError(
+            "dist_paged_decode_step requires cfg.window=None: pages hold "
+            "layout-order tokens, and a windowed band over page order "
+            "would not be the band over natural positions")
+    sp_axes = cfg.seq_axes
+    world = 1
+    for a in sp_axes:
+        world *= mesh.shape.get(a, 1)
+    slots = tokens.shape[0]
+    page = state.k_pages[0].shape[2]
+    n_pages = state.k_pages[0].shape[0]
+    if n_pages % world:
+        raise ValueError(f"n_pages {n_pages} must divide by the sequence "
+                         f"world {world} to shard the pool page dim")
+    scale = cfg.d_head**-0.5
+    group = cfg.n_heads // cfg.n_kv_heads
+    live = state.lengths > 0
+    pos = jnp.where(live, state.lengths, 0)
+    x = params["embed"].astype(cfg.dtype)[tokens[:, None]]
+    slot_page = state.lengths // page
+    offset = state.lengths % page
+    page_id = jnp.take_along_axis(state.page_table, slot_page[:, None],
+                                  axis=1)[:, 0]
+    boundary_unassigned = live & (page_id == 0)
+    page_id = jnp.where(live, page_id, 0)
+    lengths_new = state.lengths + live.astype(jnp.int32)
+    quant = state.k_scales is not None
+    seq_spec = sp_axes if len(sp_axes) > 1 else sp_axes[0]
+    pool_spec = P(seq_spec, None, None, None)
+    scale_spec = P(seq_spec, None, None)
+
+    def shard_partial(qg, kp_l, vp_l, ks_l, vs_l, table, lens):
+        part = _page_partition(sp_axes)
+        p_loc = kp_l.shape[0]
+        lo = part * p_loc
+        owned = (table >= lo) & (table < lo + p_loc) & (table != 0)
+        lp = jnp.clip(table - lo, 0, p_loc - 1)
+        k_loc = kp_l[lp]                     # [slots, cols, Nkv, page, D]
+        v_loc = vp_l[lp]
+        if quant:
+            k_loc = k_loc.astype(jnp.float32) * ks_l[lp][..., None]
+            v_loc = v_loc.astype(jnp.float32) * vs_l[lp][..., None]
+        cols = table.shape[1]
+        k_loc = jnp.moveaxis(k_loc, 2, 1).reshape(
+            slots, cfg.n_kv_heads, cols * page, cfg.d_head)
+        v_loc = jnp.moveaxis(v_loc, 2, 1).reshape(
+            slots, cfg.n_kv_heads, cols * page, cfg.d_head)
+        col_pos = jnp.arange(cols * page, dtype=jnp.int32)[None, :]
+        valid = (col_pos < lens[:, None]) \
+            & jnp.repeat(owned, page, axis=1)
+        s = jnp.einsum("bngd,bnjd->bngj", qg.astype(jnp.float32),
+                       k_loc.astype(jnp.float32)) * scale
+        s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+        m = jnp.max(s, axis=-1)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        l = jnp.sum(p, axis=-1)
+        acc = jnp.einsum("bngj,bnjd->bngd", p, v_loc.astype(jnp.float32))
+        m = jnp.where(jnp.isfinite(m), m, -1e30)  # neutral under pmax
+        m_g = lax.pmax(m, sp_axes)
+        w = jnp.exp(m - m_g)
+        l_g = lax.psum(l * w, sp_axes)
+        acc_g = lax.psum(acc * w[..., None], sp_axes)
+        return acc_g / jnp.maximum(l_g, 1e-30)[..., None]
+
+    k_pools, v_pools, k_scs, v_scs = [], [], [], []
+    for li, (p, kp, vp) in enumerate(zip(params["layers"], state.k_pages,
+                                         state.v_pages)):
+        q, k, v = _qkv_proj(p, x, pos[:, None], cfg)
+        k_row, v_row = k[:, :, 0], v[:, :, 0]
+        ks = vs = None
+        if quant:
+            k8, k_s = _quant(k_row)
+            v8, v_s = _quant(v_row)
+            kp = kp.at[page_id, :, offset].set(k8)
+            vp = vp.at[page_id, :, offset].set(v8)
+            ks = state.k_scales[li].at[page_id, :, offset].set(k_s)
+            vs = state.v_scales[li].at[page_id, :, offset].set(v_s)
+        else:
+            kp = kp.at[page_id, :, offset].set(k_row.astype(kp.dtype))
+            vp = vp.at[page_id, :, offset].set(v_row.astype(vp.dtype))
+        qg = q.reshape(slots, cfg.n_kv_heads, group, cfg.d_head)
+        in_specs = [P(None, None, None, None), pool_spec, pool_spec,
+                    scale_spec if quant else P(),
+                    scale_spec if quant else P(),
+                    P(None, None), P(None)]
+        o = shard_map(
+            shard_partial, mesh=mesh, in_specs=tuple(in_specs),
+            out_specs=P(None, None, None, None), check_vma=False,
+        )(qg, kp, vp,
+          ks if quant else jnp.zeros((), cfg.dtype),
+          vs if quant else jnp.zeros((), cfg.dtype),
+          state.page_table, lengths_new)
+        o = o.reshape(slots, cfg.n_heads, 1, cfg.d_head).astype(cfg.dtype)
+        x = x + _attn_out(p, o)
+        m_out, _ = _mlp(p, x, cfg, inference=True)
+        x = x + m_out
+        k_pools.append(kp)
+        v_pools.append(vp)
+        k_scs.append(ks)
+        v_scs.append(vs)
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)[:, 0]
+    logits = jnp.where(boundary_unassigned[:, None], jnp.nan, logits)
+    return logits, PagedState(
+        tuple(k_pools), tuple(v_pools), state.page_table, lengths_new,
+        tuple(k_scs) if quant else None, tuple(v_scs) if quant else None)
+
+
 def dist_generate(params, prompt, cfg: ModelConfig, mesh, *, steps: int,
                   temperature: float = 0.0, top_k=None, top_p=None, rng=None):
     """Greedy/sampled generation with the sequence-sharded prompt cache.

@@ -94,12 +94,14 @@ class ServeEngine:
                  quantize: bool = False, mesh=None, eos_id: Optional[int] = None,
                  temperature: float = 0.0, top_k=None, top_p=None, rng=None,
                  prefix_cache: bool = False, draft_params=None,
-                 draft_cfg: Optional[ModelConfig] = None, spec_k: int = 4):
+                 draft_cfg: Optional[ModelConfig] = None, spec_k: int = 4,
+                 max_queue: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
         self.eos_id = eos_id
         self.page = page
+        self.max_queue = max_queue
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -146,7 +148,13 @@ class ServeEngine:
 
     def submit(self, tokens, max_new_tokens: int) -> int:
         """Queue a prompt; returns a request id (tokens appear in
-        step() results / results() once finished)."""
+        step() results / results() once finished).
+
+        Raises ValueError on malformed / permanently unservable requests;
+        with `max_queue` set, raises RuntimeError when load-shed — pool
+        pressure (`pool-exhausted`) sheds BEFORE queue pressure
+        (`queue-full`), and `serve.requests_rejected{reason}` labels the
+        two distinctly."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
             _M_REJECTED.inc(reason="empty-prompt")
@@ -168,6 +176,22 @@ class ServeEngine:
             raise ValueError(
                 f"request needs {need} pages but the pool only has "
                 f"{self.pool.n_pages - 1} usable pages total")
+        if self.max_queue is not None:
+            # load shed, POOL pressure before QUEUE pressure: a request
+            # that would wait behind others for pages that are not free
+            # only deepens the backlog, whatever the queue depth; a full
+            # queue is only the reason when pages were never short
+            if self._queue and need > self.pool.available:
+                _M_REJECTED.inc(reason="pool-exhausted")
+                raise RuntimeError(
+                    f"load shed (pool-exhausted): request needs {need} "
+                    f"pages, {self.pool.available} free, "
+                    f"{len(self._queue)} already waiting")
+            if len(self._queue) >= self.max_queue:
+                _M_REJECTED.inc(reason="queue-full")
+                raise RuntimeError(
+                    f"load shed (queue-full): {len(self._queue)} waiting "
+                    f">= max_queue {self.max_queue}")
         rid = self._next_id
         self._next_id += 1
         self._queue.append(_Request(rid, tokens, max_new_tokens,

@@ -1,0 +1,347 @@
+"""Ragged paged attention: ONE Pallas launch for a mixed prefill+decode
+token batch against the paged KV pool.
+
+ops/paged_attention.py serves exactly one query token per sequence per
+launch — fine for pure decode, but a continuous-batching engine lives on
+MIXED steps: some slots absorbing a prompt chunk (tens of query tokens),
+others decoding (one), all against the same page pool.  Running prefill
+and decode as separate programs forfeits the batch (two launches, two
+sets of ragged predication, and the prefill chunk's MXU work cannot soak
+up the decode slots' latency).  This kernel is the single-launch design
+(PAPERS.md "Ragged Paged Attention"):
+
+  * The grid walks `(sequence, kv-head, q-block, page-slot)`.  Each
+    sequence brings its OWN query token count `q_lens[s]` (1 = decode,
+    up to the chunk size = prefill); per-sequence page tables arrive via
+    scalar prefetch and are consulted in the kv index maps, exactly like
+    the decode kernel — each grid step DMAs one pool page.
+  * GQA folds the query-head group INTO the q tile rows: block rows are
+    laid out `token-major x group` (row r = token r//G, head r%G), so a
+    decode step (1 token x G heads) and a prefill block (block_q tokens
+    x G heads) are the same [rows, page] score tile shape.
+  * Causality is enforced within each sequence: query token t of
+    sequence s sits at absolute position `kv_lens[s] - q_lens[s] + t`
+    and sees cached positions `<= ` that (sliding window optional).
+    Page-slots wholly outside a block's visible band are predicated off
+    and their DMAs clamped onto a live page (consecutive duplicate block
+    indexes collapse into one fetch) — cost per sequence ∝ its length.
+  * The inner online-softmax update is OP-FOR-OP the decode kernel's
+    (same exp2 rebase, same masking order, same fp32 accumulation), so a
+    decode row (q_len == 1) is BIT-IDENTICAL to paged_decode_attention —
+    tested, not aspirational: the serving engine may route any step
+    through either kernel and streams must not fork.
+
+Interpret mode runs the same grid on CPU, which is how tier-1 proves the
+mixed-batch parity (dense oracle + decode-kernel bit compare) off-TPU.
+`ragged_supported()` is the capability probe: the serving engine falls
+back to the dense-gather path (models/serving) with a labeled
+`burst.fused_fallback{pass=serve}` counter instead of raising.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_flash import LOG2E, NEG_INF, VMEM_LIMIT, _interpret_default
+from ..utils.compat import tpu_compiler_params
+
+# hard ceiling on the padded rows-per-block tile (block_q * group rounded
+# to sublanes): past this the [rows, page] score tile plus the fp32
+# accumulator stops fitting VMEM comfortably at page=128, d=128
+_MAX_BLOCK_ROWS = 1024
+
+
+def _ragged_kernel(
+    table_ref, n_live_ref, kvlen_ref, qlen_ref, lo_ref,  # scalar prefetch
+    *refs,
+    scale, page, n_slots, bq, g, quant, window,
+):
+    if quant:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    s_ = pl.program_id(0)
+    qi = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_len = qlen_ref[s_]
+    kv_len = kvlen_ref[s_]
+    q_start = kv_len - q_len          # absolute position of query token 0
+    t0 = qi * bq                      # first query token of this block
+    # absolute position of the block's LAST real token: everything at or
+    # below it is potentially visible, pages wholly above it are dead.
+    # (for q_len == 1 this reduces to the decode kernel's j < n_live test)
+    p_max = q_start + jnp.minimum(q_len, t0 + bq) - 1
+    live = (t0 < q_len) & (j * page <= p_max) & (j >= lo_ref[s_] // page)
+
+    @pl.when(live)
+    def _accum():
+        q = q_ref[0, 0, :, :] * (scale * LOG2E)
+        k_tile = k_ref[0, :, :]
+        if quant:
+            k_tile = k_tile.astype(jnp.bfloat16)
+        s = jax.lax.dot_general(
+            q, k_tile, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if quant:
+            # per-token dequant as a column rescale (decode kernel's trick)
+            s = s * ks_ref[0]
+        pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # row r = query token t0 + r//g at absolute position qp; rows past
+        # q_len are wrapper padding — their outputs are sliced away, so
+        # they need no extra masking (their q rows are zeros / pad tokens
+        # and every op below is row-independent)
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        qp = q_start + t0 + row // g
+        valid = pos <= qp
+        if window is not None:
+            valid &= pos >= qp - window + 1
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.where(m_prev >= m_new, 1.0, jnp.exp2(m_prev - m_new))
+        p = jnp.exp2(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if quant:
+            pv = jax.lax.dot_general(
+                (p * vs_ref[0]).astype(jnp.bfloat16),
+                v_ref[0, :, :].astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            pv = jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0, :, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(j == n_slots - 1)
+    def _finish():
+        # fully-masked blocks (idle slot / past-q_len block) emit zeros
+        l = jnp.where(l_scr[:] > 0, l_scr[:], 1.0)
+        o_ref[0, 0, :, :] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _block_rows(block_q: int, group: int) -> int:
+    """Padded rows per q block: block_q tokens x group heads, rounded up
+    to the 8-sublane tile (>= 8, matching _pad_group for block_q == 1)."""
+    return max(8, -(-(block_q * group) // 8) * 8)
+
+
+def ragged_supported(*, n_kv_heads, n_q_heads, q_tokens, d_head, page,
+                     quantized=False, block_q=8, interpret=None):
+    """Capability probe: None when ragged_paged_attention can serve this
+    shape, else a human-readable reason whose PREFIX is a stable key (the
+    serving engine maps it to a bounded fallback-counter label).  Mirrors
+    fused_ring.supported's contract: probe first, fall back loudly."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if q_tokens < 1:
+        return f"empty q chunk: q_tokens {q_tokens} < 1"
+    if n_q_heads % n_kv_heads:
+        return (f"GQA group mismatch: {n_q_heads} query heads not a "
+                f"multiple of {n_kv_heads} kv heads")
+    if page % 128:
+        return f"page size {page} is not a multiple of the 128 lane tile"
+    group = n_q_heads // n_kv_heads
+    bq = max(1, min(block_q, q_tokens))
+    rows = _block_rows(bq, group)
+    if rows > _MAX_BLOCK_ROWS:
+        return (f"q-block rows {rows} (block_q {bq} x group {group}, "
+                f"padded) exceed the {_MAX_BLOCK_ROWS}-row tile budget")
+    # VMEM plan: q + o + acc tiles (fp32) plus a double-buffered k/v page
+    kv_bytes = 1 if quantized else 2
+    plan = (rows * d_head * 4 * 3          # q, o, acc
+            + rows * (page + 2) * 4        # scores + m/l columns
+            + 4 * page * d_head * kv_bytes)  # k/v pages, double buffered
+    if plan > VMEM_LIMIT:
+        return (f"VMEM plan {plan} bytes exceeds the {VMEM_LIMIT} budget "
+                f"(page {page}, d_head {d_head}, rows {rows})")
+    if not interpret and d_head % 128:
+        # compiled Mosaic wants lane-aligned head dims; interpret mode
+        # (CPU tier-1) has no such constraint
+        return f"head dim {d_head} is not lane-aligned (128) for Mosaic"
+    return None
+
+
+def _fold_groups(q, n_kv, group, n_qblk, bq, rows):
+    """[S, Nq, QT, D] -> [S, Nkv, n_qblk*rows, D] token-major x group row
+    layout, zero-padded to bq tokens per block and `rows` sublanes."""
+    s, _, qt, d = q.shape
+    qtp = n_qblk * bq
+    q = q.reshape(s, n_kv, group, qt, d)
+    if qtp != qt:
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, 0), (0, qtp - qt), (0, 0)])
+    q = jnp.moveaxis(q, 2, 3)                       # [S, Nkv, QTp, G, D]
+    q = q.reshape(s, n_kv, n_qblk, bq * group, d)
+    if rows != bq * group:
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, 0), (0, rows - bq * group),
+                        (0, 0)])
+    return q.reshape(s, n_kv, n_qblk * rows, d)
+
+
+def _unfold_groups(o, n_q, group, n_qblk, bq, rows, qt):
+    """Inverse of _fold_groups: [S, Nkv, n_qblk*rows, D] -> [S, Nq, QT, D]."""
+    s, n_kv, _, d = o.shape
+    o = o.reshape(s, n_kv, n_qblk, rows, d)[:, :, :, :bq * group]
+    o = o.reshape(s, n_kv, n_qblk * bq, group, d)
+    o = jnp.moveaxis(o, 3, 2)                       # [S, Nkv, G, QTp, D]
+    return o.reshape(s, n_q, n_qblk * bq, d)[:, :, :qt]
+
+
+def ragged_paged_attention(q, k_pages, v_pages, page_table, q_lens, kv_lens,
+                           *, k_scales=None, v_scales=None, window=None,
+                           scale=None, block_q=8, interpret=None):
+    """Mixed prefill+decode ragged attention against a paged KV pool.
+
+    q          [S, Nq, QT, D]   query tokens per slot; slot s's token t is
+                                the token at absolute position
+                                kv_lens[s] - q_lens[s] + t.  Rows at or
+                                past q_lens[s] are padding (outputs there
+                                are garbage the caller must ignore).
+    k_pages    [P, Nkv, page, D]  shared pool — the new tokens' K/V must
+    v_pages    [P, Nkv, page, D]  already be scattered in (the serving
+                                  step scatters BEFORE attending)
+    page_table [S, n_slots] int32 pool page per (slot, table column)
+    q_lens     [S] int32        query tokens this launch (0 = idle slot;
+                                1 = decode; >1 = prefill chunk)
+    kv_lens    [S] int32        total live tokens INCLUDING this launch's
+    window     static int       sliding-window band per query position
+    k_scales / v_scales         per-token dequant scales for int8 pools
+    block_q    static int       query tokens per grid block
+
+    Returns [S, Nq, QT, D] in q's dtype.  A pure-decode batch (QT == 1)
+    is bit-identical to paged_decode_attention on the same pool.
+    """
+    s, n_q, qt, d = q.shape
+    n_kv = k_pages.shape[1]
+    page = k_pages.shape[2]
+    n_slots = page_table.shape[1]
+    if n_q % n_kv:
+        raise ValueError(f"{n_q} query heads not grouped by {n_kv} kv heads")
+    group = n_q // n_kv
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    quant = k_scales is not None
+    if quant != (v_scales is not None):
+        raise ValueError("k_scales and v_scales must be given together")
+
+    bq = max(1, min(block_q, qt))
+    n_qblk = -(-qt // bq)
+    rows = _block_rows(bq, group)
+    q_rows = _fold_groups(q, n_kv, group, n_qblk, bq, rows)
+
+    q_lens = q_lens.astype(jnp.int32)
+    kv_lens = kv_lens.astype(jnp.int32)
+    n_live = -(-kv_lens // page)
+    if window is None:
+        lo = jnp.zeros_like(kv_lens)
+    else:
+        # lower edge of query token 0's band (the widest in the batch);
+        # per-row edges re-tighten inside the kernel.  q_len == 1 reduces
+        # to the decode kernel's max(len - window, 0).
+        lo = jnp.maximum(kv_lens - q_lens - window + 1, 0)
+
+    def q_map(s_, h, qi, j, table, n_live_, kvlen_, qlen_, lo_):
+        return (s_, h, qi, 0)
+
+    def kv_map(s_, h, qi, j, table, n_live_, kvlen_, qlen_, lo_):
+        # clamp dead page-slots into the live band (duplicate consecutive
+        # indexes collapse into one DMA); empty slots stay in range
+        slot = jnp.clip(j, lo_[s_] // page, jnp.maximum(n_live_[s_] - 1, 0))
+        return (table[s_, slot], h, 0, 0)
+
+    kernel = functools.partial(
+        _ragged_kernel, scale=scale, page=page, n_slots=n_slots,
+        bq=bq, g=group, quant=quant, window=window,
+    )
+    in_specs = [
+        pl.BlockSpec((1, 1, rows, d), q_map),
+        pl.BlockSpec((None, 1, page, d), kv_map),
+        pl.BlockSpec((None, 1, page, d), kv_map),
+    ]
+    inputs = [page_table, n_live, kv_lens, q_lens, lo,
+              q_rows, k_pages, v_pages]
+    if quant:
+        def sc_map(s_, h, qi, j, table, n_live_, kvlen_, qlen_, lo_):
+            return kv_map(s_, h, qi, j, table, n_live_, kvlen_, qlen_,
+                          lo_)[:3] + (0,)
+
+        in_specs.append(pl.BlockSpec((None, 1, 1, page), sc_map))
+        in_specs.append(pl.BlockSpec((None, 1, 1, page), sc_map))
+        inputs += [k_scales[:, :, None, :], v_scales[:, :, None, :]]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(s, n_kv, n_qblk, n_slots),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rows, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+    )
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, n_kv, n_qblk * rows, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            vmem_limit_bytes=VMEM_LIMIT,
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*inputs)
+    return _unfold_groups(o, n_q, group, n_qblk, bq, rows, qt)
+
+
+def ragged_paged_reference(q, k_pages, v_pages, page_table, q_lens, kv_lens,
+                           *, k_scales=None, v_scales=None, window=None,
+                           scale=None):
+    """jnp oracle: dense-gathers every slot's pages and runs masked
+    softmax with the per-row causal band.  O(S·n_slots·page) memory —
+    tests only.  Padding rows (t >= q_lens) and idle slots emit zeros."""
+    if k_scales is not None:
+        k_pages = k_pages.astype(jnp.float32) * k_scales[..., None]
+        v_pages = v_pages.astype(jnp.float32) * v_scales[..., None]
+    s, n_q, qt, d = q.shape
+    n_kv = k_pages.shape[1]
+    page = k_pages.shape[2]
+    n_slots = page_table.shape[1]
+    group = n_q // n_kv
+    if scale is None:
+        scale = d**-0.5
+    k = k_pages[page_table]  # [S, n_slots, Nkv, page, D]
+    v = v_pages[page_table]
+    k = jnp.moveaxis(k, 2, 1).reshape(s, n_kv, n_slots * page, d)
+    v = jnp.moveaxis(v, 2, 1).reshape(s, n_kv, n_slots * page, d)
+    qg = q.reshape(s, n_kv, group, qt, d)
+    sc = jnp.einsum("bngtd,bnjd->bngtj", qg.astype(jnp.float32),
+                    k.astype(jnp.float32)) * scale
+    qp = (kv_lens - q_lens)[:, None] + jnp.arange(qt)[None, :]  # [S, QT]
+    col = jnp.arange(n_slots * page)[None, None, :]
+    valid = col <= qp[:, :, None]
+    if window is not None:
+        valid &= col >= (qp[:, :, None] - window + 1)
+    valid &= (jnp.arange(qt)[None, :] < q_lens[:, None])[:, :, None]
+    sc = jnp.where(valid[:, None, None, :, :], sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    p = jnp.where(valid[:, None, None, :, :], p, 0.0)  # masked rows -> 0
+    o = jnp.einsum("bngtj,bnjd->bngtd", p, v.astype(jnp.float32))
+    return o.reshape(s, n_q, qt, d).astype(q.dtype)

@@ -1,0 +1,33 @@
+"""Ragged paged serving subsystem: one kernel, one pool, one engine.
+
+The fourth pillar next to ring/obs/analysis (ROADMAP open item 3).  The
+pieces:
+
+  * `ops/ragged_paged.py` — ONE Pallas launch attending a mixed
+    chunked-prefill + decode token batch against the paged KV pool.
+  * `serving.model.ragged_model_step` — the jitted transformer step that
+    scatters each slot's new K/V into its pages and attends through the
+    ragged kernel (or the dense-gather fallback when the capability
+    probe declines).
+  * `serving.engine.RaggedServeEngine` — continuous batching: per-step
+    admission, chunked prefill interleaved with in-flight decode, page
+    allocation/eviction from one pool, speculative decoding as a
+    scheduler policy.
+  * `serving.handoff` — the million-token path: ring-sharded prefill
+    whose K/V lands DIRECTLY in pool pages (no re-layout copy), feeding
+    sequence-parallel paged decode (models/dist_decode.py).
+
+docs/serving.md walks the batch layout, page-table format, scheduler
+policy, and the handoff diagram.
+"""
+
+from .engine import RaggedServeEngine
+from .model import ragged_model_step
+from .handoff import ring_prefill_to_pages, handoff_generate
+
+__all__ = [
+    "RaggedServeEngine",
+    "ragged_model_step",
+    "ring_prefill_to_pages",
+    "handoff_generate",
+]

@@ -1,0 +1,510 @@
+"""RaggedServeEngine: continuous batching over the one-launch ragged
+kernel.
+
+models/serve.py's engine prefills a whole prompt at admission (one
+program per prompt page count) and then decodes one token per tick —
+a long prompt stalls every in-flight stream for its full prefill.  This
+engine schedules PREFILL AS CHUNKS through the same launch that decodes:
+
+  * submit() queues; admission reserves a request's FULL page lifetime
+    up front (prompt + budget + speculative slack — mid-generation OOM
+    stays impossible by construction) but moves NO tokens.
+  * Every tick builds one ragged batch: each mid-prefill slot consumes
+    its next `chunk` prompt tokens, each decoding slot its single next
+    token, idle slots ride along predicated off.  One
+    `ragged_model_step` launch serves them all; a slot whose chunk
+    completes its prompt samples its first token THAT tick (TTFT).
+  * Speculative decoding is a SCHEDULER POLICY, not a separate engine:
+    when a draft model is attached and no slot is mid-prefill, the tick
+    becomes a speculative round (k draft proposals per slot, one ragged
+    all-logits verify, per-slot prefix acceptance, vectorized rollback).
+    Mixed ticks fall back to plain chunking, with the draft cache kept
+    in sync through its own ragged catch-up step.
+  * Load shedding (`max_queue`): POOL pressure sheds before QUEUE
+    pressure — a request that would wait behind others for pages that
+    are not free is rejected `pool-exhausted` even when the queue still
+    has room; `queue-full` only fires when pages were never the
+    bottleneck.
+
+Kernel routing: `ragged_supported` probes each launch width once; a
+declined shape runs the dense-gather fallback and counts a labeled
+`burst.fused_fallback{pass="serve"}` — never a raise (ISSUE 8 satellite).
+
+Metrics: every serve.* instrument models/serve.py exports is preserved
+(same registry names), plus the `serve.ragged_batch_*` family describing
+what each one-launch batch carried (docs/observability.md).
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+
+logger = obs.get_logger(__name__)
+
+# same instrument names as models/serve.py — the registry get-or-creates,
+# so both engines share one catalog and dashboards see one serve.* family
+_M_SUBMITTED = obs.counter("serve.requests_submitted")
+_M_REJECTED = obs.counter("serve.requests_rejected",
+                          "submissions refused up front, by reason")
+_M_ADMITTED = obs.counter("serve.requests_admitted")
+_M_RETIRED = obs.counter("serve.requests_retired",
+                         "finished requests, by cause (eos | budget)")
+_M_STEPS = obs.counter("serve.engine_steps")
+_M_TOKENS = obs.counter("serve.tokens_generated")
+_M_QUEUE = obs.gauge("serve.queue_depth")
+_M_LIVE = obs.gauge("serve.live_slots")
+_M_POOL = obs.gauge("serve.page_pool_occupancy",
+                    "fraction of usable pool pages currently held")
+_M_SPEC_RATE = obs.gauge("serve.spec_acceptance_rate")
+_M_TTFT = obs.histogram("serve.ttft_s")
+_M_TOK_LAT = obs.histogram("serve.token_latency_s")
+# ragged-batch family: what each one-launch batch carried
+_M_RB_LAUNCH = obs.counter("serve.ragged_batch_launches",
+                           "one-kernel ragged launches, by batch kind")
+_M_RB_PREFILL = obs.counter("serve.ragged_batch_prefill_tokens",
+                            "prompt tokens absorbed through ragged launches")
+_M_RB_DECODE = obs.counter("serve.ragged_batch_decode_tokens",
+                           "decode tokens advanced through ragged launches")
+_M_RB_FILL = obs.gauge("serve.ragged_batch_fill",
+                       "real-token fraction of the last launch's [slots, "
+                       "chunk] token grid")
+_M_FALLBACK = obs.counter("burst.fused_fallback")
+
+from ..models.decode import sample_logits
+from ..models.paged_decode import (
+    PagePool, PagedState, init_paged_state, paged_decode_step, paged_prefill,
+    provision_capacity, retire_slot,
+)
+from ..models.transformer import ModelConfig
+from ..ops.ragged_paged import ragged_supported
+from .model import assign_pages, free_slot, ragged_model_step
+
+# reason-string prefix -> bounded counter label, mirroring
+# parallel/burst.py's _FALLBACK_LABELS contract (probe reasons embed
+# shapes, which would explode label cardinality verbatim)
+_FALLBACK_LABELS = (
+    ("empty q chunk", "empty-chunk"),
+    ("GQA group mismatch", "gqa-group"),
+    ("page size", "page-size"),
+    ("q-block rows", "block-rows"),
+    ("VMEM plan", "vmem-budget"),
+    ("head dim", "head-dim"),
+)
+
+
+def _fallback_label(reason: str) -> str:
+    for prefix, label in _FALLBACK_LABELS:
+        if reason.startswith(prefix):
+            return label
+    return "other"
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray          # [T] int32
+    max_new_tokens: int
+    tokens: List[int] = field(default_factory=list)
+    t_submit: float = 0.0
+    n_prefilled: int = 0        # prompt tokens absorbed so far
+
+
+class RaggedServeEngine:
+    """Host-side continuous-batching loop over ragged_model_step.  Not
+    thread-safe; drive it from one thread."""
+
+    def __init__(self, params, cfg: ModelConfig, *, slots: int, n_pages: int,
+                 page: int = 128, max_pages_per_seq: int = 64,
+                 quantize: bool = False, eos_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k=None, top_p=None, rng=None,
+                 chunk: Optional[int] = None, max_queue: Optional[int] = None,
+                 draft_params=None, draft_cfg: Optional[ModelConfig] = None,
+                 spec_k: int = 4, use_ragged: Optional[bool] = None):
+        self.params = params
+        self.cfg = cfg
+        self.eos_id = eos_id
+        self.page = page
+        self.chunk = page if chunk is None else chunk
+        if self.chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {self.chunk}")
+        self.max_queue = max_queue
+        self.temperature = temperature
+        self.top_k, self.top_p = top_k, top_p
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.state, self.pool = init_paged_state(
+            cfg, slots=slots, n_pages=n_pages, page=page,
+            max_pages_per_seq=max_pages_per_seq, quantize=quantize)
+        self.quantize = quantize
+        # None: probe per launch width; True/False force a path
+        self.use_ragged = use_ragged
+        self._attn_cache: Dict[int, str] = {}
+        self.draft = None
+        self.spec_k = 0
+        if draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_params needs draft_cfg")
+            if temperature != 0.0:
+                raise ValueError("speculative serving requires "
+                                 "temperature == 0")
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError("draft and target must share a vocabulary")
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            self.draft = (draft_params, draft_cfg)
+            self.spec_k = spec_k
+            self.dstate, self.dpool = init_paged_state(
+                draft_cfg, slots=slots, n_pages=n_pages, page=page,
+                max_pages_per_seq=max_pages_per_seq, quantize=quantize)
+        self.slots: List[Optional[_Request]] = [None] * slots
+        self._next_tok = np.zeros((slots,), np.int32)
+        self._queue: List[_Request] = []
+        self._next_id = 0
+        self._finished: Dict[int, List[int]] = {}
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rounds = 0
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, tokens, max_new_tokens: int) -> int:
+        """Queue a prompt; returns a request id.  Raises ValueError on
+        malformed / permanently unservable requests, RuntimeError when
+        load-shed (pool pressure sheds BEFORE queue pressure)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size == 0:
+            _M_REJECTED.inc(reason="empty-prompt")
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            _M_REJECTED.inc(reason="bad-budget")
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        need = self._pages_for(tokens.size, max_new_tokens)
+        if need > self.state.page_table.shape[1]:
+            _M_REJECTED.inc(reason="table-width")
+            raise ValueError(
+                f"request needs {need} pages > max_pages_per_seq "
+                f"{self.state.page_table.shape[1]}")
+        if need > self.pool.n_pages - 1:  # page 0 is the reserved sink
+            _M_REJECTED.inc(reason="pool-size")
+            raise ValueError(
+                f"request needs {need} pages but the pool only has "
+                f"{self.pool.n_pages - 1} usable pages total")
+        if self.max_queue is not None:
+            # pool pressure first: a request that would queue behind others
+            # for pages that are not free only deepens the backlog
+            if self._queue and need > self.pool.available:
+                _M_REJECTED.inc(reason="pool-exhausted")
+                raise RuntimeError(
+                    f"load shed (pool-exhausted): request needs {need} "
+                    f"pages, {self.pool.available} free, "
+                    f"{len(self._queue)} already waiting")
+            if len(self._queue) >= self.max_queue:
+                _M_REJECTED.inc(reason="queue-full")
+                raise RuntimeError(
+                    f"load shed (queue-full): {len(self._queue)} waiting "
+                    f">= max_queue {self.max_queue}")
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append(_Request(rid, tokens, max_new_tokens,
+                                    t_submit=time.perf_counter()))
+        _M_SUBMITTED.inc()
+        _M_QUEUE.set(len(self._queue))
+        return rid
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    @property
+    def live(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    def results(self) -> Dict[int, List[int]]:
+        return dict(self._finished)
+
+    @property
+    def acceptance_rate(self) -> Optional[float]:
+        if self.spec_proposed == 0:
+            return None
+        return self.spec_accepted / self.spec_proposed
+
+    def run(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
+        with obs.span("serve.run"):
+            for _ in range(max_steps):
+                if not self._queue and self.live == 0:
+                    return self.results()
+                self.step()
+        raise RuntimeError(f"run() exceeded {max_steps} steps")
+
+    # -- engine ------------------------------------------------------------
+
+    def _pages_for(self, prompt_len: int, max_new: int) -> int:
+        slack = self.spec_k + 1 if self.draft is not None else 0
+        return -(-(prompt_len + max_new + slack) // self.page)
+
+    def _attn_for(self, qt: int) -> str:
+        """Kernel route for a launch width, probed once per width; a
+        declined probe counts one labeled fallback per width."""
+        if self.use_ragged is True:
+            return "ragged"
+        if self.use_ragged is False:
+            return "dense"
+        if qt not in self._attn_cache:
+            reason = ragged_supported(
+                n_kv_heads=self.cfg.n_kv_heads, n_q_heads=self.cfg.n_heads,
+                q_tokens=qt, d_head=self.cfg.d_head, page=self.page,
+                quantized=self.quantize)
+            if reason is not None:
+                _M_FALLBACK.inc(reason=_fallback_label(reason),
+                                **{"pass": "serve"})
+                logger.info("ragged kernel declined (qt=%d): %s — dense "
+                            "fallback", qt, reason)
+            self._attn_cache[qt] = "dense" if reason is not None else "ragged"
+        return self._attn_cache[qt]
+
+    def _admit(self) -> None:
+        """Reserve queued requests' full page lifetime into free slots
+        (FIFO; the head is never starved by admitting behind it).  No
+        tokens move here — prefill is chunked through subsequent ticks."""
+        for slot, occupant in enumerate(self.slots):
+            if occupant is not None or not self._queue:
+                continue
+            req = self._queue[0]
+            need = self._pages_for(len(req.prompt), req.max_new_tokens)
+            if need > self.pool.available:
+                break
+            if self.draft is not None and need > self.dpool.available:
+                break
+            ids = self.pool.acquire(need)
+            try:
+                self.state = assign_pages(self.state, slot, ids)
+                if self.draft is not None:
+                    # draft prefills its WHOLE prompt now (one program, the
+                    # draft is cheap); its cache then tracks the target's
+                    # accepted stream via per-tick catch-up steps
+                    dp, dc = self.draft
+                    _, self.dstate = paged_prefill(
+                        dp, jnp.asarray(req.prompt), self.dstate,
+                        self.dpool, slot, dc)
+                    self.dstate = provision_capacity(
+                        self.dstate, self.dpool, slot,
+                        req.max_new_tokens + self.spec_k + 1)
+            except Exception:
+                self.state = free_slot(self.state, self.pool, slot)
+                if self.draft is not None:
+                    try:
+                        self.dstate = retire_slot(self.dstate, self.dpool,
+                                                  slot)
+                    except Exception as rollback_err:  # noqa: BLE001
+                        logger.warning(
+                            "admission rollback: draft retire_slot(%d) "
+                            "failed (%s: %s); continuing", slot,
+                            type(rollback_err).__name__, rollback_err)
+                raise
+            self._queue.pop(0)
+            self.slots[slot] = req
+            _M_ADMITTED.inc()
+            _M_QUEUE.set(len(self._queue))
+
+    def _sample(self, logits):
+        self._rng, key = jax.random.split(self._rng)
+        return np.asarray(sample_logits(
+            logits, key, temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p, nan_sentinel=True))
+
+    def _retire_finished(self) -> List[Tuple[int, List[int]]]:
+        done = []
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            hit_eos = self.eos_id is not None and req.tokens \
+                and req.tokens[-1] == self.eos_id
+            if hit_eos or len(req.tokens) >= req.max_new_tokens:
+                self.state = free_slot(self.state, self.pool, slot)
+                if self.draft is not None:
+                    self.dstate = retire_slot(self.dstate, self.dpool, slot)
+                self.slots[slot] = None
+                self._finished[req.rid] = req.tokens
+                done.append((req.rid, req.tokens))
+                _M_RETIRED.inc(cause="eos" if hit_eos else "budget")
+        if done:
+            # retirement frees pages AFTER the tick's _note_tick ran; keep
+            # the gauges honest so a drained engine reads occupancy 0
+            _M_LIVE.set(self.live)
+            usable = self.pool.n_pages - 1
+            _M_POOL.set((usable - self.pool.available) / usable
+                        if usable else 0.0)
+        return done
+
+    def _note_tick(self, dt: float, added: int) -> None:
+        _M_STEPS.inc()
+        _M_QUEUE.set(len(self._queue))
+        live = self.live
+        _M_LIVE.set(live)
+        usable = self.pool.n_pages - 1
+        _M_POOL.set((usable - self.pool.available) / usable if usable else 0.0)
+        if added:
+            _M_TOKENS.inc(added)
+            _M_TOK_LAT.observe(dt * live / added)
+        rate = self.acceptance_rate
+        if rate is not None:
+            _M_SPEC_RATE.set(rate)
+
+    def step(self) -> List[Tuple[int, List[int]]]:
+        """One engine tick: retire -> admit -> ONE ragged launch moving
+        every active slot (prefill chunks + decode singles together, or a
+        whole speculative round when a draft is attached and nothing is
+        mid-prefill).  Returns requests that finished THIS tick."""
+        t0 = time.perf_counter()
+        done = self._retire_finished()
+        self._admit()
+        if self.live == 0:
+            self._note_tick(time.perf_counter() - t0, 0)
+            return done
+
+        prefilling = [s for s, r in enumerate(self.slots)
+                      if r is not None and r.n_prefilled < len(r.prompt)]
+        if self.draft is not None and not prefilling:
+            added = self._spec_round()
+            self._note_tick(time.perf_counter() - t0, added)
+            done += self._retire_finished()
+            return done
+
+        qt = self.chunk if prefilling else 1
+        slots = len(self.slots)
+        toks = np.zeros((slots, qt), np.int32)
+        q_lens = np.zeros((slots,), np.int32)
+        n_prefill_toks = 0
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            if req.n_prefilled < len(req.prompt):
+                seg = req.prompt[req.n_prefilled:req.n_prefilled + qt]
+                toks[slot, :len(seg)] = seg
+                q_lens[slot] = len(seg)
+                n_prefill_toks += len(seg)
+            else:
+                toks[slot, 0] = self._next_tok[slot]
+                q_lens[slot] = 1
+        logits, self.state = ragged_model_step(
+            self.params, jnp.asarray(toks), jnp.asarray(q_lens), self.state,
+            self.cfg, attn=self._attn_for(qt))
+        choice = self._sample(logits)
+
+        kind = ("mixed" if prefilling and len(prefilling) < self.live
+                else "prefill" if prefilling else "decode")
+        _M_RB_LAUNCH.inc(kind=kind)
+        if n_prefill_toks:
+            _M_RB_PREFILL.inc(n_prefill_toks)
+        _M_RB_FILL.set(float(q_lens.sum()) / (slots * qt))
+
+        added = 0
+        dtoks = np.zeros((slots,), np.int32)   # draft catch-up feed
+        dlens = np.zeros((slots,), np.int32)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            if choice[slot] < 0:  # sample_logits NaN-poison sentinel
+                raise RuntimeError(
+                    f"slot {slot} (rid {req.rid}) logits are NaN-poisoned: "
+                    "a live slot was stepped without assigned pages")
+            if req.n_prefilled < len(req.prompt):
+                was = req.n_prefilled
+                req.n_prefilled = was + int(q_lens[slot])
+                if req.n_prefilled == len(req.prompt):
+                    # chunk completed the prompt: its last-token logits ARE
+                    # the first-token distribution (TTFT lands here)
+                    tok = int(choice[slot])
+                    req.tokens.append(tok)
+                    self._next_tok[slot] = tok
+                    added += 1
+                    _M_TTFT.observe(time.perf_counter() - req.t_submit)
+            else:
+                tok = int(choice[slot])
+                req.tokens.append(tok)
+                # draft cache catch-up: it must absorb the token the target
+                # just consumed (the PREVIOUS next_tok) to stay aligned
+                dtoks[slot] = toks[slot, 0]
+                dlens[slot] = 1
+                self._next_tok[slot] = tok
+                added += 1
+                _M_RB_DECODE.inc()
+        if self.draft is not None and dlens.any():
+            dp, dc = self.draft
+            _, self.dstate = ragged_model_step(
+                dp, jnp.asarray(dtoks[:, None]), jnp.asarray(dlens),
+                self.dstate, dc, attn="dense")
+        self._note_tick(time.perf_counter() - t0, added)
+        done += self._retire_finished()
+        return done
+
+    def _spec_round(self) -> int:
+        """One speculative round for every (decoding) live slot: k draft
+        proposals via single paged steps on the draft state, ONE ragged
+        all-logits verify of [last | proposals] on the target, per-slot
+        prefix acceptance, then a vectorized lengths rollback on both
+        states.  Greedy; token-exact with the plain engine."""
+        k = self.spec_k
+        dp, dc = self.draft
+        slots = len(self.slots)
+        live_mask = np.asarray([r is not None for r in self.slots])
+        toks_dev = []
+        cur = jnp.asarray(self._next_tok)
+        bad_d = jnp.zeros(slots, bool)
+        for _ in range(k):
+            lg_d, self.dstate = paged_decode_step(dp, cur, self.dstate, dc)
+            bad_d = bad_d | jnp.any(jnp.isnan(lg_d), axis=-1)
+            cur = jnp.argmax(lg_d, axis=-1).astype(jnp.int32)
+            toks_dev.append(cur)
+        d_toks_dev = jnp.stack(toks_dev, axis=1)              # [slots, k]
+        feed = jnp.concatenate(
+            [jnp.asarray(self._next_tok)[:, None], d_toks_dev], axis=1)
+        q_lens = jnp.asarray(np.where(live_mask, k + 1, 0).astype(np.int32))
+        lg_t, self.state = ragged_model_step(
+            self.params, feed, q_lens, self.state, self.cfg,
+            attn=self._attn_for(k + 1), all_logits=True)
+        # draft catch-up to base + k + 1, then the same rollback trims both
+        _, self.dstate = paged_decode_step(
+            dp, d_toks_dev[:, -1], self.dstate, dc)
+        self.spec_rounds += 1
+        _M_RB_LAUNCH.inc(kind="spec-verify")
+        d_toks = np.asarray(d_toks_dev)
+        choice = np.asarray(jnp.argmax(lg_t, axis=-1))        # [slots, k+1]
+        bad = np.asarray(
+            jnp.any(jnp.isnan(lg_t), axis=(1, 2)) | bad_d)
+        undo = np.zeros(slots, np.int32)
+        n_kept = 0
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            if bad[slot]:
+                raise RuntimeError(
+                    f"slot {slot} (rid {req.rid}) speculative logits are "
+                    "NaN-poisoned: stepped without provisioned capacity")
+            n_acc = 0
+            while n_acc < k and d_toks[slot, n_acc] == choice[slot, n_acc]:
+                n_acc += 1
+            self.spec_proposed += k
+            self.spec_accepted += n_acc
+            new = ([int(x) for x in d_toks[slot, :n_acc]]
+                   + [int(choice[slot, n_acc])])
+            new = new[: req.max_new_tokens - len(req.tokens)]
+            if self.eos_id is not None and self.eos_id in new:
+                new = new[: new.index(self.eos_id) + 1]
+            req.tokens += new
+            n_kept += len(new)
+            _M_RB_DECODE.inc(len(new))
+            self._next_tok[slot] = new[-1]
+            undo[slot] = k + 1 - len(new)
+        undo_dev = jnp.asarray(undo)
+        self.state = self.state._replace(
+            lengths=self.state.lengths - undo_dev)
+        self.dstate = self.dstate._replace(
+            lengths=self.dstate.lengths - undo_dev)
+        return n_kept

@@ -1,0 +1,179 @@
+"""The jitted model step behind RaggedServeEngine: scatter each slot's
+new tokens' K/V into its pool pages, attend the whole ragged batch in one
+kernel launch, return next-token logits.
+
+One program serves EVERY engine tick shape with the same q-chunk width:
+per-slot `q_lens` is traced (0 = idle slot, 1 = decode, up to the chunk
+size = prefill), so admission/retirement/chunking never retrace.  The
+compile key is (chunk width, attn path) — a continuous-batching engine
+runs exactly two programs (chunk and 1) plus the speculative verify
+width when a draft is attached.
+
+`attn` selects the kernel: "ragged" is the one-launch Pallas kernel
+(ops/ragged_paged.py); "dense" is the gather-based fallback the engine
+routes through when `ragged_supported` declines the shape — same math,
+paged_multi_step's dense-gather style, O(slots·max_ctx) memory.
+
+Loud-failure contract (paged_decode.py's): a live slot whose tokens
+would land in an unassigned (page 0) table column gets NaN logits — the
+engine raises instead of silently attending sink-page garbage.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.paged_decode import (
+    PagedState, PagePool, _gather_dequant_pages,
+)
+from ..models.transformer import (
+    ModelConfig, _attn_out, _mlp, _qkv_proj, _rms_norm,
+)
+from ..ops.paged_attention import quantize_tokens
+from ..ops.ragged_paged import ragged_paged_attention
+
+
+def _dense_ragged_attention(q, kp, vp, ks, vs, table, pos, real,
+                            cfg: ModelConfig):
+    """Fallback path: dense-gather each slot's pages (including the just-
+    scattered new tokens) and run masked softmax with the same per-row
+    causal band the ragged kernel enforces.  q [S, Nq, QT, D]."""
+    slots, n_q, qt, d = q.shape
+    group = n_q // cfg.n_kv_heads
+    kc = _gather_dequant_pages(kp, ks, table, cfg.n_kv_heads, cfg.d_head)
+    vc = _gather_dequant_pages(vp, vs, table, cfg.n_kv_heads, cfg.d_head)
+    qg = q.reshape(slots, cfg.n_kv_heads, group, qt, d)
+    s = jnp.einsum("bngtd,bnjd->bngtj", qg.astype(jnp.float32),
+                   kc.astype(jnp.float32)) * cfg.d_head**-0.5
+    col = jnp.arange(kc.shape[2], dtype=jnp.int32)[None, None, :]
+    visible = (col <= pos[:, :, None]) & real[:, :, None]
+    if cfg.window is not None:
+        visible &= col > pos[:, :, None] - cfg.window
+    s = jnp.where(visible[:, None, None, :, :], s, float("-inf"))
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(visible[:, None, None, :, :], p, 0.0)  # masked rows -> 0
+    o = jnp.einsum("bngtj,bnjd->bngtd", p, vc.astype(jnp.float32))
+    return o.reshape(slots, n_q, qt, d).astype(q.dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn", "all_logits"),
+         donate_argnums=(3,))
+def ragged_model_step(params, tokens, q_lens, state: PagedState,
+                      cfg: ModelConfig, attn: str = "ragged",
+                      all_logits: bool = False):
+    """Advance every active slot by its own token count in ONE pass.
+
+    tokens  [slots, QT] int32 — slot s consumes tokens[s, :q_lens[s]]
+            (the rest is padding; idle slots pass q_lens == 0)
+    q_lens  [slots] int32 (traced) — tokens this launch per slot
+    state   donated PagedState; each slot's pages for positions
+            lengths .. lengths+q_lens-1 must be pre-assigned
+            (admission/provisioning — the engine's job)
+
+    Returns (logits, new state with lengths += q_lens):
+      all_logits=False: [slots, vocab] fp32 at each slot's LAST consumed
+        token — the next-token distribution a scheduler samples from.
+      all_logits=True:  [slots, QT, vocab] fp32 (speculative verify).
+    """
+    if attn not in ("ragged", "dense"):
+        raise ValueError(f"attn must be 'ragged' or 'dense', got {attn!r}")
+    slots, qt = tokens.shape
+    page = state.k_pages[0].shape[2]
+    quant = state.k_scales is not None
+    live = q_lens > 0
+    base = jnp.where(live, state.lengths, 0)
+    t_ix = jnp.arange(qt, dtype=jnp.int32)[None, :]
+    real = (t_ix < q_lens[:, None]) & live[:, None]       # [slots, QT]
+    pos = base[:, None] + t_ix                            # absolute positions
+    slot_ix = jnp.arange(slots)[:, None]
+    safe_col = jnp.minimum(pos // page, state.page_table.shape[1] - 1)
+    pids = state.page_table[slot_ix, safe_col]
+    # loud failure: a live slot's REAL token mapping to the sink page means
+    # its page was never assigned — poison the logits (a jit cannot raise)
+    boundary_unassigned = jnp.any(real & (pids == 0), axis=1)
+    # padding/idle tokens scatter into the reserved sink page 0
+    pids = jnp.where(real, pids, 0)
+    offs = pos % page
+    kv_lens = base + q_lens
+
+    x = params["embed"].astype(cfg.dtype)[tokens]          # [slots, QT, dm]
+    k_pools, v_pools, k_scs, v_scs = [], [], [], []
+    for li, (p, kp, vp) in enumerate(zip(params["layers"], state.k_pages,
+                                         state.v_pages)):
+        q, k, v = _qkv_proj(p, x, pos, cfg)
+        # scatter the new K/V FIRST so attention reads a complete pool
+        k_rows = jnp.moveaxis(k, 1, 2)                     # [slots,QT,Nkv,D]
+        v_rows = jnp.moveaxis(v, 1, 2)
+        ks = vs = None
+        if quant:
+            k8, k_s = quantize_tokens(k_rows)
+            v8, v_s = quantize_tokens(v_rows)
+            kp = kp.at[pids, :, offs].set(k8)
+            vp = vp.at[pids, :, offs].set(v8)
+            ks = state.k_scales[li].at[pids, :, offs].set(k_s)
+            vs = state.v_scales[li].at[pids, :, offs].set(v_s)
+        else:
+            kp = kp.at[pids, :, offs].set(k_rows.astype(kp.dtype))
+            vp = vp.at[pids, :, offs].set(v_rows.astype(vp.dtype))
+        if attn == "ragged":
+            o = ragged_paged_attention(
+                q, kp, vp, state.page_table, q_lens, kv_lens,
+                k_scales=ks, v_scales=vs, window=cfg.window)
+        else:
+            o = _dense_ragged_attention(q, kp, vp, ks, vs,
+                                        state.page_table, pos, real, cfg)
+        x = x + _attn_out(p, o)
+        m, _ = _mlp(p, x, cfg, inference=True)
+        x = x + m
+        k_pools.append(kp)
+        v_pools.append(vp)
+        k_scs.append(ks)
+        v_scs.append(vs)
+    x = _rms_norm(x, params["final_norm"])
+    if all_logits:
+        logits = jnp.einsum("btd,vd->btv", x, params["lm_head"],
+                            preferred_element_type=jnp.float32)
+        logits = jnp.where(boundary_unassigned[:, None, None], jnp.nan,
+                           logits)
+    else:
+        last = jnp.clip(q_lens - 1, 0, qt - 1)
+        x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)
+        logits = jnp.einsum("bsd,vd->bsv", x_last, params["lm_head"],
+                            preferred_element_type=jnp.float32)[:, 0]
+        logits = jnp.where(boundary_unassigned[:, None], jnp.nan, logits)
+    lengths = state.lengths + jnp.where(live, q_lens, 0)
+    return logits, PagedState(
+        tuple(k_pools), tuple(v_pools), state.page_table, lengths,
+        tuple(k_scs) if quant else None, tuple(v_scs) if quant else None)
+
+
+def assign_pages(state: PagedState, slot: int, ids) -> PagedState:
+    """Host-side: point `slot`'s table row at freshly acquired pages (the
+    engine reserves a request's FULL lifetime at admission, before any
+    token lands).  The slot's length stays 0 until the first chunk; the
+    row must be empty (retired) first."""
+    if not ids:
+        return state
+    if int(state.lengths[slot]) != 0:
+        raise RuntimeError(f"slot {slot} is still live; free_slot first")
+    table = state.page_table.at[slot, :len(ids)].set(
+        np.asarray(ids, np.int32))
+    return state._replace(page_table=table)
+
+
+def free_slot(state: PagedState, pool: PagePool, slot: int) -> PagedState:
+    """Host-side: release EVERY page in `slot`'s table row and empty it.
+
+    Unlike paged_decode.retire_slot this does NOT early-return on length
+    0 — the ragged engine assigns pages at admission, before the first
+    prefill chunk lands, so a slot can hold pages at length 0 (mid-
+    admission rollback) and they must not leak."""
+    row = np.asarray(state.page_table[slot])
+    ids = [int(i) for i in row if i != 0]
+    if ids:
+        pool.release(ids)
+    return state._replace(
+        lengths=state.lengths.at[slot].set(0),
+        page_table=state.page_table.at[slot].set(0))

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Serving bench smoke: drive RaggedServeEngine on a tiny model and emit
+headline records for the perf-regression gate.
+
+Runs anywhere (CPU interpret path) in a few seconds; on TPU the same
+harness exercises the compiled ragged kernel.  Two headline records land
+in results/:
+
+  headline_serve_ttft.json      serve.ttft_p99 seconds   (direction: lower)
+  headline_serve_tokens.json    serve.tokens_per_s       (direction: higher)
+
+check_regression.py gates both against BENCH_*.json history — TTFT with
+the inverted (ceiling) sense via the record's `direction` field.  The
+`scripts/test.sh --serve` lane runs this smoke and then the gate in
+dry-run, so a serving-path slowdown surfaces on every lane run without
+flaking CI on shared-machine noise.
+
+    python scripts/bench_serve.py [--slots 4] [--requests 8] [--out results]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _hist_p99(before, after):
+    """p99 (seconds) of the TTFT observations that landed between the two
+    Histogram.get() snapshots.  Bucket counts are per-bin (not cumulative);
+    the p99 is the upper edge of the first bin where the cumulative delta
+    crosses 99% — overflow ("+Inf") reports the window's max instead, the
+    honest bound when the tail escaped the bins."""
+    db = dict(before.get("buckets") or {})
+    deltas = [(edge, count - db.get(edge, 0))
+              for edge, count in (after.get("buckets") or {}).items()]
+    finite = sorted(((float(e), d) for e, d in deltas if e != "+Inf"),
+                    key=lambda ed: ed[0])
+    overflow = sum(d for e, d in deltas if e == "+Inf")
+    total = sum(d for _, d in finite) + overflow
+    if total <= 0:
+        return float(after.get("max", 0.0) or 0.0)
+    need, seen = 0.99 * total, 0
+    for edge, d in finite:
+        seen += d
+        if seen >= need:
+            return edge
+    return float(after.get("max", 0.0) or 0.0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python scripts/bench_serve.py")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=48)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--out", default=os.path.join(ROOT, "results"))
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from burst_attn_tpu import obs
+    from burst_attn_tpu.models import ModelConfig, init_params
+    from burst_attn_tpu.serving import RaggedServeEngine
+
+    cfg = ModelConfig(
+        vocab=256, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_head=32, d_ff=256, block_q=8, block_kv=8, attn_backend="jnp",
+        remat=False, dtype=jnp.float32, batch_axis=None, head_axis=None)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    eng = RaggedServeEngine(params, cfg, slots=args.slots,
+                            n_pages=args.slots * 2 + 2, page=128,
+                            max_pages_per_seq=4, chunk=args.chunk)
+
+    # warmup: compile both launch widths before the timed window
+    eng.submit(rng.integers(1, cfg.vocab, size=args.prompt_len), 2)
+    eng.run()
+    before = obs.histogram("serve.ttft_s").get()
+
+    t0 = time.perf_counter()
+    for _ in range(args.requests):
+        eng.submit(rng.integers(1, cfg.vocab, size=args.prompt_len),
+                   args.max_new)
+    res = eng.run()
+    wall = time.perf_counter() - t0
+    n_tokens = sum(len(v) for v in res.values())
+
+    ttft_p99 = _hist_p99(before, obs.histogram("serve.ttft_s").get())
+    tokens_per_s = n_tokens / wall if wall > 0 else 0.0
+    platform = jax.devices()[0].platform
+
+    os.makedirs(args.out, exist_ok=True)
+    records = [
+        ("headline_serve_ttft.json", {
+            "metric": f"serve.ttft_p99 s @ ragged chunk={args.chunk} "
+                      f"slots={args.slots} {platform}",
+            "value": round(ttft_p99, 6), "unit": "s", "direction": "lower",
+            "timestamp": time.time(),
+            "note": "bench_serve.py smoke (RaggedServeEngine continuous "
+                    "batching)"}),
+        ("headline_serve_tokens.json", {
+            "metric": f"serve.tokens_per_s @ ragged chunk={args.chunk} "
+                      f"slots={args.slots} {platform}",
+            "value": round(tokens_per_s, 3), "unit": "tokens/s",
+            "direction": "higher", "timestamp": time.time(),
+            "note": "bench_serve.py smoke (RaggedServeEngine continuous "
+                    "batching)"}),
+    ]
+    for name, rec in records:
+        path = os.path.join(args.out, name)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+        print(f"{rec['metric']}: {rec['value']} {rec['unit']} -> {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,8 +14,11 @@ instead of three rounds later in a VERDICT.
     python scripts/check_regression.py --tolerance 0.05
 
 Matching is by the exact `metric` string (configs self-describe:
-"... TFLOPs/s/chip @ seq=65536 causal bf16"), value direction is
-higher-is-better.  Metrics with no history PASS with a note — a brand-new
+"... TFLOPs/s/chip @ seq=65536 causal bf16").  Value direction defaults to
+higher-is-better; a headline record carrying `"direction": "lower"`
+(latency-style metrics — serve.ttft_p99) gates the other way: regression
+means rising more than `--tolerance` ABOVE the best (lowest) prior.
+Metrics with no history PASS with a note — a brand-new
 config cannot regress.  Cached headline replays still gate: a cached record
 IS a prior on-chip measurement, and history only moves when fresh runs land.
 Cached provenance (`cached` / `cached_age_hours` from bench.py's replay
@@ -61,10 +64,12 @@ def load_headlines(patterns):
 
 
 def load_history(patterns, baseline_path):
-    """metric -> (best_value, source) over BENCH round files + BASELINE
-    published numbers.  Files that don't parse or carry no number are
-    skipped silently — history is best-effort evidence, the gate only
-    needs what it can read."""
+    """metric -> [(value, source), ...] over BENCH round files + BASELINE
+    published numbers.  ALL readings are kept — which one is "best" depends
+    on the headline's direction (max for throughput, min for latency), so
+    the choice belongs to check().  Files that don't parse or carry no
+    number are skipped silently — history is best-effort evidence, the
+    gate only needs what it can read."""
     best = {}
 
     def _offer(metric, value, source):
@@ -73,8 +78,7 @@ def load_history(patterns, baseline_path):
             value = float(value)
         except (TypeError, ValueError):
             return
-        if metric not in best or value > best[metric][0]:
-            best[metric] = (value, source)
+        best.setdefault(metric, []).append((value, source))
 
     for pat in patterns:
         for path in sorted(glob.glob(pat)):
@@ -119,19 +123,33 @@ def check(headlines, history, tolerance, max_cached_age=None):
     for path, metric, value, rec in headlines:
         note = _cached_note(rec)
         prior = history.get(metric)
+        # headline records self-describe their sense: direction "lower"
+        # (latency-style — serve.ttft_p99) regresses UP past a ceiling;
+        # the default "higher" (throughput-style) regresses DOWN past a
+        # floor.  History's best follows the same sense.
+        lower = str(rec.get("direction", "higher")).lower() == "lower"
         if prior is None:
             verdicts.append(("NO-HISTORY",
                              f"NO-HISTORY  {metric}: {value:g} "
                              f"({os.path.basename(path)}){note} — nothing "
                              "to compare against"))
         else:
-            best, source = prior
-            floor = best * (1.0 - tolerance)
+            best, source = (min if lower else max)(prior,
+                                                   key=lambda vs: vs[0])
             ratio = value / best if best else float("inf")
+            if lower:
+                bound = best * (1.0 + tolerance)
+                regressed = value > bound
+                bound_word = "ceiling"
+            else:
+                bound = best * (1.0 - tolerance)
+                regressed = value < bound
+                bound_word = "floor"
             line = (f"{metric}: current {value:g}{note} vs best {best:g} "
-                    f"[{source}] = {ratio:.4f} (floor {floor:g} at "
-                    f"tolerance {tolerance:g})")
-            if value < floor:
+                    f"[{source}] = {ratio:.4f} ({bound_word} {bound:g} at "
+                    f"tolerance {tolerance:g}"
+                    + (", direction=lower)" if lower else ")"))
+            if regressed:
                 verdicts.append(("REGRESSION", f"REGRESSION  {line}"))
             else:
                 verdicts.append(("PASS", f"PASS        {line}"))

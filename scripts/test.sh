@@ -7,7 +7,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 args=("$@")
 filtered=()
-fast=0; tpu=0; fused=0; obs=0; schedule=0
+fast=0; tpu=0; fused=0; obs=0; schedule=0; serve=0
 for a in "${args[@]}"; do
   case "$a" in
     --fast) fast=1 ;;
@@ -15,6 +15,7 @@ for a in "${args[@]}"; do
     --fused) fused=1 ;;
     --obs) obs=1 ;;
     --schedule) schedule=1 ;;
+    --serve) serve=1 ;;
     *) filtered+=("$a") ;;
   esac
 done
@@ -48,6 +49,22 @@ for p in range(2):
 PY
   python -m burst_attn_tpu.obs --merge "$obs_tmp/obs*.jsonl" > /dev/null
   python scripts/check_regression.py --dry-run
+elif [[ $serve == 1 ]]; then
+  # focused lane for the ragged paged serving subsystem: the one-launch
+  # ragged kernel's interpret-mode parity + probe tests, the continuous-
+  # batching engine (admission/eviction/speculative policy, load-shed
+  # ordering), and the ring->pages handoff — the quick iteration loop
+  # while working on burst_attn_tpu/serving/ and ops/ragged_paged.py
+  python -m pytest tests/test_ragged_paged.py tests/test_serving.py \
+    tests/test_serving_handoff.py tests/test_check_regression.py -q \
+    ${filtered[@]+"${filtered[@]}"}
+  # bench smoke + perf gate: drive the engine end to end, emit the
+  # serve.ttft_p99 (direction: lower) and serve.tokens_per_s headlines,
+  # then gate them against BENCH history in dry-run — a serving-path
+  # slowdown surfaces on every lane run without flaking CI on noise
+  python scripts/bench_serve.py
+  python scripts/check_regression.py \
+    --headline 'results/headline_serve_*.json' --dry-run
 elif [[ $schedule == 1 ]]; then
   # focused lane for the ring-schedule IR + compiler (parallel/schedule.py):
   # compiler/oracle unit tests, interpret-mode parity of the bidi and

@@ -92,6 +92,21 @@ _SLOW = {
     ("test_schedule.py", "test_schedule_matches_host_expectation"),
     ("test_serve.py", "test_speculative_serving_matches_plain_engine"),
     ("test_ulysses.py", "test_ulysses_fwd_grad"),
+    ("test_ragged_paged.py", "test_chunk_width_equals_sequential_chunks"),
+    ("test_ragged_paged.py", "test_mixed_batch_matches_oracle_windowed"),
+    ("test_ragged_paged.py",
+     "test_decode_rows_bit_equal_paged_decode_variants"),
+    ("test_ragged_paged.py", "test_mixed_batch_int8_matches_oracle"),
+    ("test_ragged_paged.py", "test_gqa_groups_match_oracle"),
+    ("test_serving.py", "test_engine_speculative_policy_token_exact"),
+    ("test_serving.py", "test_legacy_engine_load_shed_split"),
+    ("test_serving.py", "test_engine_exhaustion_admission_waits_then_proceeds"),
+    ("test_serving.py", "test_engine_rejection_labels_and_shed_order"),
+    ("test_serving_handoff.py",
+     "test_ring_prefill_pages_are_ring_shards_no_relayout"),
+    ("test_serving_handoff.py", "test_handoff_decodes_token_exact_single_host"),
+    ("test_serving_handoff.py",
+     "test_handoff_generate_sequence_parallel_token_exact"),
     ("test_window.py", "test_burst_ring_contig_window"),
     ("test_window.py", "test_burst_ring_window_grad"),
     ("test_window.py", "test_decode_window_matches_forward"),

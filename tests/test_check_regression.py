@@ -57,6 +57,42 @@ def test_cached_provenance_and_stale_warn_never_gate(tmp_path, capsys):
         assert "STALE-CACHE" not in capsys.readouterr().out
 
 
+@pytest.mark.parametrize("value,want_exit", [(0.105, 0), (0.25, 1)])
+def test_direction_lower_gates_as_ceiling(tmp_path, capsys, value, want_exit):
+    """A headline carrying direction "lower" (latency-style —
+    serve.ttft_p99) regresses when it rises ABOVE best*(1+tolerance);
+    best prior is the LOWEST history reading, not the highest."""
+    _write(tmp_path / "results" / "headline.json",
+           {"metric": "serve.ttft_p99 s", "value": value,
+            "direction": "lower"})
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"metric": "serve.ttft_p99 s", "value": 0.10}})
+    _write(tmp_path / "BENCH_r02.json",
+           {"parsed": {"metric": "serve.ttft_p99 s", "value": 0.30}})
+    assert cr.main(_argv(tmp_path)) == want_exit
+    out = capsys.readouterr().out
+    assert ("REGRESSION" in out) == bool(want_exit)
+    assert "direction=lower" in out and "ceiling" in out
+    assert "best 0.1 [BENCH_r01.json]" in out  # min of history, not max
+
+
+def test_direction_default_still_floors(tmp_path, capsys):
+    """Records with no direction field keep the historical floor sense —
+    the serve lane's two headlines gate in opposite directions from the
+    same history files."""
+    _write(tmp_path / "results" / "headline_t.json",
+           {"metric": "tps", "value": 95.0})
+    _write(tmp_path / "results" / "headline_l.json",
+           {"metric": "lat", "value": 0.09, "direction": "lower"})
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"metric": "tps", "value": 100.0}})
+    _write(tmp_path / "BENCH_r02.json",
+           {"parsed": {"metric": "lat", "value": 0.10}})
+    assert cr.main(_argv(tmp_path)) == 0
+    out = capsys.readouterr().out
+    assert "floor" in out and "ceiling" in out
+
+
 def test_stale_warning_rides_next_to_a_regression(tmp_path, capsys):
     """STALE-CACHE is additive: a genuinely regressed cached record still
     exits 1, with both lines and the age in the JSON verdict stream."""

@@ -1,0 +1,271 @@
+"""RaggedServeEngine (burst_attn_tpu/serving/): continuous batching over
+the one-launch ragged kernel, token-exact with single-stream generate().
+
+Covers the subsystem contract end to end on CPU:
+  * ragged_model_step (chunked prefill + decode in one launch) matches
+    generate() through BOTH kernel routes (ragged / dense fallback);
+  * the engine interleaves admission, chunked prefill, decode, and
+    retirement with no token drift and no leaked pages;
+  * speculative decoding as a scheduler policy stays token-exact;
+  * page-pool exhaustion/eviction: admission waits under pressure,
+    retirement frees everything, occupancy returns to zero;
+  * load shedding labels pool pressure BEFORE queue pressure, on both
+    the new engine and the legacy models/serve.ServeEngine (satellite);
+  * the probe-declined fallback path counts a labeled
+    burst.fused_fallback{pass=serve} and still serves correctly;
+  * the `ragged-serve-safe` burstlint rule is active, clean on the real
+    kernel, and actually fires on a mutated (callback-carrying) program.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from burst_attn_tpu import obs
+from burst_attn_tpu.models import ModelConfig, init_params, generate
+from burst_attn_tpu.models.serve import ServeEngine
+from burst_attn_tpu.serving import RaggedServeEngine
+from burst_attn_tpu.serving.model import (
+    assign_pages, free_slot, ragged_model_step,
+)
+from burst_attn_tpu.models.paged_decode import init_paged_state
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(
+        vocab=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_head=16,
+        d_ff=128, block_q=8, block_kv=8, attn_backend="jnp", remat=False,
+        dtype=jnp.float32, batch_axis=None, head_axis=None,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(11)
+    lengths = [9, 5, 13, 3]
+    prompts = [np.asarray(rng.integers(1, cfg.vocab, size=(n,)), np.int32)
+               for n in lengths]
+    steps = [5, 4, 6, 3]
+    refs = [list(np.asarray(generate(params, jnp.asarray(p)[None], cfg,
+                                     steps=s, max_seq=256)[0]))
+            for p, s in zip(prompts, steps)]
+    return cfg, params, prompts, steps, refs
+
+
+@pytest.mark.parametrize("attn", ["ragged", "dense"])
+def test_ragged_model_step_matches_generate(setup, attn):
+    """Chunked prefill + interleaved decode through ONE jitted step per
+    tick reproduces generate() token-for-token on every slot."""
+    cfg, params, prompts, steps, refs = setup
+    prompts, steps, refs = prompts[:2], steps[:2], refs[:2]
+    lengths = [len(p) for p in prompts]
+    chunk, slots = 4, 2
+    st, pool = init_paged_state(cfg, slots=slots, n_pages=10, page=128,
+                                max_pages_per_seq=4)
+    for s_ in range(slots):
+        st = assign_pages(st, s_, pool.acquire(1))
+    prefilled = [0] * slots
+    out = [[] for _ in range(slots)]
+    while True:
+        any_prefill = any(prefilled[s_] < lengths[s_] for s_ in range(slots))
+        if not any_prefill and all(len(out[s_]) >= steps[s_]
+                                   for s_ in range(slots)):
+            break
+        qt = chunk if any_prefill else 1
+        toks = np.zeros((slots, qt), np.int32)
+        qls = np.zeros((slots,), np.int32)
+        for s_ in range(slots):
+            if prefilled[s_] < lengths[s_]:
+                seg = prompts[s_][prefilled[s_]:prefilled[s_] + qt]
+                toks[s_, :len(seg)] = seg
+                qls[s_] = len(seg)
+            elif len(out[s_]) < steps[s_]:
+                toks[s_, 0] = out[s_][-1]
+                qls[s_] = 1
+        logits, st = ragged_model_step(params, jnp.asarray(toks),
+                                       jnp.asarray(qls), st, cfg, attn=attn)
+        logits = np.asarray(logits)
+        assert not np.any(np.isnan(logits[np.asarray(qls) > 0]))
+        for s_ in range(slots):
+            if qls[s_] == 0:
+                continue
+            if prefilled[s_] < lengths[s_]:
+                prefilled[s_] += int(qls[s_])
+                if prefilled[s_] == lengths[s_]:
+                    out[s_].append(int(np.argmax(logits[s_])))
+            elif len(out[s_]) < steps[s_]:
+                out[s_].append(int(np.argmax(logits[s_])))
+    for s_ in range(slots):
+        assert out[s_][:steps[s_]] == refs[s_]
+        st = free_slot(st, pool, s_)
+    assert pool.available == 9  # nothing orphaned
+
+
+def test_engine_continuous_batching_token_exact(setup):
+    """More requests than slots: chunked admission keeps every stream
+    token-exact with generate(); the pool drains back to full and the
+    occupancy gauge returns to zero."""
+    cfg, params, prompts, steps, refs = setup
+    eng = RaggedServeEngine(params, cfg, slots=2, n_pages=10, page=128,
+                            max_pages_per_seq=4, chunk=4)
+    rids = [eng.submit(p, s) for p, s in zip(prompts, steps)]
+    res = eng.run()
+    for rid, want in zip(rids, refs):
+        assert res[rid] == want
+    assert eng.live == 0 and eng.pending == 0
+    assert eng.pool.available == 9  # every page back after retirement
+    assert obs.gauge("serve.page_pool_occupancy").get() == 0.0
+    # the ragged-batch family saw the work
+    assert obs.counter("serve.ragged_batch_prefill_tokens").get() > 0
+    assert obs.counter("serve.ragged_batch_decode_tokens").get() > 0
+
+
+def test_engine_speculative_policy_token_exact(setup):
+    """Speculative decoding as a scheduler policy: same tokens, both
+    pools drained after retirement."""
+    cfg, params, prompts, steps, refs = setup
+    dcfg = ModelConfig(
+        vocab=97, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2, d_head=16,
+        d_ff=64, block_q=8, block_kv=8, attn_backend="jnp", remat=False,
+        dtype=jnp.float32, batch_axis=None, head_axis=None,
+    )
+    dparams = init_params(jax.random.PRNGKey(1), dcfg)
+    eng = RaggedServeEngine(params, cfg, slots=2, n_pages=12, page=128,
+                            max_pages_per_seq=4, chunk=4,
+                            draft_params=dparams, draft_cfg=dcfg, spec_k=3)
+    rids = [eng.submit(p, s) for p, s in zip(prompts, steps)]
+    res = eng.run()
+    for rid, want in zip(rids, refs):
+        assert res[rid] == want
+    assert eng.spec_rounds > 0
+    assert eng.pool.available == 11
+    assert eng.dpool.available == 11
+
+
+def test_engine_exhaustion_admission_waits_then_proceeds(setup):
+    """Pool pressure: a request that cannot fit WAITS in the queue (no
+    refusal without max_queue) and is admitted after retirement frees
+    pages; nothing orphans."""
+    cfg, params, prompts, steps, refs = setup
+    # 3 usable pages; the big request reserves all of them, the small one
+    # must wait for its page until the big one retires
+    eng = RaggedServeEngine(params, cfg, slots=2, n_pages=4, page=128,
+                            max_pages_per_seq=4, chunk=4)
+    big = np.asarray(np.arange(1, 201) % 96 + 1, np.int32)   # 200 toks
+    r_big = eng.submit(big, 184)     # 200+184 = 384 tokens -> 3 pages
+    r_small = eng.submit(prompts[1], 2)
+    eng.step()
+    assert eng.live == 1             # big admitted, small waits: 0 pages free
+    assert eng.pool.available == 0
+    assert eng.pending == 1
+    # drive until the big request retires and the small one completes
+    res = eng.run(max_steps=500)
+    assert len(res[r_big]) == 184
+    assert res[r_small] == refs[1][:2]
+    assert eng.pool.available == 3
+    assert obs.gauge("serve.page_pool_occupancy").get() == 0.0
+
+
+def test_engine_rejection_labels_and_shed_order(setup):
+    """submit() reason labels: malformed -> ValueError; with max_queue,
+    pool pressure sheds BEFORE queue pressure."""
+    cfg, params, prompts, steps, refs = setup
+    eng = RaggedServeEngine(params, cfg, slots=1, n_pages=4, page=128,
+                            max_pages_per_seq=8, chunk=4, max_queue=2)
+
+    def count(reason):
+        return obs.counter("serve.requests_rejected").get(reason=reason)
+
+    base = {r: count(r) for r in ("empty-prompt", "bad-budget", "table-width",
+                                  "pool-size", "pool-exhausted", "queue-full")}
+    with pytest.raises(ValueError):
+        eng.submit([], 5)
+    with pytest.raises(ValueError):
+        eng.submit([1, 2], 0)
+    with pytest.raises(ValueError):
+        eng.submit(np.ones(2000, np.int32), 5)      # table-width
+    eng.submit(np.ones(200, np.int32), 100)          # 3 pages = whole pool
+    eng.step()
+    assert eng.pool.available == 0
+    eng.submit(np.ones(4, np.int32), 4)              # empty queue: may wait
+    with pytest.raises(RuntimeError, match="pool-exhausted"):
+        eng.submit(np.ones(4, np.int32), 4)          # queue + pool pressure
+    assert count("pool-exhausted") == base["pool-exhausted"] + 1
+    # queue pressure alone (pool has room): queue-full
+    eng2 = RaggedServeEngine(params, cfg, slots=1, n_pages=40, page=128,
+                             max_pages_per_seq=8, chunk=4, max_queue=1)
+    eng2.submit(np.ones(4, np.int32), 4)
+    eng2.step()
+    eng2.submit(np.ones(4, np.int32), 4)
+    with pytest.raises(RuntimeError, match="queue-full"):
+        eng2.submit(np.ones(4, np.int32), 4)
+    assert count("queue-full") == base["queue-full"] + 1
+    assert count("empty-prompt") == base["empty-prompt"] + 1
+    assert count("bad-budget") == base["bad-budget"] + 1
+    assert count("table-width") == base["table-width"] + 1
+
+
+def test_legacy_engine_load_shed_split(setup):
+    """Satellite: models/serve.ServeEngine gets the same max_queue split —
+    pool-exhausted sheds first, queue-full only when pages were free."""
+    cfg, params, prompts, steps, refs = setup
+    eng = ServeEngine(params, cfg, slots=1, n_pages=4, page=128,
+                      max_pages_per_seq=8, max_queue=2)
+    eng.submit(np.ones(200, np.int32), 100)
+    eng.step()
+    assert eng.pool.available == 0
+    eng.submit(np.ones(4, np.int32), 4)
+    with pytest.raises(RuntimeError, match="pool-exhausted"):
+        eng.submit(np.ones(4, np.int32), 4)
+    eng2 = ServeEngine(params, cfg, slots=1, n_pages=40, page=128,
+                       max_pages_per_seq=8, max_queue=1)
+    eng2.submit(np.ones(4, np.int32), 4)
+    eng2.step()
+    eng2.submit(np.ones(4, np.int32), 4)
+    with pytest.raises(RuntimeError, match="queue-full"):
+        eng2.submit(np.ones(4, np.int32), 4)
+
+
+def test_probe_decline_routes_dense_with_labeled_fallback(setup, monkeypatch):
+    """When ragged_supported declines, the engine serves through the dense
+    path (still token-exact) and counts ONE labeled
+    burst.fused_fallback{pass=serve} per launch width."""
+    cfg, params, prompts, steps, refs = setup
+    from burst_attn_tpu.serving import engine as engine_mod
+
+    monkeypatch.setattr(
+        engine_mod, "ragged_supported",
+        lambda **kw: "VMEM plan 999 bytes exceeds the 1 budget (synthetic)")
+    before = obs.counter("burst.fused_fallback").get(
+        reason="vmem-budget", **{"pass": "serve"})
+    eng = RaggedServeEngine(params, cfg, slots=2, n_pages=10, page=128,
+                            max_pages_per_seq=4, chunk=4)
+    rids = [eng.submit(p, s) for p, s in
+            zip(prompts[:2], steps[:2])]
+    res = eng.run()
+    for rid, want in zip(rids, refs[:2]):
+        assert res[rid] == want
+    assert eng._attn_cache and set(eng._attn_cache.values()) == {"dense"}
+    after = obs.counter("burst.fused_fallback").get(
+        reason="vmem-budget", **{"pass": "serve"})
+    # one count per distinct launch width probed (chunk and decode)
+    assert after - before == len(eng._attn_cache)
+
+
+def test_servecheck_rule_clean_and_fires_on_mutant():
+    """burstlint's ragged-serve-safe: zero findings on the real kernel;
+    a callback smuggled into a traced program IS flagged."""
+    from burst_attn_tpu.analysis import servecheck
+    from burst_attn_tpu.analysis.core import RULES
+
+    assert "ragged-serve-safe" in RULES
+    assert servecheck.check_all() == []
+
+    def mutant(x):
+        jax.debug.callback(lambda v: None, x)
+        return x * 2
+
+    jx = jax.make_jaxpr(mutant)(jnp.ones((4,), jnp.float32))
+    findings = servecheck.check_trace(jx, where="mutant",
+                                      anchor=("<test>", 1))
+    assert any(f.rule == "ragged-serve-safe" and "callback" in f.message
+               for f in findings)

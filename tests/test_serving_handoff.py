@@ -1,0 +1,125 @@
+"""The ring→pages handoff (serving/handoff.py): ring-sharded prefill
+lands K/V directly in pool pages — byte-for-byte the ring's own shard
+layout, NO re-layout copy — and both paged decode paths (single-host and
+sequence-parallel) continue the stream token-exact with generate()."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from burst_attn_tpu.models import ModelConfig, init_params, generate
+from burst_attn_tpu.models.dist_decode import (
+    dist_paged_decode_step, dist_prefill,
+)
+from burst_attn_tpu.models.paged_decode import (
+    init_paged_state, paged_decode_step, provision_capacity,
+)
+from burst_attn_tpu.models.train import make_mesh
+from burst_attn_tpu.parallel import layouts
+from burst_attn_tpu.serving.handoff import (
+    handoff_generate, ring_prefill_to_pages,
+)
+
+PAGE, S, STEPS = 128, 256, 4
+N_PAGES = 8   # divisible by the sp world for the sharded pool
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_head=16,
+        d_ff=128, block_q=16, block_kv=16, attn_backend="jnp", remat=False,
+        dtype=jnp.float32, layout="zigzag", batch_axis=None, head_axis=None,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh({"sp": 4})
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (S,), 0, cfg.vocab)
+    return cfg, params, mesh, prompt
+
+
+@pytest.fixture(scope="module")
+def ref(setup):
+    # the dense-decode reference is only needed by the (slow-marked)
+    # parity tests — keep the fast-lane rejection tests from paying it
+    cfg, params, _, prompt = setup
+    return list(np.asarray(generate(params, prompt[None], cfg, steps=STEPS,
+                                    max_seq=S + STEPS)[0]))
+
+
+def _fresh(cfg):
+    return init_paged_state(cfg, slots=2, n_pages=N_PAGES, page=PAGE,
+                            max_pages_per_seq=6)
+
+
+def test_ring_prefill_pages_are_ring_shards_no_relayout(setup, ref):
+    """The pool pages hold the ring's LAYOUT-order K/V — concatenating a
+    slot's pages in table order reproduces dist_prefill's sharded cache,
+    proving the handoff never re-laid the million-token cache out."""
+    cfg, params, mesh, prompt = setup
+    state, pool = _fresh(cfg)
+    last, state = ring_prefill_to_pages(params, prompt, state, pool, 0,
+                                        cfg, mesh)
+    assert pool.available == N_PAGES - 1 - S // PAGE
+    assert int(state.lengths[0]) == S
+    assert int(np.argmax(np.asarray(last))) == ref[0]
+    _, cache = dist_prefill(params, prompt[None], cfg, mesh, gen_budget=4)
+    table0 = np.asarray(state.page_table[0])
+    for li in range(cfg.n_layers):
+        ring_shard = np.asarray(cache.k_shard[li][0])   # [Nkv, S, D] layout
+        paged = np.concatenate(
+            [np.asarray(state.k_pages[li][table0[j]])
+             for j in range(S // PAGE)], axis=1)
+        np.testing.assert_allclose(paged, ring_shard, rtol=2e-5, atol=2e-5)
+
+
+def test_handoff_decodes_token_exact_single_host(setup, ref):
+    """Ring-prefilled pages feed the plain paged decode kernel directly:
+    the serving engine could pick this slot up as-is."""
+    cfg, params, mesh, prompt = setup
+    state, pool = _fresh(cfg)
+    last, state = ring_prefill_to_pages(params, prompt, state, pool, 0,
+                                        cfg, mesh)
+    state = provision_capacity(state, pool, 0, STEPS)
+    out = [int(np.argmax(np.asarray(last)))]
+    feed = np.zeros((2,), np.int32)
+    for _ in range(STEPS - 1):
+        feed[0] = out[-1]
+        lg, state = paged_decode_step(params, jnp.asarray(feed), state, cfg)
+        out.append(int(np.argmax(np.asarray(lg[0]))))
+    assert out == ref[:STEPS]
+
+
+def test_handoff_generate_sequence_parallel_token_exact(setup, ref):
+    """End to end: ring prefill -> pages -> dist_paged_decode_step
+    (pool page-dim sharded over sp, LSE-merged partials) == generate()."""
+    cfg, params, mesh, prompt = setup
+    state, pool = _fresh(cfg)
+    out, state = handoff_generate(params, prompt, state, pool, cfg, mesh,
+                                  steps=STEPS)
+    assert out == ref[:STEPS]
+    assert int(state.lengths[0]) == S + STEPS - 1  # last token not appended
+
+
+def test_handoff_rejects_window_and_ragged_lengths(setup):
+    cfg, params, mesh, prompt = setup
+    state, pool = _fresh(cfg)
+    wcfg = ModelConfig(**{**cfg.__dict__, "window": 64, "layout": "contig"})
+    with pytest.raises(ValueError, match="window"):
+        ring_prefill_to_pages(params, prompt, state, pool, 0, wcfg, mesh)
+    with pytest.raises(ValueError, match="multiple"):
+        ring_prefill_to_pages(params, prompt[:100], state, pool, 0, cfg, mesh)
+    assert pool.available == N_PAGES - 1  # failed calls leaked nothing
+
+
+def test_dist_paged_decode_rejects_window_and_odd_pool(setup):
+    cfg, params, mesh, prompt = setup
+    state, pool = _fresh(cfg)
+    wcfg = ModelConfig(**{**cfg.__dict__, "window": 64, "layout": "contig"})
+    feed = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="window"):
+        dist_paged_decode_step(params, feed, state, wcfg, mesh)
+    odd_state, _ = init_paged_state(cfg, slots=2, n_pages=7, page=PAGE,
+                                    max_pages_per_seq=6)
+    with pytest.raises(ValueError, match="divisible|multiple|world"):
+        dist_paged_decode_step(params, feed, odd_state, cfg, mesh)
