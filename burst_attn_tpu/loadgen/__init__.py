@@ -1,0 +1,35 @@
+"""loadgen: trace-replay load harness, fault injection, SLO gates.
+
+Production-serve hardening for the serving stack (ISSUE 9 / ROADMAP
+item 5), in four layers:
+
+  trace    deterministic workload model — seeded ragged/bursty/poison
+           traces serialized as replayable JSONL (loadgen/trace.py)
+  driver   open-loop replay against one engine + the single-process
+           token oracle and the zero-corruption diff (loadgen/driver.py)
+  cluster  N spawned CPU serve workers behind a router with first-class
+           fault injection (kill / pool-hog / stall), rerouting, and
+           merged obs (loadgen/cluster.py, loadgen/worker.py)
+  slo      p50/p99 TTFT + token latency, goodput, shed-rate from the
+           merged export; Objectives pass/fail (loadgen/slo.py)
+
+CLI: python -m burst_attn_tpu.loadgen {gen,replay,slo} ...
+Docs: docs/loadgen.md
+"""
+
+from .cluster import ClusterReport, FaultEvent, LoadGenCluster
+from .driver import (
+    Outcome, ReplayReport, assert_token_exact, diff_tokens, oracle_replay,
+    replay_trace,
+)
+from .slo import Objectives, compute_slo, evaluate, format_slo
+from .trace import Trace, TraceRequest, load_trace, save_trace, \
+    synthesize_trace
+
+__all__ = [
+    "ClusterReport", "FaultEvent", "LoadGenCluster", "Objectives",
+    "Outcome", "ReplayReport", "Trace", "TraceRequest",
+    "assert_token_exact", "compute_slo", "diff_tokens", "evaluate",
+    "format_slo", "load_trace", "oracle_replay", "replay_trace",
+    "save_trace", "synthesize_trace",
+]

@@ -1,0 +1,377 @@
+"""Multi-process serve cluster: router, fault schedule, recovery proof.
+
+N worker processes (loadgen/worker.py — each its own spawned interpreter
+with a single-process CPU JAX runtime and its own obs registry) behind
+one in-process router.  The router replays a Trace open-loop: arrivals
+route to the least-loaded alive worker, retryable sheds back off and
+re-route, and a FAULT SCHEDULE injects failures at virtual times:
+
+  kill    SIGKILL the worker process (no cooperation, no cleanup — the
+          real failure mode).  The router reroutes every rid the dead
+          worker still owed to surviving workers; greedy decode
+          regenerates each rerouted request's tokens EXACTLY, so the
+          kill is invisible in the output stream — the property
+          `assert_token_exact` gates against the single-process oracle.
+  hog     force pool exhaustion inside the worker (pages acquired out
+          from under admission) — sheds/deferrals must kick in, and
+          `unhog` must let the backlog drain (bounded recovery).
+  stall   freeze the worker's engine loop for S seconds (delayed-retire
+          / GC-pause stand-in); queued work must survive untouched.
+
+Wire-safety note: worker->router messages are small (a done record for a
+canary request pickles well under PIPE_BUF = 4096 bytes), so kernel pipe
+writes are atomic and a SIGKILL cannot tear a frame mid-message; each
+worker also gets its OWN result queue so a dead worker's stream never
+interleaves with a live one's.  The torn-write hazard that DOES exist —
+a kill mid `export_jsonl` — lands in the worker's obs file, which is
+exactly what `obs.aggregate.load_records_tolerant` absorbs at merge.
+
+Every worker exports obs JSONL snapshots (`obs_w{wid}.jsonl`, tagged
+process_index=wid); `merged()` folds them into the one job-level view
+(`obs --merge` semantics) that loadgen/slo.py evaluates.
+"""
+
+import multiprocessing as mp
+import os
+import queue
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .driver import DONE, REJECTED, SHED, Outcome, ReplayReport
+from .trace import Trace
+from .worker import worker_main
+
+FAULT_KINDS = ("kill", "hog", "unhog", "stall")
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault: at virtual time `t`, do `kind` to `worker`.
+
+    `kill` waits until the target holds at least one in-flight request
+    (a kill that lands on an idle worker proves nothing about recovery);
+    if the trace drains first, it fires on the idle worker anyway so the
+    schedule always executes.  `arg`: pages to hog / stall seconds."""
+
+    t: float
+    kind: str
+    worker: int
+    arg: float = 0.0
+    note: str = ""
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(one of {FAULT_KINDS})")
+
+
+@dataclass
+class ClusterReport(ReplayReport):
+    """ReplayReport plus the fault/recovery evidence the tests gate on:
+    each kill records WHO died, WHAT was rerouted, and the virtual time
+    by which every rerouted request completed."""
+
+    kills: List[dict] = field(default_factory=list)
+    obs_paths: List[str] = field(default_factory=list)
+
+    def recovery_s(self) -> List[float]:
+        """Per-kill recovery spans (virtual): last rerouted completion
+        minus kill time; kills that rerouted nothing contribute 0."""
+        out = []
+        for k in self.kills:
+            ts = [self.outcomes[rid].t_done for rid in k["rerouted"]
+                  if self.outcomes[rid].t_done is not None]
+            out.append(max(ts) - k["t"] if ts else 0.0)
+        return out
+
+
+class LoadGenCluster:
+    """Spawn, replay, stop.  Use as a context manager — __exit__ always
+    reaps worker processes, even when replay raised."""
+
+    def __init__(self, model_spec: dict, engine_spec: dict, *,
+                 n_workers: int, out_dir: str, export_every: int = 4,
+                 start_timeout_s: float = 180.0):
+        if n_workers < 1:
+            raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+        self.model_spec = dict(model_spec)
+        self.engine_spec = dict(engine_spec)
+        self.n_workers = n_workers
+        self.out_dir = out_dir
+        self.export_every = export_every
+        self.start_timeout_s = start_timeout_s
+        self._ctx = mp.get_context("spawn")
+        self._procs: Dict[int, mp.Process] = {}
+        self._req_q: Dict[int, object] = {}
+        self._res_q: Dict[int, object] = {}
+        self._alive: set = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def obs_path(self, wid: int) -> str:
+        return os.path.join(self.out_dir, f"obs_w{wid}.jsonl")
+
+    @property
+    def obs_paths(self) -> List[str]:
+        return [self.obs_path(w) for w in range(self.n_workers)]
+
+    def start(self) -> None:
+        # spawned children import the package (and therefore jax) BEFORE
+        # worker_main runs, so the CPU pin must ride in via the inherited
+        # environment — a TPU host must never hand its chips to workers
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.makedirs(self.out_dir, exist_ok=True)
+        for wid in range(self.n_workers):
+            path = self.obs_path(wid)
+            if os.path.exists(path):
+                os.remove(path)  # stale exports would pollute the merge
+            self._req_q[wid] = self._ctx.Queue()
+            self._res_q[wid] = self._ctx.Queue()
+            proc = self._ctx.Process(
+                target=worker_main,
+                args=(wid, self.model_spec, self.engine_spec, path,
+                      self._req_q[wid], self._res_q[wid], self.export_every),
+                daemon=True, name=f"loadgen-worker-{wid}")
+            proc.start()
+            self._procs[wid] = proc
+        deadline = time.monotonic() + self.start_timeout_s
+        waiting = set(range(self.n_workers))
+        while waiting:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"workers {sorted(waiting)} not ready within "
+                    f"{self.start_timeout_s:g}s")
+            for wid in sorted(waiting):
+                msg = self._poll(wid)
+                if msg is None:
+                    continue
+                if msg[0] == "ready":
+                    waiting.discard(wid)
+                    self._alive.add(wid)
+                elif msg[0] == "error":
+                    raise RuntimeError(f"worker {wid} failed to start: "
+                                       f"{msg[2]}")
+            time.sleep(0.01)
+
+    def __enter__(self) -> "LoadGenCluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self, timeout_s: float = 60.0) -> None:
+        """Graceful where possible (workers flush a final obs export),
+        SIGKILL where not.  Idempotent."""
+        for wid in sorted(self._alive):
+            try:
+                self._req_q[wid].put(("stop",))
+            except (OSError, ValueError):
+                self._alive.discard(wid)
+        deadline = time.monotonic() + timeout_s
+        pending = set(self._alive)
+        while pending and time.monotonic() < deadline:
+            for wid in sorted(pending):
+                if not self._procs[wid].is_alive():
+                    pending.discard(wid)
+                    continue
+                msg = self._poll(wid)
+                if msg is not None and msg[0] == "stopped":
+                    pending.discard(wid)
+            time.sleep(0.01)
+        for wid, proc in self._procs.items():
+            if proc.is_alive():
+                proc.terminate()
+            proc.join(timeout=10)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=10)
+        self._alive.clear()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _poll(self, wid: int):
+        try:
+            return self._res_q[wid].get_nowait()
+        except queue.Empty:
+            return None
+        except (OSError, EOFError, ValueError):
+            return None  # queue torn down under us (dead worker)
+
+    def _kill(self, wid: int) -> None:
+        proc = self._procs[wid]
+        if proc.is_alive() and proc.pid:
+            os.kill(proc.pid, signal.SIGKILL)
+        proc.join(timeout=10)
+        self._alive.discard(wid)
+
+    # -- replay ------------------------------------------------------------
+
+    def replay(self, trace: Trace, faults: Sequence[FaultEvent] = (), *,
+               speed: float = 25.0, retry_backoff_s: float = 0.1,
+               max_retries: int = 500,
+               max_wall_s: float = 240.0) -> ClusterReport:
+        """Replay `trace` through the cluster with `faults` injected at
+        their virtual times.  Returns when every trace request reached a
+        terminal outcome (done / rejected / shed) — including requests
+        rerouted off killed workers."""
+        if not self._alive:
+            raise RuntimeError("cluster not started (use .start() or the "
+                               "context manager)")
+        vocab = trace.vocab
+        arrivals = sorted(trace.requests, key=lambda r: (r.t_arrival, r.rid))
+        by_rid = {r.rid: r for r in trace.requests}
+        outcomes = {r.rid: Outcome(rid=r.rid, kind=r.kind,
+                                   t_arrival=r.t_arrival)
+                    for r in trace.requests}
+        retry: List[tuple] = []            # (t_due_v, rid)
+        owner: Dict[int, int] = {}         # rid -> wid while in flight
+        outstanding = {wid: set() for wid in range(self.n_workers)}
+        terminal: set = set()
+        fault_q = sorted(faults, key=lambda f: (f.t, f.worker))
+        kills: List[dict] = []
+        t0 = time.perf_counter()
+
+        def now_v() -> float:
+            return (time.perf_counter() - t0) * speed
+
+        def route(rid: int, t: float, rerouting: bool = False) -> None:
+            if not self._alive:
+                raise RuntimeError(
+                    f"no workers alive to take rid {rid} "
+                    f"({len(terminal)}/{len(outcomes)} terminal)")
+            req = by_rid[rid]
+            wid = min(self._alive,
+                      key=lambda w: (len(outstanding[w]), w))
+            owner[rid] = wid
+            outstanding[wid].add(rid)
+            if rerouting:
+                outcomes[rid].retries += 1
+            self._req_q[wid].put(("submit", rid,
+                                  [int(x) for x in req.prompt(vocab)],
+                                  req.max_new_tokens))
+
+        def settle(msg) -> None:
+            op = msg[0]
+            if op == "accepted":
+                _, wid, rid = msg
+                if rid not in terminal:
+                    outcomes[rid].t_submit = now_v()
+            elif op == "done":
+                _, wid, rid, toks = msg
+                outstanding[wid].discard(rid)
+                owner.pop(rid, None)
+                if rid in terminal:
+                    return  # late duplicate after a reroute race
+                out = outcomes[rid]
+                out.status = DONE
+                out.tokens = [int(t) for t in toks]
+                out.t_done = now_v()
+                terminal.add(rid)
+            elif op == "rejected":
+                _, wid, rid, reason, retryable, _message = msg
+                outstanding[wid].discard(rid)
+                owner.pop(rid, None)
+                if rid in terminal:
+                    return
+                out = outcomes[rid]
+                if retryable and out.retries < max_retries:
+                    out.retries += 1
+                    retry.append((now_v() + retry_backoff_s, rid))
+                else:
+                    out.status = SHED if retryable else REJECTED
+                    out.reason = reason
+                    terminal.add(rid)
+            elif op == "error":
+                raise RuntimeError(f"worker {msg[1]} errored: {msg[2]}")
+            # "ready"/"stopped" are lifecycle chatter — ignored here
+
+        def reap(wid: int, t: float, scheduled: Optional[FaultEvent]) -> None:
+            """A worker is gone (scheduled kill or crash): drain what it
+            already delivered, then reroute everything it still owed."""
+            while True:
+                msg = self._poll(wid)
+                if msg is None:
+                    break
+                settle(msg)
+            orphans = sorted(outstanding[wid] - terminal)
+            outstanding[wid].clear()
+            kills.append({
+                "t": t, "worker": wid, "rerouted": orphans,
+                "scheduled": scheduled is not None,
+                "note": scheduled.note if scheduled else "unscheduled exit",
+            })
+            for rid in orphans:
+                route(rid, t, rerouting=True)
+
+        i = 0
+        while len(terminal) < len(outcomes):
+            t = now_v()
+            # 1) due faults
+            while fault_q and fault_q[0].t <= t:
+                ev = fault_q[0]
+                if ev.worker not in self._alive:
+                    fault_q.pop(0)
+                    continue
+                if ev.kind == "kill":
+                    # wait for in-flight work unless none can ever come
+                    work_possible = i < len(arrivals) or bool(retry)
+                    if not outstanding[ev.worker] and work_possible:
+                        break
+                    fault_q.pop(0)
+                    self._kill(ev.worker)
+                    reap(ev.worker, t, ev)
+                else:
+                    fault_q.pop(0)
+                    self._req_q[ev.worker].put(("fault", ev.kind, ev.arg))
+            # 2) unscheduled deaths (crash ≠ kill fault, same recovery)
+            for wid in sorted(self._alive):
+                if not self._procs[wid].is_alive():
+                    self._alive.discard(wid)
+                    reap(wid, t, None)
+            # 3) due arrivals + retries
+            while i < len(arrivals) and arrivals[i].t_arrival <= t:
+                route(arrivals[i].rid, t)
+                i += 1
+            if retry:
+                retry.sort()
+                while retry and retry[0][0] <= t:
+                    _, rid = retry.pop(0)
+                    if rid not in terminal:
+                        route(rid, t)
+            # 4) worker results
+            idle = True
+            for wid in sorted(self._alive):
+                while True:
+                    msg = self._poll(wid)
+                    if msg is None:
+                        break
+                    idle = False
+                    settle(msg)
+            if idle:
+                time.sleep(0.002)
+            if time.perf_counter() - t0 > max_wall_s:
+                n_out = sum(len(s) for s in outstanding.values())
+                raise RuntimeError(
+                    f"cluster replay exceeded max_wall_s={max_wall_s:g}: "
+                    f"{len(terminal)}/{len(outcomes)} terminal, "
+                    f"{i}/{len(arrivals)} arrived, {len(retry)} retrying, "
+                    f"{n_out} in flight, alive={sorted(self._alive)}")
+        return ClusterReport(outcomes=outcomes,
+                             wall_s=time.perf_counter() - t0, speed=speed,
+                             kills=kills, obs_paths=self.obs_paths)
+
+    def merged(self, by_process: bool = False):
+        """(metrics, spans, meta) — the per-worker obs exports folded into
+        one job view with `obs --merge` semantics (counters summed,
+        histograms bucket-added, gauges per-process; torn final lines
+        from killed workers skipped with a `truncated_lines` count)."""
+        from ..obs.aggregate import merge_files
+
+        present = [p for p in self.obs_paths if os.path.exists(p)]
+        if not present:
+            raise FileNotFoundError(
+                f"no worker obs exports under {self.out_dir!r} yet")
+        return merge_files(present, by_process=by_process)

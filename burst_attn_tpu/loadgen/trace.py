@@ -1,0 +1,225 @@
+"""Deterministic serve-workload traces: synthesize, serialize, replay.
+
+A trace is the unit of serve-hardening evidence: a SEEDED, wall-clock-free
+description of heavy traffic (ragged prompt/output lengths, bursty
+arrivals, a sprinkling of poison requests) that replays byte-identically
+anywhere — the single-process oracle, the multi-process cluster, and a CI
+lane three months from now all see the same requests at the same virtual
+times.  Determinism rules:
+
+  * every sampled quantity comes from ONE `np.random.default_rng(seed)`
+    stream in a fixed draw order — same seed, same trace, bit-for-bit;
+  * prompts are NOT stored as tokens: each request carries a
+    `prompt_seed` and regenerates its tokens on demand (`prompt()`), so
+    a million-token trace file stays kilobytes and the oracle can never
+    see different tokens than the cluster;
+  * arrival times are virtual seconds from trace start — the replayers
+    (loadgen/driver.py, loadgen/cluster.py) map them to wall time with a
+    `speed` factor; nothing in this module reads a clock.
+
+Arrival model: a two-state Markov-modulated process (calm | burst).  The
+state flips ahead of each arrival (`p_enter_burst` / `p_exit_burst`), and
+interarrival gaps are exponential at the calm rate or `burst_factor`×
+faster inside a burst — the clumpy, overdispersed arrivals (CV > 1) that
+actually stress admission control, rather than a smooth Poisson stream.
+
+Poison requests model malformed traffic the engines must reject without
+taking a worker down: empty prompts, zero budgets, and prompts too large
+for any pool (`poison-oversize`).
+
+Serialized form (JSONL, `results/traces/*.jsonl`): one `trace-meta`
+header line with the full synthesis recipe, then one `trace-request`
+line per request.  `load_trace` is strict — a trace is CI input, not
+best-effort telemetry.
+"""
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+TRACE_VERSION = 1
+
+REQUEST_KINDS = ("normal", "poison-empty", "poison-budget",
+                 "poison-oversize")
+POISON_KINDS = REQUEST_KINDS[1:]
+
+
+@dataclass(frozen=True)
+class TraceRequest:
+    """One replayable request: WHEN it arrives and WHAT it asks for."""
+
+    rid: int
+    t_arrival: float            # virtual seconds from trace start
+    prompt_len: int
+    prompt_seed: int            # tokens regenerate from this (see prompt())
+    max_new_tokens: int
+    kind: str = "normal"        # REQUEST_KINDS
+
+    @property
+    def poison(self) -> bool:
+        return self.kind != "normal"
+
+    def prompt(self, vocab: int) -> np.ndarray:
+        """The request's tokens, regenerated deterministically — every
+        replayer and the oracle derive the identical [prompt_len] int32
+        array from (prompt_seed, prompt_len, vocab)."""
+        if self.prompt_len <= 0:
+            return np.zeros((0,), np.int32)
+        rng = np.random.default_rng(self.prompt_seed)
+        return rng.integers(1, vocab, size=self.prompt_len).astype(np.int32)
+
+
+@dataclass
+class Trace:
+    """A meta header (the synthesis recipe) + arrival-ordered requests."""
+
+    meta: Dict[str, object]
+    requests: List[TraceRequest] = field(default_factory=list)
+
+    @property
+    def vocab(self) -> int:
+        return int(self.meta["vocab"])
+
+    @property
+    def duration_s(self) -> float:
+        """Virtual span from trace start to the last arrival."""
+        return max((r.t_arrival for r in self.requests), default=0.0)
+
+    def normal(self) -> List[TraceRequest]:
+        return [r for r in self.requests if not r.poison]
+
+    def prompts(self) -> Dict[int, np.ndarray]:
+        return {r.rid: r.prompt(self.vocab) for r in self.requests}
+
+
+def synthesize_trace(
+    n_requests: int,
+    *,
+    seed: int,
+    vocab: int,
+    mean_interarrival_s: float = 0.05,
+    burst_factor: float = 8.0,
+    p_enter_burst: float = 0.15,
+    p_exit_burst: float = 0.35,
+    prompt_len_log_mean: float = 2.5,
+    prompt_len_log_sigma: float = 0.6,
+    prompt_len_min: int = 1,
+    prompt_len_max: int = 64,
+    max_new_mean: float = 12.0,
+    max_new_min: int = 1,
+    max_new_max: int = 48,
+    poison_rate: float = 0.0,
+    oversize_len: int = 100_000,
+    label: str = "synthetic",
+) -> Trace:
+    """Seeded workload synthesis (see the module docstring for the
+    models).  Prompt lengths are clipped lognormal (ragged, heavy-ish
+    tail), decode budgets clipped geometric, arrivals Markov-modulated
+    exponential.  No wall-clock, no global RNG — the same call is the
+    same trace forever."""
+    if n_requests < 1:
+        raise ValueError(f"n_requests must be >= 1, got {n_requests}")
+    if not 0.0 <= poison_rate < 1.0:
+        raise ValueError(f"poison_rate must be in [0, 1), got {poison_rate}")
+    rng = np.random.default_rng(seed)
+    requests: List[TraceRequest] = []
+    t = 0.0
+    in_burst = False
+    for rid in range(n_requests):
+        # state flip AHEAD of each arrival, then the gap at the state rate
+        if in_burst:
+            in_burst = rng.random() >= p_exit_burst
+        else:
+            in_burst = rng.random() < p_enter_burst
+        scale = mean_interarrival_s / (burst_factor if in_burst else 1.0)
+        t += float(rng.exponential(scale))
+        kind = "normal"
+        if poison_rate and rng.random() < poison_rate:
+            kind = POISON_KINDS[int(rng.integers(0, len(POISON_KINDS)))]
+        prompt_len = int(np.clip(
+            round(rng.lognormal(prompt_len_log_mean, prompt_len_log_sigma)),
+            prompt_len_min, prompt_len_max))
+        max_new = int(np.clip(rng.geometric(1.0 / max_new_mean),
+                              max_new_min, max_new_max))
+        if kind == "poison-empty":
+            prompt_len = 0
+        elif kind == "poison-budget":
+            max_new = 0
+        elif kind == "poison-oversize":
+            prompt_len = oversize_len
+        requests.append(TraceRequest(
+            rid=rid, t_arrival=round(t, 6), prompt_len=prompt_len,
+            prompt_seed=int(rng.integers(0, 2**31 - 1)),
+            max_new_tokens=max_new, kind=kind))
+    meta = {
+        "version": TRACE_VERSION, "label": label, "seed": int(seed),
+        "vocab": int(vocab), "n_requests": int(n_requests),
+        "mean_interarrival_s": mean_interarrival_s,
+        "burst_factor": burst_factor, "p_enter_burst": p_enter_burst,
+        "p_exit_burst": p_exit_burst,
+        "prompt_len_log_mean": prompt_len_log_mean,
+        "prompt_len_log_sigma": prompt_len_log_sigma,
+        "prompt_len_min": prompt_len_min, "prompt_len_max": prompt_len_max,
+        "max_new_mean": max_new_mean, "max_new_min": max_new_min,
+        "max_new_max": max_new_max, "poison_rate": poison_rate,
+        "oversize_len": oversize_len,
+        "duration_s": round(t, 6),
+    }
+    return Trace(meta=meta, requests=requests)
+
+
+def save_trace(trace: Trace, path: str) -> str:
+    """JSONL: `trace-meta` header first, one `trace-request` per line.
+    Deterministic bytes for a deterministic trace (sorted keys)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        # discriminator key is "record", NOT "kind" — requests already
+        # carry a `kind` field (normal | poison-*)
+        f.write(json.dumps({"record": "trace-meta", **trace.meta},
+                           sort_keys=True) + "\n")
+        for req in trace.requests:
+            f.write(json.dumps({"record": "trace-request", **asdict(req)},
+                               sort_keys=True) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
+def load_trace(path: str) -> Trace:
+    """Strict parse: a trace is replay input, so any malformed line or a
+    missing/incompatible header raises ValueError."""
+    meta: Optional[dict] = None
+    requests: List[TraceRequest] = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not JSON: {e}") from e
+            tag = rec.pop("record", None) if isinstance(rec, dict) else None
+            if tag == "trace-meta":
+                if meta is not None:
+                    raise ValueError(f"{path}:{i}: duplicate trace-meta")
+                if rec.get("version") != TRACE_VERSION:
+                    raise ValueError(
+                        f"{path}:{i}: trace version {rec.get('version')!r} "
+                        f"!= supported {TRACE_VERSION}")
+                meta = rec
+            elif tag == "trace-request":
+                if rec.get("kind", "normal") not in REQUEST_KINDS:
+                    raise ValueError(
+                        f"{path}:{i}: unknown request kind {rec.get('kind')!r}")
+                requests.append(TraceRequest(**rec))
+            else:
+                raise ValueError(f"{path}:{i}: not a trace record: "
+                                 f"{line[:80]}")
+    if meta is None:
+        raise ValueError(f"{path}: no trace-meta header")
+    return Trace(meta=meta, requests=requests)

@@ -1,0 +1,158 @@
+"""Serve worker process for the loadgen cluster.
+
+Each worker is an isolated OS process (multiprocessing `spawn` context —
+a clean interpreter, its own single-process CPU JAX runtime, its own obs
+registry) running one serve engine and a small message loop:
+
+  router -> worker   ("submit", rrid, prompt list, max_new)
+                     ("fault", fault_kind, arg)   hog | unhog | stall
+                     ("stop",)                    finish backlog, export, exit
+  worker -> router   ("ready", wid, pid)
+                     ("accepted", wid, rrid)
+                     ("rejected", wid, rrid, reason, retryable, message)
+                     ("done", wid, rrid, tokens)
+                     ("stopped", wid)
+                     ("error", wid, message)      engine loop blew up
+
+Request ids on the wire are the ROUTER's (trace rids): the worker maps
+its engine's local rids back before reporting, so the router never sees
+worker-local numbering.
+
+Obs discipline: the engine's serve.* instruments land in this process's
+registry; the loop exports a full fsynced snapshot to the worker's JSONL
+(tagged `process_index=wid`) every `export_every` completions and again
+at clean shutdown.  A SIGKILLed worker therefore leaves its last
+snapshot on disk — possibly with one torn final line, which is exactly
+the case `obs.aggregate.load_records_tolerant` absorbs.
+
+Fault injection runs INSIDE the worker because that is where the faults
+live in production: "hog" grabs pages straight from the engine's pool
+(forced pool exhaustion — admission and shed paths see real scarcity),
+"unhog" releases them, "stall" freezes the engine loop (delayed retire /
+GC pause stand-in) without touching the queue.  Worker kill is not a
+message — the router SIGKILLs the process, the point being that no
+cooperation is required.
+"""
+
+import os
+import queue
+import time
+
+
+def build_engine(model_spec: dict, engine_spec: dict):
+    """Construct a serve engine from plain-dict specs (everything must be
+    picklable across the spawn boundary, so no arrays/params travel —
+    each process re-derives identical params from the shared seed).
+    `engine_spec["kind"]`: "ragged" (RaggedServeEngine, default) or
+    "legacy" (models/serve.py's ServeEngine)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..admission import AdmissionPolicy
+    from ..models import ModelConfig, ServeEngine, init_params
+    from ..serving import RaggedServeEngine
+
+    ms = dict(model_spec)
+    # token-exactness across PROCESSES requires every process to compute
+    # identical logits: pin matmul precision in whatever process builds an
+    # engine (spawned workers don't inherit the parent's jax.config)
+    jax.config.update("jax_default_matmul_precision",
+                      ms.pop("matmul_precision", "highest"))
+    seed = ms.pop("seed", 0)
+    cfg = ModelConfig(attn_backend="jnp", remat=False, dtype=jnp.float32,
+                      batch_axis=None, head_axis=None, **ms)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    es = dict(engine_spec)
+    kind = es.pop("kind", "ragged")
+    adm = es.pop("admission", None)
+    if adm is not None:
+        adm = AdmissionPolicy(**adm)
+    cls = {"ragged": RaggedServeEngine, "legacy": ServeEngine}[kind]
+    return cls(params, cfg, admission=adm, **es)
+
+
+def _export(obs_path: str, wid: int) -> None:
+    from .. import obs
+    from ..obs import spans as _spans
+
+    obs.default_registry().export_jsonl(
+        obs_path, extra_records=_spans.span_records(), process_index=wid)
+
+
+def worker_main(wid: int, model_spec: dict, engine_spec: dict,
+                obs_path: str, request_q, result_q,
+                export_every: int = 4) -> None:
+    """Entry point for one spawned worker (cluster.py passes this to
+    multiprocessing.Process)."""
+    # must land before the jax import inside build_engine: the cluster is
+    # a CPU-mesh harness even on a TPU host
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        eng = build_engine(model_spec, engine_spec)
+        _export(obs_path, wid)  # baseline: even an early kill leaves a file
+        result_q.put(("ready", wid, os.getpid()))
+        rid_map = {}                  # engine rid -> router rid
+        hogged = []                   # pages held by the "hog" fault
+        stall_until = 0.0
+        stopping = False
+        n_since_export = 0
+        while True:
+            try:
+                while True:
+                    msg = request_q.get_nowait()
+                    op = msg[0]
+                    if op == "submit":
+                        _, rrid, prompt, max_new = msg
+                        res = eng.try_submit(prompt, max_new)
+                        if res.ok:
+                            rid_map[res.rid] = rrid
+                            result_q.put(("accepted", wid, rrid))
+                        else:
+                            result_q.put((
+                                "rejected", wid, rrid,
+                                res.reason.value if res.reason else None,
+                                res.retryable, res.message))
+                    elif op == "fault":
+                        _, fkind, arg = msg
+                        if fkind == "hog":
+                            n = min(int(arg), eng.pool.available)
+                            if n > 0:
+                                hogged += list(eng.pool.acquire(n))
+                        elif fkind == "unhog":
+                            if hogged:
+                                eng.pool.release(hogged)
+                                hogged = []
+                        elif fkind == "stall":
+                            stall_until = time.monotonic() + float(arg)
+                        else:
+                            result_q.put(("error", wid,
+                                          f"unknown fault {fkind!r}"))
+                    elif op == "stop":
+                        stopping = True
+                    else:
+                        result_q.put(("error", wid, f"unknown op {op!r}"))
+            except queue.Empty:
+                pass
+            if time.monotonic() < stall_until:
+                time.sleep(0.002)
+                continue
+            if eng.pending or eng.live:
+                for erid, toks in eng.step():
+                    result_q.put(("done", wid, rid_map.pop(erid),
+                                  [int(t) for t in toks]))
+                    n_since_export += 1
+                if n_since_export >= export_every:
+                    _export(obs_path, wid)
+                    n_since_export = 0
+            elif stopping:
+                _export(obs_path, wid)
+                result_q.put(("stopped", wid))
+                return
+            else:
+                time.sleep(0.002)
+    except Exception as e:  # noqa: BLE001 — report, then die visibly
+        try:
+            result_q.put(("error", wid, f"{type(e).__name__}: {e}"))
+        except Exception:  # noqa: BLE001
+            os.write(2, f"loadgen worker {wid}: {e}\n".encode())
+        raise

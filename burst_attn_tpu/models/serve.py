@@ -45,6 +45,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..admission import (
+    AdmissionPolicy, InvalidRequest, LoadShed, RejectReason, SubmitRejected,
+    SubmitResult,
+)
 
 logger = obs.get_logger(__name__)
 
@@ -95,13 +99,15 @@ class ServeEngine:
                  temperature: float = 0.0, top_k=None, top_p=None, rng=None,
                  prefix_cache: bool = False, draft_params=None,
                  draft_cfg: Optional[ModelConfig] = None, spec_k: int = 4,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 admission: Optional[AdmissionPolicy] = None):
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
         self.eos_id = eos_id
         self.page = page
         self.max_queue = max_queue
+        self.admission = admission
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -146,52 +152,70 @@ class ServeEngine:
 
     # -- client surface ----------------------------------------------------
 
+    def _reject(self, exc_cls, reason: RejectReason, message: str):
+        _M_REJECTED.inc(reason=reason.value)
+        raise exc_cls(reason, message)
+
+    def _occupancy(self) -> float:
+        """Live pool occupancy, the same value `serve.page_pool_occupancy`
+        exports (fraction of usable pages held; page 0 is the sink)."""
+        usable = self.pool.n_pages - 1
+        return (usable - self.pool.available) / usable if usable else 0.0
+
     def submit(self, tokens, max_new_tokens: int) -> int:
         """Queue a prompt; returns a request id (tokens appear in
         step() results / results() once finished).
 
-        Raises ValueError on malformed / permanently unservable requests;
-        with `max_queue` set, raises RuntimeError when load-shed — pool
-        pressure (`pool-exhausted`) sheds BEFORE queue pressure
-        (`queue-full`), and `serve.requests_rejected{reason}` labels the
-        two distinctly."""
+        Raises InvalidRequest (a ValueError) on malformed / permanently
+        unservable requests; with `max_queue` or an `admission` policy
+        set, raises LoadShed (a RuntimeError) when shed — pool pressure
+        (`pool-exhausted`) sheds BEFORE queue pressure (`queue-full`),
+        hard exhaustion before the policy's hysteresis sheds
+        (`admission-pool` / `admission-queue`).  Every rejection carries
+        a typed `.reason` matching its `serve.requests_rejected{reason}`
+        label; `try_submit()` is the non-raising surface."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
-            _M_REJECTED.inc(reason="empty-prompt")
-            raise ValueError("empty prompt")
+            self._reject(InvalidRequest, RejectReason.EMPTY_PROMPT,
+                         "empty prompt")
         if max_new_tokens < 1:
-            _M_REJECTED.inc(reason="bad-budget")
-            raise ValueError(f"max_new_tokens must be >= 1, got "
-                             f"{max_new_tokens} (prefill always samples one)")
+            self._reject(InvalidRequest, RejectReason.BAD_BUDGET,
+                         f"max_new_tokens must be >= 1, got "
+                         f"{max_new_tokens} (prefill always samples one)")
         need = self._pages_for(tokens.size, max_new_tokens)
         if need > self.state.page_table.shape[1]:
-            _M_REJECTED.inc(reason="table-width")
-            raise ValueError(
-                f"request needs {need} pages > max_pages_per_seq "
-                f"{self.state.page_table.shape[1]}")
+            self._reject(InvalidRequest, RejectReason.TABLE_WIDTH,
+                         f"request needs {need} pages > max_pages_per_seq "
+                         f"{self.state.page_table.shape[1]}")
         if need > self.pool.n_pages - 1:  # page 0 is the reserved sink
             # a permanently unservable request would deadlock the FIFO
             # queue (admission waits forever for pages that cannot exist)
-            _M_REJECTED.inc(reason="pool-size")
-            raise ValueError(
-                f"request needs {need} pages but the pool only has "
-                f"{self.pool.n_pages - 1} usable pages total")
+            self._reject(InvalidRequest, RejectReason.POOL_SIZE,
+                         f"request needs {need} pages but the pool only has "
+                         f"{self.pool.n_pages - 1} usable pages total")
         if self.max_queue is not None:
             # load shed, POOL pressure before QUEUE pressure: a request
             # that would wait behind others for pages that are not free
             # only deepens the backlog, whatever the queue depth; a full
             # queue is only the reason when pages were never short
             if self._queue and need > self.pool.available:
-                _M_REJECTED.inc(reason="pool-exhausted")
-                raise RuntimeError(
-                    f"load shed (pool-exhausted): request needs {need} "
-                    f"pages, {self.pool.available} free, "
-                    f"{len(self._queue)} already waiting")
+                self._reject(LoadShed, RejectReason.POOL_EXHAUSTED,
+                             f"load shed (pool-exhausted): request needs "
+                             f"{need} pages, {self.pool.available} free, "
+                             f"{len(self._queue)} already waiting")
             if len(self._queue) >= self.max_queue:
-                _M_REJECTED.inc(reason="queue-full")
-                raise RuntimeError(
-                    f"load shed (queue-full): {len(self._queue)} waiting "
-                    f">= max_queue {self.max_queue}")
+                self._reject(LoadShed, RejectReason.QUEUE_FULL,
+                             f"load shed (queue-full): {len(self._queue)} "
+                             f"waiting >= max_queue {self.max_queue}")
+        if self.admission is not None:
+            occ = self._occupancy()
+            reason = self.admission.decide(queue_depth=len(self._queue),
+                                           pool_occupancy=occ)
+            if reason is not None:
+                self._reject(LoadShed, reason,
+                             f"load shed ({reason}): admission policy — "
+                             f"queue_depth={len(self._queue)}, "
+                             f"pool_occupancy={occ:.3f}")
         rid = self._next_id
         self._next_id += 1
         self._queue.append(_Request(rid, tokens, max_new_tokens,
@@ -199,6 +223,14 @@ class ServeEngine:
         _M_SUBMITTED.inc()
         _M_QUEUE.set(len(self._queue))
         return rid
+
+    def try_submit(self, tokens, max_new_tokens: int) -> SubmitResult:
+        """Non-raising submit for routers: rid on success, typed reason
+        (with its `retryable` bit) on rejection."""
+        try:
+            return SubmitResult(rid=self.submit(tokens, max_new_tokens))
+        except SubmitRejected as e:
+            return SubmitResult(reason=e.reason, message=str(e))
 
     @property
     def pending(self) -> int:
@@ -232,6 +264,31 @@ class ServeEngine:
                     return self.results()
                 self.step()
         raise RuntimeError(f"run() exceeded {max_steps} steps")
+
+    def drain(self) -> List[int]:
+        """Graceful shutdown: release every in-flight slot's pages and put
+        its request BACK at the queue head (generated tokens reset; the
+        prefill re-samples the identical first token under greedy
+        decoding), then refresh the gauges so a drained engine reads
+        live=0 / occupancy=0.  Returns the requeued rids in their new
+        queue order.  The engine stays usable — run() after drain()
+        serves everything, requeued work first, to completion."""
+        inflight = [req for req in self.slots if req is not None]
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            self.state = retire_slot(self.state, self.pool, slot)
+            if self.draft is not None:
+                self.dstate = retire_slot(self.dstate, self.dpool, slot)
+            self.slots[slot] = None
+        inflight.sort(key=lambda r: r.rid)
+        for req in reversed(inflight):
+            req.tokens = []
+            self._queue.insert(0, req)
+        _M_QUEUE.set(len(self._queue))
+        _M_LIVE.set(0)
+        _M_POOL.set(self._occupancy())
+        return [r.rid for r in inflight]
 
     # -- engine ------------------------------------------------------------
 

@@ -22,10 +22,41 @@ CLI:  python -m burst_attn_tpu.obs --merge 'results/obs*.jsonl'
 """
 
 import glob
+import json
 import os
 from typing import Dict, List, Sequence, Tuple
 
-from .__main__ import load_records, merge_records
+from .__main__ import merge_records
+
+
+def load_records_tolerant(path: str) -> Tuple[List[dict], int]:
+    """Like __main__.load_records, but a bad FINAL line is skipped with a
+    count instead of raising — the signature of a snapshot truncated by a
+    kill (SIGKILL mid-write leaves a partial last line; everything before
+    it is a complete, fsynced earlier snapshot).  A bad line anywhere
+    ELSE still raises ValueError: mid-file corruption is not truncation
+    and must stay loud.  Returns (records, n_skipped)."""
+    with open(path, encoding="utf-8") as f:
+        lines = [(i, line.strip()) for i, line in enumerate(f, 1)]
+    lines = [(i, line) for i, line in lines if line]
+    records: List[dict] = []
+    for pos, (i, line) in enumerate(lines):
+        bad = None
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            bad = f"{path}:{i}: not JSON: {e}"
+            rec = None
+        if bad is None and (not isinstance(rec, dict) or "kind" not in rec):
+            bad = f"{path}:{i}: not an obs record: {line[:80]}"
+        if bad is not None:
+            # only a bad FINAL line with valid records before it reads as
+            # truncation — a file that is nothing but garbage stays loud
+            if pos == len(lines) - 1 and records:
+                return records, 1
+            raise ValueError(bad)
+        records.append(rec)
+    return records, 0
 
 
 def resolve_files(patterns: Sequence[str]) -> List[str]:
@@ -54,7 +85,9 @@ def load_process_states(files: Sequence[str]):
     states = []
     used = set()
     for i, path in enumerate(files):
-        records = load_records(path)  # raises ValueError on bad lines
+        # tolerant: a killed worker's final partial line is skipped with a
+        # `truncated_lines` count (mid-file corruption still raises)
+        records, skipped = load_records_tolerant(path)
         if not records:
             continue
         metrics, spans, meta = merge_records(records)
@@ -66,7 +99,8 @@ def load_process_states(files: Sequence[str]):
             label = i
         label = str(label)
         used.add(label)
-        states.append((label, metrics, spans, dict(meta, file=path)))
+        states.append((label, metrics, spans,
+                       dict(meta, file=path, truncated_lines=skipped)))
     return states
 
 
@@ -89,9 +123,11 @@ def merge_processes(states, by_process: bool = False):
     metrics: Dict[tuple, dict] = {}
     spans: List[dict] = []
     n_snapshots = 0
+    n_truncated = 0
     last_ts = ""
     for proc, proc_metrics, proc_spans, proc_meta in states:
         n_snapshots += proc_meta.get("snapshots", 0)
+        n_truncated += proc_meta.get("truncated_lines", 0)
         last_ts = max(last_ts, proc_meta.get("last_ts_utc", ""))
         for rec in proc_spans:
             spans.append(dict(rec, process_index=proc))
@@ -135,6 +171,7 @@ def merge_processes(states, by_process: bool = False):
         "process_labels": [s[0] for s in states],
         "n_metrics": len(metrics),
         "n_spans": len(spans),
+        "truncated_lines": n_truncated,
     }
     return list(metrics.values()), spans, meta
 

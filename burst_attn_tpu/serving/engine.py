@@ -24,7 +24,12 @@ engine schedules PREFILL AS CHUNKS through the same launch that decodes:
     pressure — a request that would wait behind others for pages that
     are not free is rejected `pool-exhausted` even when the queue still
     has room; `queue-full` only fires when pages were never the
-    bottleneck.
+    bottleneck.  An optional `admission` policy
+    (burst_attn_tpu.admission.AdmissionPolicy) sheds EARLY with
+    hysteresis from the live queue-depth / pool-occupancy values (typed
+    reasons `admission-pool` / `admission-queue`), and every rejection
+    is a typed InvalidRequest / LoadShed (`.reason`); `try_submit()` is
+    the non-raising router surface.
 
 Kernel routing: `ragged_supported` probes each launch width once; a
 declined shape runs the dense-gather fallback and counts a labeled
@@ -44,6 +49,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..admission import (
+    AdmissionPolicy, InvalidRequest, LoadShed, RejectReason, SubmitRejected,
+    SubmitResult,
+)
 
 logger = obs.get_logger(__name__)
 
@@ -124,6 +133,7 @@ class RaggedServeEngine:
                  quantize: bool = False, eos_id: Optional[int] = None,
                  temperature: float = 0.0, top_k=None, top_p=None, rng=None,
                  chunk: Optional[int] = None, max_queue: Optional[int] = None,
+                 admission: Optional[AdmissionPolicy] = None,
                  draft_params=None, draft_cfg: Optional[ModelConfig] = None,
                  spec_k: int = 4, use_ragged: Optional[bool] = None):
         self.params = params
@@ -134,6 +144,7 @@ class RaggedServeEngine:
         if self.chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {self.chunk}")
         self.max_queue = max_queue
+        self.admission = admission
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -172,43 +183,61 @@ class RaggedServeEngine:
 
     # -- client surface ----------------------------------------------------
 
+    def _reject(self, exc_cls, reason: RejectReason, message: str):
+        _M_REJECTED.inc(reason=reason.value)
+        raise exc_cls(reason, message)
+
+    def _occupancy(self) -> float:
+        """Live pool occupancy, the same value `serve.page_pool_occupancy`
+        exports (fraction of usable pages held; page 0 is the sink)."""
+        usable = self.pool.n_pages - 1
+        return (usable - self.pool.available) / usable if usable else 0.0
+
     def submit(self, tokens, max_new_tokens: int) -> int:
-        """Queue a prompt; returns a request id.  Raises ValueError on
-        malformed / permanently unservable requests, RuntimeError when
-        load-shed (pool pressure sheds BEFORE queue pressure)."""
+        """Queue a prompt; returns a request id.  Raises InvalidRequest
+        (a ValueError) on malformed / permanently unservable requests,
+        LoadShed (a RuntimeError) when shed — both carry a typed
+        `.reason` matching the `rejected{reason=…}` counter label.  Pool
+        pressure sheds BEFORE queue pressure, hard exhaustion before the
+        soft `admission` policy."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
-            _M_REJECTED.inc(reason="empty-prompt")
-            raise ValueError("empty prompt")
+            self._reject(InvalidRequest, RejectReason.EMPTY_PROMPT,
+                         "empty prompt")
         if max_new_tokens < 1:
-            _M_REJECTED.inc(reason="bad-budget")
-            raise ValueError(f"max_new_tokens must be >= 1, got "
-                             f"{max_new_tokens}")
+            self._reject(InvalidRequest, RejectReason.BAD_BUDGET,
+                         f"max_new_tokens must be >= 1, got "
+                         f"{max_new_tokens}")
         need = self._pages_for(tokens.size, max_new_tokens)
         if need > self.state.page_table.shape[1]:
-            _M_REJECTED.inc(reason="table-width")
-            raise ValueError(
-                f"request needs {need} pages > max_pages_per_seq "
-                f"{self.state.page_table.shape[1]}")
+            self._reject(InvalidRequest, RejectReason.TABLE_WIDTH,
+                         f"request needs {need} pages > max_pages_per_seq "
+                         f"{self.state.page_table.shape[1]}")
         if need > self.pool.n_pages - 1:  # page 0 is the reserved sink
-            _M_REJECTED.inc(reason="pool-size")
-            raise ValueError(
-                f"request needs {need} pages but the pool only has "
-                f"{self.pool.n_pages - 1} usable pages total")
+            self._reject(InvalidRequest, RejectReason.POOL_SIZE,
+                         f"request needs {need} pages but the pool only has "
+                         f"{self.pool.n_pages - 1} usable pages total")
         if self.max_queue is not None:
             # pool pressure first: a request that would queue behind others
             # for pages that are not free only deepens the backlog
             if self._queue and need > self.pool.available:
-                _M_REJECTED.inc(reason="pool-exhausted")
-                raise RuntimeError(
-                    f"load shed (pool-exhausted): request needs {need} "
-                    f"pages, {self.pool.available} free, "
-                    f"{len(self._queue)} already waiting")
+                self._reject(LoadShed, RejectReason.POOL_EXHAUSTED,
+                             f"load shed (pool-exhausted): request needs "
+                             f"{need} pages, {self.pool.available} free, "
+                             f"{len(self._queue)} already waiting")
             if len(self._queue) >= self.max_queue:
-                _M_REJECTED.inc(reason="queue-full")
-                raise RuntimeError(
-                    f"load shed (queue-full): {len(self._queue)} waiting "
-                    f">= max_queue {self.max_queue}")
+                self._reject(LoadShed, RejectReason.QUEUE_FULL,
+                             f"load shed (queue-full): {len(self._queue)} "
+                             f"waiting >= max_queue {self.max_queue}")
+        if self.admission is not None:
+            occ = self._occupancy()
+            reason = self.admission.decide(queue_depth=len(self._queue),
+                                           pool_occupancy=occ)
+            if reason is not None:
+                self._reject(LoadShed, reason,
+                             f"load shed ({reason}): admission policy — "
+                             f"queue_depth={len(self._queue)}, "
+                             f"pool_occupancy={occ:.3f}")
         rid = self._next_id
         self._next_id += 1
         self._queue.append(_Request(rid, tokens, max_new_tokens,
@@ -216,6 +245,14 @@ class RaggedServeEngine:
         _M_SUBMITTED.inc()
         _M_QUEUE.set(len(self._queue))
         return rid
+
+    def try_submit(self, tokens, max_new_tokens: int) -> SubmitResult:
+        """Non-raising submit for routers: rid on success, typed reason
+        (with its `retryable` bit) on rejection."""
+        try:
+            return SubmitResult(rid=self.submit(tokens, max_new_tokens))
+        except SubmitRejected as e:
+            return SubmitResult(reason=e.reason, message=str(e))
 
     @property
     def pending(self) -> int:
@@ -241,6 +278,32 @@ class RaggedServeEngine:
                     return self.results()
                 self.step()
         raise RuntimeError(f"run() exceeded {max_steps} steps")
+
+    def drain(self) -> List[int]:
+        """Graceful shutdown: release every in-flight slot's pages and put
+        its request BACK at the queue head (reset to un-prefilled; greedy
+        decode regenerates the identical tokens on re-admission), then
+        refresh the gauges so a drained engine reads live=0 /
+        occupancy=0.  Returns the requeued rids in their new queue order.
+        The engine stays usable — run() after drain() serves everything,
+        requeued work first, to completion."""
+        inflight = [req for req in self.slots if req is not None]
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            self.state = free_slot(self.state, self.pool, slot)
+            if self.draft is not None:
+                self.dstate = retire_slot(self.dstate, self.dpool, slot)
+            self.slots[slot] = None
+        inflight.sort(key=lambda r: r.rid)
+        for req in reversed(inflight):
+            req.tokens = []
+            req.n_prefilled = 0
+            self._queue.insert(0, req)
+        _M_QUEUE.set(len(self._queue))
+        _M_LIVE.set(0)
+        _M_POOL.set(self._occupancy())
+        return [r.rid for r in inflight]
 
     # -- engine ------------------------------------------------------------
 

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Loadgen bench: replay the canonical serve-hardening trace and emit
+headline records for the perf-regression gate.
+
+Replays a FIXED seeded trace (bursty arrivals, ragged lengths, a few
+poison requests) open-loop through RaggedServeEngine with an admission
+policy attached, verifies the completed tokens against the
+single-process oracle (any corruption fails the bench — a perf number
+from a wrong-answer run is worse than no number), then lands two
+headline records in results/:
+
+  headline_loadgen_ttft.json      serve.load_p99_ttft seconds (direction:
+                                  lower) — p99 TTFT over the replay window
+  headline_loadgen_goodput.json   serve.load_goodput tokens/s (direction:
+                                  higher) — COMPLETED requests' tokens per
+                                  wall second; partial/shed work excluded
+
+check_regression.py gates both against BENCH_*.json history (the
+`scripts/test.sh --loadgen` lane runs the gate for real, with
+--summary-json so CI can annotate).  The full SLO report and the trace
+itself are also written (results/loadgen_slo.json,
+results/traces/loadgen_bench.jsonl) so a regression can be diagnosed
+from artifacts alone.
+
+    python scripts/bench_loadgen.py [--requests 24] [--speed 50] [--out results]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python scripts/bench_loadgen.py")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--speed", type=float, default=50.0)
+    ap.add_argument("--out", default=os.path.join(ROOT, "results"))
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from burst_attn_tpu import obs
+    from burst_attn_tpu.loadgen import (
+        assert_token_exact, compute_slo, format_slo, oracle_replay,
+        replay_trace, save_trace, synthesize_trace,
+    )
+    from burst_attn_tpu.loadgen.__main__ import _default_specs
+    from burst_attn_tpu.loadgen.slo import quantile_from_window
+    from burst_attn_tpu.loadgen.worker import build_engine
+
+    model_spec, engine_spec = _default_specs(vocab=97)
+    engine_spec = dict(engine_spec,
+                       admission={"pool_high": 0.95, "pool_low": 0.80,
+                                  "queue_high": 16, "queue_low": 8})
+    trace = synthesize_trace(
+        args.requests, seed=args.seed, vocab=97, poison_rate=0.08,
+        mean_interarrival_s=0.05, prompt_len_max=40, max_new_max=12,
+        label="loadgen-bench")
+    save_trace(trace, os.path.join(args.out, "traces",
+                                   "loadgen_bench.jsonl"))
+
+    eng = build_engine(model_spec, engine_spec)
+    # warmup: compile prefill-chunk and decode launch widths outside the
+    # measured window
+    eng.submit(trace.requests[0].prompt(trace.vocab)[:20], 2)
+    eng.run()
+    ttft_before = obs.histogram("serve.ttft_s").get()
+
+    report = replay_trace(eng, trace, speed=args.speed)
+    ttft_p99 = quantile_from_window(
+        ttft_before, obs.histogram("serve.ttft_s").get(), 0.99)
+    goodput = (report.completed_tokens / report.wall_s
+               if report.wall_s > 0 else 0.0)
+
+    # SLO snapshot BEFORE the oracle pass — the oracle replays through the
+    # same in-process registry and would pollute the counters
+    slo = compute_slo(
+        _registry_records(), duration_s=report.duration_v,
+        completed_tokens=report.completed_tokens, n_done=report.n_done,
+        n_rejected=report.n_rejected)
+
+    oracle = oracle_replay(
+        trace, lambda: build_engine(model_spec,
+                                    dict(engine_spec, max_queue=None,
+                                         admission=None)))
+    assert_token_exact(report.completed(), oracle)
+    slo["wall_s"] = report.wall_s
+    slo["ttft_p99_wall_s"] = ttft_p99
+    slo["goodput_wall_tokens_per_s"] = goodput
+    platform = jax.devices()[0].platform
+
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "loadgen_slo.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(slo, f, indent=1, sort_keys=True)
+        f.write("\n")
+    records = [
+        ("headline_loadgen_ttft.json", {
+            "metric": f"serve.load_p99_ttft s @ trace seed={args.seed} "
+                      f"n={args.requests} {platform}",
+            "value": round(ttft_p99, 6), "unit": "s", "direction": "lower",
+            "timestamp": time.time(),
+            "note": "bench_loadgen.py trace replay (open-loop, admission "
+                    "policy on; token-exact vs oracle)"}),
+        ("headline_loadgen_goodput.json", {
+            "metric": f"serve.load_goodput tokens/s @ trace seed={args.seed} "
+                      f"n={args.requests} {platform}",
+            "value": round(goodput, 3), "unit": "tokens/s",
+            "direction": "higher", "timestamp": time.time(),
+            "note": "bench_loadgen.py trace replay — completed requests' "
+                    "tokens per wall second"}),
+    ]
+    for name, rec in records:
+        path = os.path.join(args.out, name)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        print(f"bench_loadgen: {rec['metric']} = {rec['value']} -> {path}")
+    print(f"bench_loadgen: {report.n_done} done / {report.n_rejected} "
+          f"rejected / {report.n_shed} shed, wall {report.wall_s:.2f}s, "
+          "token-exact vs oracle")
+    print(format_slo(slo))
+    return 0
+
+
+def _registry_records():
+    """The live registry's metric records, in merged-export schema (what
+    compute_slo consumes) — the single-process analogue of obs --merge."""
+    from burst_attn_tpu.obs.registry import default_registry
+
+    return default_registry().snapshot()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,9 +23,12 @@ config cannot regress.  Cached headline replays still gate: a cached record
 IS a prior on-chip measurement, and history only moves when fresh runs land.
 Cached provenance (`cached` / `cached_age_hours` from bench.py's replay
 path) is surfaced on every verdict line, and `--max-cached-age HOURS` adds
-a STALE-CACHE warning — warn only, never a gate failure: a stale replay is
-an honest old number, not a regression, but a driver round gating on a
-58-hour-old record should say so out loud.
+a STALE-CACHE warning — warn only by default: a stale replay is an honest
+old number, not a regression, but a driver round gating on a 58-hour-old
+record should say so out loud.  `--strict-cache` escalates those warnings
+to exit 1 for lanes that must run on fresh measurements.  `--summary-json
+PATH` additionally writes the machine-readable verdict summary (gate,
+exit_code, per-metric verdicts) for CI annotation.
 
 Exit status: 0 clean (or --dry-run), 1 regression, 2 internal error
 (missing/unparseable current headline counts as 2 — the gate cannot run).
@@ -184,8 +187,15 @@ def main(argv=None) -> int:
                          "value (default: 0.10)")
     ap.add_argument("--max-cached-age", type=float, default=None,
                     metavar="HOURS",
-                    help="warn (never gate) when a cached headline replay "
-                         "is older than this many hours")
+                    help="warn when a cached headline replay is older than "
+                         "this many hours (gates only with --strict-cache)")
+    ap.add_argument("--strict-cache", action="store_true",
+                    help="escalate STALE-CACHE warnings to gate failures "
+                         "(exit 1): a lane that MUST run on fresh numbers "
+                         "refuses to pass on an old replay")
+    ap.add_argument("--summary-json", metavar="PATH", default=None,
+                    help="also write the machine-readable verdict summary "
+                         "to PATH (CI annotation; independent of --json)")
     ap.add_argument("--dry-run", action="store_true",
                     help="report verdicts but always exit 0 (CI smoke lane)")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -211,26 +221,38 @@ def main(argv=None) -> int:
 
     regressed = [line for st, line in verdicts if st == "REGRESSION"]
     stale = [line for st, line in verdicts if st == "STALE-CACHE"]
+    gate_fail = bool(regressed) or (args.strict_cache and bool(stale))
+    exit_code = 1 if gate_fail and not args.dry_run else 0
+    summary = {
+        "tolerance": args.tolerance,
+        "dry_run": args.dry_run,
+        "strict_cache": args.strict_cache,
+        "n_regressions": len(regressed),
+        "n_stale_cached": len(stale),
+        "exit_code": exit_code,
+        "gate": "FAIL" if gate_fail else "PASS",
+        "verdicts": [{"status": st, "detail": line}
+                     for st, line in verdicts],
+    }
     if args.as_json:
-        print(json.dumps({
-            "tolerance": args.tolerance,
-            "dry_run": args.dry_run,
-            "n_regressions": len(regressed),
-            "n_stale_cached": len(stale),
-            "verdicts": [{"status": st, "detail": line}
-                         for st, line in verdicts],
-        }, indent=1))
+        print(json.dumps(summary, indent=1))
     else:
         for _, line in verdicts:
             print(line)
         print(f"check_regression: {len(regressed)} regression(s), "
-              f"{len(stale)} stale-cache warning(s) across "
-              f"{len(verdicts) - len(stale)} metric(s), tolerance "
+              f"{len(stale)} stale-cache "
+              + ("violation(s) [strict-cache]" if args.strict_cache
+                 else "warning(s)")
+              + f" across {len(verdicts) - len(stale)} metric(s), tolerance "
               f"{args.tolerance:g}"
               + (" [dry-run]" if args.dry_run else ""))
-    if regressed and not args.dry_run:
-        return 1
-    return 0
+    if args.summary_json:
+        d = os.path.dirname(os.path.abspath(args.summary_json))
+        os.makedirs(d, exist_ok=True)
+        with open(args.summary_json, "w", encoding="utf-8") as f:
+            json.dump(summary, f, indent=1)
+            f.write("\n")
+    return exit_code
 
 
 if __name__ == "__main__":

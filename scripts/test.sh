@@ -7,7 +7,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 args=("$@")
 filtered=()
-fast=0; tpu=0; fused=0; obs=0; schedule=0; serve=0
+fast=0; tpu=0; fused=0; obs=0; schedule=0; serve=0; loadgen=0
 for a in "${args[@]}"; do
   case "$a" in
     --fast) fast=1 ;;
@@ -16,6 +16,7 @@ for a in "${args[@]}"; do
     --obs) obs=1 ;;
     --schedule) schedule=1 ;;
     --serve) serve=1 ;;
+    --loadgen) loadgen=1 ;;
     *) filtered+=("$a") ;;
   esac
 done
@@ -65,6 +66,24 @@ elif [[ $serve == 1 ]]; then
   python scripts/bench_serve.py
   python scripts/check_regression.py \
     --headline 'results/headline_serve_*.json' --dry-run
+elif [[ $loadgen == 1 ]]; then
+  # production-serve hardening lane: trace/driver/SLO unit tests, the FULL
+  # multi-process fault matrix (kill mid-decode, forced pool exhaustion,
+  # stall, legacy engine — slow-marked tests included here on purpose), and
+  # the admission/drain/typed-rejection engine tests
+  python -m pytest tests/test_loadgen.py tests/test_loadgen_cluster.py -q \
+    ${filtered[@]+"${filtered[@]}"}
+  python -m pytest tests/test_serving.py -q \
+    -k "drain or typed_rejections or admission" \
+    ${filtered[@]+"${filtered[@]}"}
+  # bench + REAL perf gate (not dry-run): replay the canonical trace, emit
+  # serve.load_p99_ttft (lower) + serve.load_goodput (higher) headlines,
+  # then gate them against BENCH history with a machine-readable verdict.
+  # --strict-cache: this lane must run the bench fresh, never a stale replay.
+  python scripts/bench_loadgen.py
+  python scripts/check_regression.py \
+    --headline 'results/headline_loadgen_*.json' \
+    --strict-cache --summary-json results/loadgen_gate.json
 elif [[ $schedule == 1 ]]; then
   # focused lane for the ring-schedule IR + compiler (parallel/schedule.py):
   # compiler/oracle unit tests, interpret-mode parity of the bidi and

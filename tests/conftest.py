@@ -98,6 +98,10 @@ _SLOW = {
      "test_decode_rows_bit_equal_paged_decode_variants"),
     ("test_ragged_paged.py", "test_mixed_batch_int8_matches_oracle"),
     ("test_ragged_paged.py", "test_gqa_groups_match_oracle"),
+    ("test_loadgen_cluster.py", "test_cluster_stall_fault_and_graceful_stop"),
+    ("test_loadgen_cluster.py", "test_cluster_legacy_engine_kill_token_exact"),
+    ("test_loadgen_cluster.py",
+     "test_cluster_forced_pool_exhaustion_bounded_recovery"),
     ("test_serving.py", "test_engine_speculative_policy_token_exact"),
     ("test_serving.py", "test_legacy_engine_load_shed_split"),
     ("test_serving.py", "test_engine_exhaustion_admission_waits_then_proceeds"),

@@ -106,3 +106,47 @@ def test_stale_warning_rides_next_to_a_regression(tmp_path, capsys):
     assert rep["n_regressions"] == 1 and rep["n_stale_cached"] == 1
     statuses = {v["status"] for v in rep["verdicts"]}
     assert statuses == {"REGRESSION", "STALE-CACHE"}
+
+
+def test_strict_cache_escalates_stale_to_gate_failure(tmp_path, capsys):
+    """ISSUE 9 satellite: --strict-cache turns the STALE-CACHE warning
+    into exit 1 (a lane that must run fresh refuses an old replay);
+    --dry-run still wins, and a fresh record passes untouched."""
+    _write(tmp_path / "results" / "headline.json",
+           {"metric": "m1", "value": 130.0, "cached": True,
+            "cached_age_hours": 58.3})
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"metric": "m1", "value": 130.0}})
+    argv = _argv(tmp_path, "--max-cached-age", "24", "--strict-cache")
+    assert cr.main(argv) == 1
+    out = capsys.readouterr().out
+    assert "stale-cache violation(s) [strict-cache]" in out
+    assert cr.main([*argv, "--dry-run"]) == 0
+    capsys.readouterr()
+    # strict-cache without a stale record gates nothing
+    _write(tmp_path / "results" / "headline.json",
+           {"metric": "m1", "value": 130.0})
+    assert cr.main(argv) == 0
+
+
+def test_summary_json_written_and_matches_exit(tmp_path, capsys):
+    """--summary-json lands the machine-readable verdict file (gate,
+    exit_code, per-metric verdicts) for CI annotation, on pass AND fail."""
+    _write(tmp_path / "results" / "headline.json",
+           {"metric": "m1", "value": 90.0})
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"metric": "m1", "value": 130.0}})
+    spath = tmp_path / "out" / "summary.json"
+    assert cr.main(_argv(tmp_path, "--summary-json", str(spath))) == 1
+    capsys.readouterr()
+    rep = json.loads(spath.read_text())
+    assert rep["gate"] == "FAIL" and rep["exit_code"] == 1
+    assert rep["n_regressions"] == 1
+    assert any(v["status"] == "REGRESSION" for v in rep["verdicts"])
+    # passing run writes gate PASS with exit 0
+    _write(tmp_path / "results" / "headline.json",
+           {"metric": "m1", "value": 130.0})
+    assert cr.main(_argv(tmp_path, "--summary-json", str(spath))) == 0
+    capsys.readouterr()
+    rep = json.loads(spath.read_text())
+    assert rep["gate"] == "PASS" and rep["exit_code"] == 0

@@ -1,0 +1,244 @@
+"""loadgen unit + single-process integration: trace determinism, the
+open-loop driver's token-exactness under load shedding, admission-policy
+hysteresis, and the SLO math (quantiles, goodput, objectives)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from burst_attn_tpu.admission import AdmissionPolicy, RejectReason
+from burst_attn_tpu.loadgen import (
+    Objectives, Trace, assert_token_exact, compute_slo, diff_tokens,
+    evaluate, load_trace, oracle_replay, replay_trace, save_trace,
+    synthesize_trace,
+)
+from burst_attn_tpu.loadgen.slo import (
+    quantile_from_record, quantile_from_window,
+)
+from burst_attn_tpu.loadgen.worker import build_engine
+
+MODEL_SPEC = dict(vocab=97, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                  d_head=16, d_ff=64, block_q=8, block_kv=8, seed=0)
+ENGINE_SPEC = dict(kind="ragged", slots=2, n_pages=4, page=128,
+                   max_pages_per_seq=2, chunk=8, max_queue=8)
+
+
+# -- traces -----------------------------------------------------------------
+
+def test_trace_deterministic_and_roundtrip(tmp_path):
+    """Same seed -> bit-identical trace; save/load is lossless; prompts
+    regenerate identically from their seeds."""
+    a = synthesize_trace(32, seed=5, vocab=97, poison_rate=0.2)
+    b = synthesize_trace(32, seed=5, vocab=97, poison_rate=0.2)
+    assert a.meta == b.meta and a.requests == b.requests
+    assert synthesize_trace(32, seed=6, vocab=97).requests != a.requests
+    path = str(tmp_path / "t.jsonl")
+    save_trace(a, path)
+    c = load_trace(path)
+    assert c.meta == a.meta and c.requests == a.requests
+    for ra, rc in zip(a.requests, c.requests):
+        np.testing.assert_array_equal(ra.prompt(97), rc.prompt(97))
+    # arrivals are monotone and the meta records the span
+    ts = [r.t_arrival for r in a.requests]
+    assert ts == sorted(ts) and a.duration_s == ts[-1]
+
+
+def test_trace_poison_kinds_present():
+    tr = synthesize_trace(200, seed=0, vocab=97, poison_rate=0.3,
+                          oversize_len=9999)
+    kinds = {r.kind for r in tr.requests if r.poison}
+    assert kinds == {"poison-empty", "poison-budget", "poison-oversize"}
+    for r in tr.requests:
+        if r.kind == "poison-empty":
+            assert r.prompt_len == 0 and r.prompt(97).size == 0
+        elif r.kind == "poison-budget":
+            assert r.max_new_tokens == 0
+        elif r.kind == "poison-oversize":
+            assert r.prompt_len == 9999
+
+
+def test_trace_loader_is_strict(tmp_path):
+    path = tmp_path / "bad.jsonl"
+    good = synthesize_trace(2, seed=0, vocab=97)
+    save_trace(good, str(path))
+    # corrupt a request line -> loud
+    lines = path.read_text().splitlines()
+    path.write_text("\n".join(lines[:2] + ["{not json"]) + "\n")
+    with pytest.raises(ValueError, match="not JSON"):
+        load_trace(str(path))
+    # missing header -> loud
+    path.write_text(lines[1] + "\n")
+    with pytest.raises(ValueError, match="no trace-meta"):
+        load_trace(str(path))
+    # version mismatch -> loud
+    hdr = json.loads(lines[0])
+    hdr["version"] = 99
+    path.write_text(json.dumps(hdr) + "\n" + lines[1] + "\n")
+    with pytest.raises(ValueError, match="version"):
+        load_trace(str(path))
+
+
+def test_trace_bursty_arrivals_are_overdispersed():
+    """The Markov-modulated model must produce clumpier-than-Poisson
+    arrivals: interarrival CV well above 1 with a real burst factor."""
+    tr = synthesize_trace(600, seed=1, vocab=97, burst_factor=16.0,
+                          p_enter_burst=0.1, p_exit_burst=0.2)
+    gaps = np.diff([0.0] + [r.t_arrival for r in tr.requests])
+    cv = gaps.std() / gaps.mean()
+    assert cv > 1.15, f"arrivals not bursty: CV={cv:.3f}"
+
+
+# -- admission policy hysteresis -------------------------------------------
+
+def test_admission_policy_pool_hysteresis():
+    pol = AdmissionPolicy(pool_high=0.9, pool_low=0.5, queue_high=None)
+    assert pol.decide(queue_depth=0, pool_occupancy=0.89) is None
+    assert pol.decide(queue_depth=0,
+                      pool_occupancy=0.9) is RejectReason.ADMISSION_POOL
+    # below high but above low: hysteresis keeps shedding
+    assert pol.decide(queue_depth=0,
+                      pool_occupancy=0.6) is RejectReason.ADMISSION_POOL
+    # below low: recovered
+    assert pol.decide(queue_depth=0, pool_occupancy=0.4) is None
+    assert pol.decide(queue_depth=0, pool_occupancy=0.85) is None
+    assert pol.shed_pool == 2
+
+
+def test_admission_policy_queue_hysteresis_and_ordering():
+    pol = AdmissionPolicy(pool_high=0.9, pool_low=0.5, queue_high=4,
+                          queue_low=1)
+    # both axes over: POOL sheds first (ordering extends the hard-shed
+    # pool-before-queue contract)
+    assert pol.decide(queue_depth=9,
+                      pool_occupancy=0.99) is RejectReason.ADMISSION_POOL
+    assert pol.decide(queue_depth=9,
+                      pool_occupancy=0.1) is RejectReason.ADMISSION_QUEUE
+    assert pol.decide(queue_depth=2,
+                      pool_occupancy=0.1) is RejectReason.ADMISSION_QUEUE
+    assert pol.decide(queue_depth=1, pool_occupancy=0.1) is None
+    assert pol.shed_queue == 2 and pol.shed_pool == 1
+
+
+def test_admission_policy_validates_water_marks():
+    with pytest.raises(ValueError, match="pool_low"):
+        AdmissionPolicy(pool_high=0.5, pool_low=0.9)
+    with pytest.raises(ValueError, match="queue_low"):
+        AdmissionPolicy(queue_high=2, queue_low=5)
+
+
+# -- SLO math ---------------------------------------------------------------
+
+def test_quantile_from_record_and_window():
+    rec = {"bucket_edges": [0.1, 0.5, 1.0], "bucket_counts": [50, 40, 9],
+           "overflow": 1, "count": 100, "max": 7.5}
+    assert quantile_from_record(rec, 0.5) == 0.1
+    assert quantile_from_record(rec, 0.9) == 0.5
+    assert quantile_from_record(rec, 0.99) == 1.0
+    # quantile landing in the overflow reports the observed max
+    assert quantile_from_record(rec, 1.0) == 7.5
+    empty = {"bucket_edges": [0.1], "bucket_counts": [0], "overflow": 0,
+             "max": 0.0}
+    assert quantile_from_record(empty, 0.99) == 0.0
+    # window deltas: only the observations BETWEEN snapshots count
+    before = {"buckets": {"0.1": 10, "0.5": 0}, "max": 0.05}
+    after = {"buckets": {"0.1": 10, "0.5": 8, "+Inf": 2}, "max": 3.0}
+    assert quantile_from_window(before, after, 0.5) == 0.5
+    assert quantile_from_window(before, after, 0.99) == 3.0
+    with pytest.raises(ValueError):
+        quantile_from_record(rec, 0.0)
+
+
+def test_compute_slo_and_objectives():
+    metrics = [
+        {"kind": "histogram", "name": "serve.ttft_s", "labels": {},
+         "bucket_edges": [0.1, 1.0], "bucket_counts": [9, 1], "overflow": 0,
+         "count": 10, "sum": 2.0, "min": 0.01, "max": 0.9},
+        {"kind": "histogram", "name": "serve.token_latency_s", "labels": {},
+         "bucket_edges": [0.01], "bucket_counts": [100], "overflow": 0,
+         "count": 100, "sum": 0.5, "min": 0.001, "max": 0.009},
+        {"kind": "counter", "name": "serve.tokens_generated", "labels": {},
+         "value": 100},
+        {"kind": "counter", "name": "serve.requests_submitted", "labels": {},
+         "value": 10},
+        {"kind": "counter", "name": "serve.requests_rejected",
+         "labels": {"reason": "queue-full"}, "value": 4},
+        {"kind": "counter", "name": "serve.requests_rejected",
+         "labels": {"reason": "admission-pool"}, "value": 2},
+        {"kind": "counter", "name": "serve.requests_rejected",
+         "labels": {"reason": "empty-prompt"}, "value": 1},
+    ]
+    slo = compute_slo(metrics, duration_s=10.0, completed_tokens=80,
+                      n_done=8)
+    assert slo["ttft_p50_s"] == 0.1 and slo["ttft_p99_s"] == 1.0
+    assert slo["throughput_tokens_per_s"] == 10.0
+    assert slo["goodput_tokens_per_s"] == 8.0
+    assert slo["shed_decisions"] == 6          # queue-full + admission-pool
+    assert slo["invalid_rejections"] == 1      # empty-prompt is not a shed
+    assert slo["shed_rate"] == pytest.approx(6 / 17)
+    ok, violations = evaluate(slo, Objectives(max_ttft_p99_s=2.0,
+                                              min_goodput_tokens_per_s=5.0,
+                                              max_shed_rate=0.5))
+    assert ok and violations == []
+    ok, violations = evaluate(slo, Objectives(max_ttft_p99_s=0.5,
+                                              min_goodput_tokens_per_s=50.0))
+    assert not ok and len(violations) == 2
+    # an objective over a value the report lacks is itself a violation
+    ok, violations = evaluate({}, Objectives(max_shed_rate=0.1))
+    assert not ok and "no value" in violations[0]
+
+
+def test_diff_tokens_reports_divergence_and_phantoms():
+    oracle = {1: [5, 6, 7], 2: [8, 9]}
+    assert diff_tokens({1: [5, 6, 7]}, oracle) == []
+    bad = diff_tokens({1: [5, 6, 8], 3: [1]}, oracle)
+    assert len(bad) == 2
+    assert "position 2" in bad[0] and "oracle rejected" in bad[1]
+    with pytest.raises(AssertionError, match="token corruption"):
+        assert_token_exact({2: [8, 1]}, oracle)
+
+
+# -- single-process driver replay ------------------------------------------
+
+def test_driver_replay_token_exact_with_sheds_and_poison():
+    """Open-loop replay on a deliberately tight engine (2 slots, 3 usable
+    pages, max_queue + admission policy): sheds/retries happen, poison is
+    rejected with typed reasons, and every completed request matches the
+    sequential oracle token for token."""
+    trace = synthesize_trace(
+        10, seed=7, vocab=97, poison_rate=0.25, mean_interarrival_s=0.01,
+        prompt_len_max=40, max_new_max=8, oversize_len=9999)
+    assert any(r.poison for r in trace.requests)
+    spec = dict(ENGINE_SPEC,
+                admission={"pool_high": 0.99, "pool_low": 0.5,
+                           "queue_high": 6, "queue_low": 2})
+    eng = build_engine(MODEL_SPEC, spec)
+    # warm the jit caches (prefill-chunk + decode widths) outside the
+    # replay so compile time doesn't eat the retry budget
+    eng.submit(np.arange(1, 21, dtype=np.int32), 2)
+    eng.run()
+    report = replay_trace(eng, trace, speed=100.0, retry_backoff_s=1.0,
+                          max_retries=2000)
+    assert report.n_done == len(trace.normal())
+    assert report.n_rejected == sum(r.poison for r in trace.requests)
+    for out in report.by_status("rejected"):
+        assert out.reason in ("empty-prompt", "bad-budget", "table-width",
+                              "pool-size")
+    oracle = oracle_replay(
+        trace, lambda: build_engine(MODEL_SPEC,
+                                    dict(ENGINE_SPEC, max_queue=None)))
+    assert_token_exact(report.completed(), oracle)
+    # virtual timestamps are populated for completed work
+    for out in report.by_status("done"):
+        assert out.t_submit is not None and out.t_done >= out.t_submit
+
+
+def test_cli_gen_writes_replayable_trace(tmp_path, capsys):
+    from burst_attn_tpu.loadgen.__main__ import main
+
+    out = str(tmp_path / "traces" / "cli.jsonl")
+    assert main(["gen", "--out", out, "--n", "5", "--seed", "3",
+                 "--poison-rate", "0.2"]) == 0
+    assert "wrote 5 requests" in capsys.readouterr().out
+    tr = load_trace(out)
+    assert isinstance(tr, Trace) and len(tr.requests) == 5

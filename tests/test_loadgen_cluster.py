@@ -1,0 +1,139 @@
+"""Multi-process loadgen cluster: fault injection, rerouting, recovery.
+
+The fast canary (2 workers, tiny trace, one kill + one pool-hog) proves
+the acceptance property end to end on every lane run: a worker SIGKILLed
+mid-decode loses nothing — the router reroutes its in-flight requests
+and the final token streams are EXACTLY the single-process oracle's
+(zero token corruption), with the merged obs view still produced.  The
+heavier matrix (stall fault, legacy-engine cluster, forced pool
+exhaustion with bounded recovery) is slow-marked.
+
+Workers are real spawned processes importing jax fresh (~5 s startup on
+the CI box), so traces here stay tiny and virtual speeds modest."""
+
+import numpy as np
+import pytest
+
+from burst_attn_tpu.loadgen import (
+    FaultEvent, LoadGenCluster, Objectives, assert_token_exact, compute_slo,
+    evaluate, oracle_replay, synthesize_trace,
+)
+from burst_attn_tpu.loadgen.worker import build_engine
+
+MODEL_SPEC = dict(vocab=97, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                  d_head=16, d_ff=64, block_q=8, block_kv=8, seed=0)
+ENGINE_SPEC = dict(kind="ragged", slots=2, n_pages=6, page=128,
+                   max_pages_per_seq=2, chunk=8, max_queue=16)
+ORACLE_SPEC = dict(ENGINE_SPEC, max_queue=None)
+
+
+def _trace(n=8, seed=7, **kw):
+    kw.setdefault("mean_interarrival_s", 0.25)
+    kw.setdefault("prompt_len_max", 24)
+    kw.setdefault("max_new_max", 6)
+    return synthesize_trace(n, seed=seed, vocab=97, **kw)
+
+
+def _oracle(trace):
+    return oracle_replay(trace,
+                         lambda: build_engine(MODEL_SPEC, ORACLE_SPEC))
+
+
+def test_cluster_canary_kill_and_hog_token_exact(tmp_path):
+    """THE acceptance gate: worker 0 is SIGKILLed while holding in-flight
+    decodes, worker 1's pool is hogged (forced exhaustion) and released;
+    every normal request still completes with oracle-exact tokens, the
+    poison request is rejected with a typed reason, and the surviving
+    workers' exports merge into one SLO report."""
+    trace = _trace(8, seed=7, poison_rate=0.15)
+    assert any(r.poison for r in trace.requests)
+    faults = [
+        FaultEvent(t=0.2, kind="hog", worker=1, arg=5, note="pool squeeze"),
+        FaultEvent(t=0.6, kind="kill", worker=0, note="mid-decode kill"),
+        FaultEvent(t=1.5, kind="unhog", worker=1),
+    ]
+    with LoadGenCluster(MODEL_SPEC, ENGINE_SPEC, n_workers=2,
+                        out_dir=str(tmp_path)) as cluster:
+        report = cluster.replay(trace, faults, speed=1.0, max_wall_s=150)
+        cluster.stop()                  # flush survivors' final exports
+        metrics, _spans, meta = cluster.merged()
+    # the kill actually happened, against a worker that held work unless
+    # the trace had fully drained first
+    assert len(report.kills) == 1 and report.kills[0]["scheduled"]
+    assert report.n_done == len(trace.normal())
+    assert report.n_rejected == sum(r.poison for r in trace.requests)
+    # zero token corruption across the kill: multi-process replay ==
+    # single-process oracle, token for token
+    assert_token_exact(report.completed(), _oracle(trace))
+    # bounded recovery: rerouted work finished within the replay window
+    for rec in report.recovery_s():
+        assert 0.0 <= rec < 120.0
+    # merged obs is a usable SLO report even with a dead worker
+    assert meta["processes"] >= 1
+    slo = compute_slo(metrics, duration_s=report.duration_v,
+                      completed_tokens=report.completed_tokens,
+                      n_done=report.n_done)
+    assert slo["goodput_tokens_per_s"] > 0
+    ok, violations = evaluate(
+        slo, Objectives(min_goodput_tokens_per_s=0.01, max_shed_rate=0.99))
+    assert ok, violations
+
+
+def test_cluster_stall_fault_and_graceful_stop(tmp_path):
+    """A stalled worker (frozen engine loop — delayed-retire stand-in)
+    delays but never corrupts; a graceful stop flushes one final export
+    per worker so the merge sees every process."""
+    trace = _trace(6, seed=3)
+    faults = [FaultEvent(t=0.3, kind="stall", worker=0, arg=1.0)]
+    with LoadGenCluster(MODEL_SPEC, ENGINE_SPEC, n_workers=2,
+                        out_dir=str(tmp_path)) as cluster:
+        report = cluster.replay(trace, faults, speed=1.0, max_wall_s=150)
+        cluster.stop()
+        metrics, _spans, meta = cluster.merged()
+    assert report.n_done == len(trace.normal()) and not report.kills
+    assert_token_exact(report.completed(), _oracle(trace))
+    assert meta["processes"] == 2
+    assert compute_slo(metrics,
+                       duration_s=report.duration_v)["requests_retired"] > 0
+
+
+def test_cluster_legacy_engine_kill_token_exact(tmp_path):
+    """The harness is engine-agnostic: models/serve.py's ServeEngine
+    behind the same router survives a kill with oracle-exact output."""
+    trace = _trace(6, seed=5)
+    spec = dict(ENGINE_SPEC, kind="legacy")
+    spec.pop("chunk")                       # legacy engine has no chunking
+    faults = [FaultEvent(t=0.5, kind="kill", worker=1)]
+    with LoadGenCluster(MODEL_SPEC, spec, n_workers=2,
+                        out_dir=str(tmp_path)) as cluster:
+        report = cluster.replay(trace, faults, speed=1.0, max_wall_s=150)
+    assert len(report.kills) == 1
+    assert report.n_done == len(trace.normal())
+    assert_token_exact(
+        report.completed(),
+        oracle_replay(trace, lambda: build_engine(
+            MODEL_SPEC, dict(spec, max_queue=None))))
+
+
+def test_cluster_forced_pool_exhaustion_bounded_recovery(tmp_path):
+    """Single worker, whole pool hogged before traffic lands: everything
+    sheds/queues, nothing is lost, and once the pages come back the
+    backlog drains to completion (bounded recovery) with shed decisions
+    visible in the merged counters."""
+    trace = _trace(6, seed=9, mean_interarrival_s=0.1)
+    faults = [FaultEvent(t=0.0, kind="hog", worker=0, arg=5),
+              FaultEvent(t=2.0, kind="unhog", worker=0)]
+    with LoadGenCluster(MODEL_SPEC, ENGINE_SPEC, n_workers=1,
+                        out_dir=str(tmp_path)) as cluster:
+        report = cluster.replay(trace, faults, speed=1.0, max_wall_s=150)
+        cluster.stop()
+        metrics, _spans, _meta = cluster.merged()
+    assert report.n_done == len(trace.normal())
+    assert_token_exact(report.completed(), _oracle(trace))
+    # the squeeze was real: at least one shed/deferral decision fired
+    slo = compute_slo(metrics, duration_s=report.duration_v)
+    assert slo["shed_decisions"] > 0
+    # recovery bounded: the last completion landed after the unhog but
+    # within the replay window
+    t_dones = [o.t_done for o in report.by_status("done")]
+    assert max(t_dones) >= 2.0 and max(t_dones) < 150.0

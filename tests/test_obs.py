@@ -564,3 +564,35 @@ def test_log_helper_delegates_to_obs():
     before = obs.counter("log.events").get(level="ERROR")
     log.error("boom")
     assert obs.counter("log.events").get(level="ERROR") == before + 1
+
+
+def test_merge_tolerates_truncated_final_line_only(tmp_path):
+    """ISSUE 9 satellite: a worker SIGKILLed mid-export leaves a torn
+    FINAL line — the merge skips it with a `truncated_lines` count
+    instead of failing the whole job view.  Garbage anywhere else (or a
+    file that is nothing but garbage) still raises."""
+    from burst_attn_tpu.obs.aggregate import (
+        load_records_tolerant, merge_files,
+    )
+
+    paths = _write_proc_files(tmp_path, 2)
+    with open(paths[1], "a", encoding="utf-8") as f:
+        f.write('{"kind": "counter", "name": "serve.requ')  # torn by kill
+    records, skipped = load_records_tolerant(paths[1])
+    assert skipped == 1 and all(isinstance(r, dict) for r in records)
+    metrics, _spans, meta = merge_files([str(tmp_path / "obs*.jsonl")])
+    assert meta["processes"] == 2
+    assert meta["truncated_lines"] == 1
+    by = {(m["name"], tuple(sorted(m["labels"].items()))): m for m in metrics}
+    assert by[("train.steps", ())]["value"] == 100 + 200  # still summed
+    # mid-file corruption is NOT truncation
+    lines = open(paths[0], encoding="utf-8").read().splitlines()
+    lines.insert(1, "not json")
+    open(paths[0], "w", encoding="utf-8").write("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="not JSON"):
+        load_records_tolerant(paths[0])
+    # a garbage-only file stays loud (exit-2 path in the CLI)
+    only_bad = tmp_path / "obs_bad.jsonl"
+    only_bad.write_text("garbage\n")
+    with pytest.raises(ValueError):
+        load_records_tolerant(str(only_bad))

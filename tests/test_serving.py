@@ -269,3 +269,96 @@ def test_servecheck_rule_clean_and_fires_on_mutant():
                                       anchor=("<test>", 1))
     assert any(f.rule == "ragged-serve-safe" and "callback" in f.message
                for f in findings)
+
+
+def test_typed_rejections_and_try_submit(setup):
+    """ISSUE 9 satellite: submit() rejections are TYPED (InvalidRequest /
+    LoadShed with a `.reason` enum matching the counter label) and
+    try_submit() is the non-raising router surface with a retryable bit."""
+    from burst_attn_tpu.admission import (
+        InvalidRequest, LoadShed, RejectReason,
+    )
+
+    cfg, params, prompts, steps, refs = setup
+    for cls in (RaggedServeEngine, ServeEngine):
+        kw = {} if cls is ServeEngine else {"chunk": 4}
+        eng = cls(params, cfg, slots=1, n_pages=4, page=128,
+                  max_pages_per_seq=8, max_queue=1, **kw)
+        with pytest.raises(InvalidRequest) as ei:
+            eng.submit([], 5)
+        assert ei.value.reason is RejectReason.EMPTY_PROMPT
+        assert not ei.value.reason.retryable
+        res = eng.try_submit([1, 2], 0)
+        assert not res.ok and res.reason is RejectReason.BAD_BUDGET
+        assert not res.retryable
+        ok = eng.try_submit(prompts[0], 2)
+        assert ok.ok and ok.reason is None
+        eng.step()
+        eng.try_submit(prompts[1], 2)            # queues
+        with pytest.raises(LoadShed) as es:      # queue full now
+            eng.submit(prompts[2], 2)
+        assert es.value.reason in (RejectReason.QUEUE_FULL,
+                                   RejectReason.POOL_EXHAUSTED)
+        shed = eng.try_submit(prompts[2], 2)
+        assert not shed.ok and shed.retryable
+        res = eng.run()
+        assert res[ok.rid] == refs[0][:2]
+
+
+def test_engine_admission_policy_sheds_with_hysteresis(setup):
+    """An attached AdmissionPolicy sheds EARLY (typed admission-* reasons)
+    from the live queue-depth gauge value, and stops shedding only after
+    the queue drains below the low-water mark."""
+    from burst_attn_tpu.admission import AdmissionPolicy, LoadShed, RejectReason
+
+    cfg, params, prompts, steps, refs = setup
+    pol = AdmissionPolicy(pool_high=None, queue_high=2, queue_low=0)
+    eng = RaggedServeEngine(params, cfg, slots=1, n_pages=20, page=128,
+                            max_pages_per_seq=4, chunk=4, admission=pol)
+    base = obs.counter("serve.requests_rejected").get(
+        reason="admission-queue")
+    r0 = eng.submit(prompts[0], 2)
+    eng.step()                                   # r0 admitted, queue empty
+    r1 = eng.submit(prompts[1], 2)
+    r2 = eng.submit(prompts[2], 2)               # queue depth 2 = high mark
+    with pytest.raises(LoadShed) as e:
+        eng.submit(prompts[3], 2)
+    assert e.value.reason is RejectReason.ADMISSION_QUEUE
+    assert obs.counter("serve.requests_rejected").get(
+        reason="admission-queue") == base + 1
+    # hysteresis: still shedding at depth 1 (> queue_low 0)
+    eng.run()                                    # drains to depth 0
+    rid = eng.submit(prompts[3], 2)              # re-admits below low mark
+    assert eng.run()[rid] == refs[3][:2]
+    assert pol.shed_queue == 1
+
+
+@pytest.mark.parametrize("engine_cls", ["ragged", "legacy"])
+def test_engine_drain_requeues_inflight_token_exact(setup, engine_cls):
+    """ISSUE 9 satellite: graceful-shutdown drain — in-flight sequences
+    are requeued (not lost), the pool returns to 0 occupancy with gauges
+    refreshed, and a post-drain run() serves everything token-exact."""
+    cfg, params, prompts, steps, refs = setup
+    if engine_cls == "ragged":
+        eng = RaggedServeEngine(params, cfg, slots=2, n_pages=8, page=128,
+                                max_pages_per_seq=2, chunk=4)
+    else:
+        eng = ServeEngine(params, cfg, slots=2, n_pages=8, page=128,
+                          max_pages_per_seq=2)
+    rids = [eng.submit(p, s) for p, s in zip(prompts[:3], steps[:3])]
+    for _ in range(3):                           # two in flight, mid-decode
+        eng.step()
+    assert eng.live == 2
+    requeued = eng.drain()
+    assert sorted(requeued) == sorted(rids[:2])
+    assert eng.live == 0
+    assert eng.pool.available == eng.pool.n_pages - 1
+    assert obs.gauge("serve.live_slots").get() == 0
+    assert obs.gauge("serve.page_pool_occupancy").get() == 0.0
+    assert obs.gauge("serve.queue_depth").get() == 3
+    # requeued work re-serves FIRST and token-exact (greedy decode
+    # regenerates the identical stream from scratch)
+    res = eng.run()
+    for rid, ref, s in zip(rids, refs[:3], steps[:3]):
+        assert res[rid] == ref[:s]
+    assert eng.pool.available == eng.pool.n_pages - 1
