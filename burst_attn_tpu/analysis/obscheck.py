@@ -39,6 +39,11 @@ rule("devstats-pure", "jaxpr",
      "stats-enabled ring fwd/bwd carry zero host-callback primitives; "
      "stats-off trace bit-identical to the plain ring")(None)
 
+rule("ckpt-jit-safe", "jaxpr",
+     "traced serve-step programs (ragged_model_step / paged_decode_step) "
+     "carry zero host-callback primitives — checkpoint/journal writes "
+     "stay at the host dispatch boundary")(None)
+
 _LEGACY_CALLBACK_PRIMS = ("outside_call",)
 
 
@@ -177,4 +182,38 @@ def check_all() -> List[Finding]:
     findings += check_off_identity(jax.make_jaxpr(off)(q, q, q),
                                    jax.make_jaxpr(plain)(q, q, q),
                                    anchor=anchor_dev)
+
+    # ---- ckpt-jit-safe: the serve-step programs the checkpoint layer
+    # wraps.  Journal appends / snapshot saves live in the engines' host
+    # loops; this proves none of them leaked INTO the traced step — a
+    # journal hook spelled as `jax.debug.callback(journal.tokens, ...)`
+    # would surface here as a callback primitive regardless of module.
+    from ..models.paged_decode import init_paged_state, paged_decode_step
+    from ..models.transformer import ModelConfig, init_params
+    from ..serving import model as serving_model
+
+    cfg_s = ModelConfig(vocab=97, d_model=16, n_layers=1, n_heads=2,
+                        n_kv_heads=1, d_head=8, d_ff=32, attn_backend="jnp",
+                        remat=False, dtype=jnp.float32, batch_axis=None,
+                        head_axis=None)
+    params = init_params(jax.random.PRNGKey(0), cfg_s)
+    state, _pool = init_paged_state(cfg_s, slots=2, n_pages=4, page=128,
+                                    max_pages_per_seq=2)
+    toks2 = jnp.zeros((2, 8), jnp.int32)
+    qlens = jnp.ones((2,), jnp.int32)
+    for attn in ("dense", "ragged"):
+        findings += check_trace(
+            jax.make_jaxpr(
+                lambda p, t, ql, st: serving_model.ragged_model_step(
+                    p, t, ql, st, cfg_s, attn=attn)
+            )(params, toks2, qlens, state),
+            where=f"ragged_model_step (attn={attn})",
+            anchor=_anchor(serving_model.ragged_model_step),
+            rule_name="ckpt-jit-safe")
+    findings += check_trace(
+        jax.make_jaxpr(
+            lambda p, t, st: paged_decode_step(p, t, st, cfg_s)
+        )(params, jnp.zeros((2,), jnp.int32), state),
+        where="paged_decode_step", anchor=_anchor(paged_decode_step),
+        rule_name="ckpt-jit-safe")
     return findings

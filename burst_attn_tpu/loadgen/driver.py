@@ -23,12 +23,54 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from .trace import Trace
 
 # outcome.status vocabulary
 DONE = "done"            # completed; tokens are the engine's output
 REJECTED = "rejected"    # non-retryable typed rejection (poison et al.)
 SHED = "shed"            # retryable sheds exhausted max_retries
+
+
+@dataclass(frozen=True)
+class RetryBackoff:
+    """Seeded exponential backoff with jitter, in VIRTUAL seconds.
+
+    A constant backoff resubmits an entire shed wave in lockstep — every
+    rejected request comes back at the same instant and is shed again
+    (retry storm).  `delay(rid, attempt)` decorrelates them: the base
+    delay doubles per attempt (capped), and a per-(seed, rid, attempt)
+    jitter in [1-jitter, 1+jitter] spreads requests apart.  Fully
+    deterministic: the same seed gives the same schedule, different rids
+    get independent streams (numpy's seed-sequence spawning — no shared
+    RNG state, so the schedule is independent of call order)."""
+
+    base_s: float = 0.05
+    cap_s: float = 2.0
+    factor: float = 2.0
+    jitter: float = 0.5
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.base_s <= 0:
+            raise ValueError(f"base_s must be > 0, got {self.base_s}")
+        if self.cap_s < self.base_s:
+            raise ValueError(f"cap_s {self.cap_s} < base_s {self.base_s}")
+        if self.factor < 1.0:
+            raise ValueError(f"factor must be >= 1, got {self.factor}")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+
+    def delay(self, rid: int, attempt: int) -> float:
+        """Virtual-seconds delay before retry number `attempt` (1-based)
+        of request `rid`."""
+        if attempt < 1:
+            raise ValueError(f"attempt must be >= 1, got {attempt}")
+        det = min(self.base_s * self.factor ** (attempt - 1), self.cap_s)
+        u = np.random.default_rng(
+            [int(self.seed), int(rid), int(attempt)]).random()
+        return det * (1.0 + self.jitter * (2.0 * u - 1.0))
 
 
 @dataclass
@@ -88,17 +130,22 @@ class ReplayReport:
 
 def replay_trace(engine, trace: Trace, *, speed: float = 50.0,
                  retry_backoff_s: float = 0.05, max_retries: int = 200,
-                 max_wall_s: float = 300.0) -> ReplayReport:
+                 max_wall_s: float = 300.0,
+                 backoff: Optional[RetryBackoff] = None) -> ReplayReport:
     """Replay `trace` open-loop against `engine` (already constructed —
     any admission policy / max_queue it carries is what gets exercised).
 
     `speed` maps virtual trace seconds to wall time (virtual = wall *
     speed), so a 5-virtual-second trace replays in ~0.1 wall seconds at
     the default; timestamps in the report stay in VIRTUAL seconds and are
-    therefore speed-invariant.  `retry_backoff_s` is virtual too.
+    therefore speed-invariant.  Retry delays are virtual too: seeded
+    exponential backoff + jitter (`backoff`, defaulting to a
+    RetryBackoff seeded at `retry_backoff_s` base).
     """
     if speed <= 0:
         raise ValueError(f"speed must be > 0, got {speed}")
+    bo = backoff if backoff is not None else RetryBackoff(
+        base_s=retry_backoff_s, cap_s=max(retry_backoff_s * 40, 2.0))
     vocab = trace.vocab
     arrivals = sorted(trace.requests, key=lambda r: (r.t_arrival, r.rid))
     retry: List[tuple] = []           # (t_due_v, trace rid)
@@ -118,7 +165,7 @@ def replay_trace(engine, trace: Trace, *, speed: float = 50.0,
             out.t_submit = now_v
         elif res.retryable and out.retries < max_retries:
             out.retries += 1
-            retry.append((now_v + retry_backoff_s, req.rid))
+            retry.append((now_v + bo.delay(req.rid, out.retries), req.rid))
         else:
             out.status = SHED if res.retryable else REJECTED
             out.reason = res.reason.value if res.reason else None

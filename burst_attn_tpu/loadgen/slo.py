@@ -106,10 +106,32 @@ def counter_total(metrics: Sequence[dict], name: str,
     return total
 
 
+def recovery_stats(recovery_s: Sequence[float]) -> Dict[str, object]:
+    """Per-fault recovery-span stats (`ClusterReport.recovery_s()` — the
+    virtual seconds from each fault to its last recovered completion).
+    Nearest-rank quantiles over the RAW samples: recovery spans come from
+    the router's fault ledger, not from histogram buckets, and a fault
+    schedule has few events — bucketing would only lose the tail."""
+    samples = sorted(float(s) for s in recovery_s)
+    out: Dict[str, object] = {"recovery_count": len(samples)}
+    if not samples:
+        out.update({"recovery_p50_s": 0.0, "recovery_p99_s": 0.0,
+                    "recovery_max_s": 0.0})
+        return out
+    n = len(samples)
+    for q in (0.50, 0.99):
+        idx = min(n - 1, max(0, int(-(-q * n // 1)) - 1))  # ceil(q*n) - 1
+        out[f"recovery_p{int(q * 100)}_s"] = samples[idx]
+    out["recovery_max_s"] = samples[-1]
+    return out
+
+
 def compute_slo(metrics: Sequence[dict], *, duration_s: float,
                 completed_tokens: Optional[int] = None,
                 n_done: Optional[int] = None,
-                n_rejected: Optional[int] = None) -> Dict[str, object]:
+                n_rejected: Optional[int] = None,
+                recovery_s: Optional[Sequence[float]] = None
+                ) -> Dict[str, object]:
     """One SLO report from a merged metrics view (`obs --merge` output or
     `aggregate.merge_files(...)[0]`).
 
@@ -161,6 +183,8 @@ def compute_slo(metrics: Sequence[dict], *, duration_s: float,
         report["n_done"] = int(n_done)
     if n_rejected is not None:
         report["n_rejected"] = int(n_rejected)
+    if recovery_s is not None:
+        report.update(recovery_stats(recovery_s))
     return report
 
 
@@ -207,7 +231,8 @@ def format_slo(report: Dict[str, object]) -> str:
              "throughput_tokens_per_s", "goodput_tokens_per_s",
              "completed_tokens", "tokens_generated", "requests_submitted",
              "requests_retired", "n_done", "n_rejected", "shed_decisions",
-             "invalid_rejections", "shed_rate")
+             "invalid_rejections", "shed_rate", "recovery_count",
+             "recovery_p50_s", "recovery_p99_s", "recovery_max_s")
     lines = []
     for key in order:
         if key in report:

@@ -4,19 +4,44 @@ Each worker is an isolated OS process (multiprocessing `spawn` context —
 a clean interpreter, its own single-process CPU JAX runtime, its own obs
 registry) running one serve engine and a small message loop:
 
-  router -> worker   ("submit", rrid, prompt list, max_new)
-                     ("fault", fault_kind, arg)   hog | unhog | stall
+  router -> worker   ("submit", rrid, prompt list, max_new[, resume_toks])
+                     ("fault", fault_kind, arg)   hog | unhog | stall | hang
+                     ("ping", seq)                heartbeat probe
                      ("stop",)                    finish backlog, export, exit
   worker -> router   ("ready", wid, pid)
+                     ("restored", wid, info)      checkpoint recovery summary
                      ("accepted", wid, rrid)
                      ("rejected", wid, rrid, reason, retryable, message)
                      ("done", wid, rrid, tokens)
+                     ("pong", wid, seq)
                      ("stopped", wid)
                      ("error", wid, message)      engine loop blew up
 
 Request ids on the wire are the ROUTER's (trace rids): the worker maps
 its engine's local rids back before reporting, so the router never sees
 worker-local numbering.
+
+Crash consistency (`ckpt_spec`, serving/checkpoint.py): when enabled the
+engine runs with a write-ahead TokenJournal (every generated token is
+fsynced before its done record can leave the process) and the worker
+snapshots the whole engine every `every` completions.  Three recovery
+flows ride on that state:
+
+  * reroute resume — a submit carrying `resume_toks` (the dead worker's
+    journaled prefix for that rid) is admitted as prompt+prefix with the
+    budget reduced; the prefix is prepended before reporting done, so
+    the router sees the original request shape.  Requires greedy decode
+    (the prefix must be the continuation the engine would have emitted).
+  * restart restore — a replacement worker (`ckpt_spec["restore"]`)
+    rebuilds its predecessor's engine from snapshot + journal
+    (`recover_engine`), reports what it claimed via "restored", emits
+    journal-complete requests as immediate dones, and rewrites a fresh
+    journal so a SECOND failure recovers from this life alone.
+  * accounting — `serve.recovered_tokens_resumed` counts tokens
+    recovered without re-decoding, `serve.recovered_tokens_replayed`
+    counts re-decoded ones (resume disabled, or journal lag); the
+    acceptance gate asserts resumed recovery strictly beats
+    replay-from-scratch on the same trace+fault schedule.
 
 Obs discipline: the engine's serve.* instruments land in this process's
 registry; the loop exports a full fsynced snapshot to the worker's JSONL
@@ -29,8 +54,10 @@ Fault injection runs INSIDE the worker because that is where the faults
 live in production: "hog" grabs pages straight from the engine's pool
 (forced pool exhaustion — admission and shed paths see real scarcity),
 "unhog" releases them, "stall" freezes the engine loop (delayed retire /
-GC pause stand-in) without touching the queue.  Worker kill is not a
-message — the router SIGKILLs the process, the point being that no
+GC pause stand-in) without touching the queue, "hang" wedges the WHOLE
+loop — no stepping, no queue drain, no pong — which only the router's
+heartbeat detector can distinguish from slow progress.  Worker kill is
+not a message — the router SIGKILLs the process, the point being that no
 cooperation is required.
 """
 
@@ -39,7 +66,7 @@ import queue
 import time
 
 
-def build_engine(model_spec: dict, engine_spec: dict):
+def build_engine(model_spec: dict, engine_spec: dict, journal=None):
     """Construct a serve engine from plain-dict specs (everything must be
     picklable across the spawn boundary, so no arrays/params travel —
     each process re-derives identical params from the shared seed).
@@ -68,7 +95,17 @@ def build_engine(model_spec: dict, engine_spec: dict):
     if adm is not None:
         adm = AdmissionPolicy(**adm)
     cls = {"ragged": RaggedServeEngine, "legacy": ServeEngine}[kind]
-    return cls(params, cfg, admission=adm, **es)
+    return cls(params, cfg, admission=adm, journal=journal, **es)
+
+
+def _warm(eng) -> None:
+    """Compile the prefill-chunk + decode launch widths BEFORE the worker
+    reports ready: an XLA compile inside the serving loop blocks the
+    queue drain for seconds — long enough to miss heartbeat pings and be
+    falsely declared dead by a tight detector."""
+    res = eng.try_submit([1] * 20, 2)
+    if res.ok:
+        eng.run()
 
 
 def _export(obs_path: str, wid: int) -> None:
@@ -81,37 +118,136 @@ def _export(obs_path: str, wid: int) -> None:
 
 def worker_main(wid: int, model_spec: dict, engine_spec: dict,
                 obs_path: str, request_q, result_q,
-                export_every: int = 4) -> None:
+                export_every: int = 4, ckpt_spec=None) -> None:
     """Entry point for one spawned worker (cluster.py passes this to
-    multiprocessing.Process)."""
+    multiprocessing.Process).  `ckpt_spec` (None disables checkpointing):
+    {"journal": path, "snapshot": path, "every": N completions between
+    snapshots, "resume": accept resume_toks prefixes, "restore": rebuild
+    from the predecessor's snapshot+journal before going ready}."""
     # must land before the jax import inside build_engine: the cluster is
     # a CPU-mesh harness even on a TPU host
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     try:
+        ck = dict(ckpt_spec) if ckpt_spec else None
+        journal = None
+        rid_map = {}                  # engine rid -> router rid
+        resume_prefix = {}            # engine rid -> resumed token prefix
+        # warm before any journal/recovery state attaches: the warm
+        # request must never land in the journal or a snapshot
         eng = build_engine(model_spec, engine_spec)
+        _warm(eng)
+        if ck is not None:
+            from ..serving import checkpoint as ckpt
+
+            if ck.get("restore"):
+                # replacement life: recover the predecessor's engine, then
+                # start journaling fresh (rewrite_journal) so a second
+                # failure recovers from THIS life's journal alone
+                info = ckpt.recover_engine(eng, ck.get("snapshot"),
+                                           ck.get("journal"))
+                rid_map = dict(info.rid_map)
+                resume_prefix = {r: list(p)
+                                 for r, p in info.resume_prefix.items()}
+                journal = ckpt.rewrite_journal(eng, ck["journal"], rid_map,
+                                               resume_prefix)
+                eng.journal = journal
+                live = [r for r in eng.slots if r is not None] \
+                    + list(eng._queue)
+                claimed = sorted(
+                    {rid_map.get(r.rid, r.rid) for r in live}
+                    | set(info.done))
+                result_q.put(("restored", wid, {
+                    "claimed": claimed,
+                    "replayed": {int(k): int(v)
+                                 for k, v in info.replayed.items()},
+                    "resumed": {int(k): int(v)
+                                for k, v in info.resumed.items()},
+                    "from_snapshot": info.from_snapshot,
+                }))
+                # requests the journal proves complete need no engine time
+                for ext, toks in sorted(info.done.items()):
+                    result_q.put(("done", wid, int(ext),
+                                  [int(t) for t in toks]))
+            else:
+                journal = ckpt.TokenJournal(ck["journal"], truncate=True)
+                eng.journal = journal
         _export(obs_path, wid)  # baseline: even an early kill leaves a file
         result_q.put(("ready", wid, os.getpid()))
-        rid_map = {}                  # engine rid -> router rid
         hogged = []                   # pages held by the "hog" fault
         stall_until = 0.0
+        hang = False
         stopping = False
         n_since_export = 0
+        n_since_ckpt = 0
         while True:
+            if hang:
+                # wedged, not dead: the process is alive (liveness polls
+                # pass) but drains nothing and answers no pings — only the
+                # heartbeat detector can declare this worker gone
+                time.sleep(0.05)
+                continue
             try:
                 while True:
                     msg = request_q.get_nowait()
                     op = msg[0]
                     if op == "submit":
-                        _, rrid, prompt, max_new = msg
-                        res = eng.try_submit(prompt, max_new)
-                        if res.ok:
-                            rid_map[res.rid] = rrid
-                            result_q.put(("accepted", wid, rrid))
+                        rrid, prompt, max_new = msg[1], msg[2], msg[3]
+                        resume_toks = msg[4] if len(msg) > 4 else None
+                        if resume_toks and ck is not None \
+                                and ck.get("resume", True):
+                            comp = ckpt.trim_complete(
+                                resume_toks, max_new, eng.eos_id)
+                            if comp is not None:
+                                # the dead worker journaled past the finish
+                                # line — complete with zero engine time
+                                ckpt.M_RECOVERED_RESUMED.inc(len(comp))
+                                result_q.put(("accepted", wid, rrid))
+                                result_q.put(("done", wid, rrid,
+                                              [int(t) for t in comp]))
+                                continue
+                            res = eng.try_submit(
+                                list(prompt) + [int(t) for t in resume_toks],
+                                max_new - len(resume_toks))
+                            if res.ok:
+                                ckpt.M_RECOVERED_RESUMED.inc(
+                                    len(resume_toks))
+                                rid_map[res.rid] = rrid
+                                resume_prefix[res.rid] = \
+                                    [int(t) for t in resume_toks]
+                                if journal is not None:
+                                    # journal the ORIGINAL request shape so
+                                    # a second recovery composes
+                                    journal.submit(res.rid, rrid, prompt,
+                                                   max_new)
+                                    journal.tokens(res.rid, resume_toks)
+                                    journal.sync()
+                                result_q.put(("accepted", wid, rrid))
+                            else:
+                                result_q.put((
+                                    "rejected", wid, rrid,
+                                    res.reason.value if res.reason else None,
+                                    res.retryable, res.message))
                         else:
-                            result_q.put((
-                                "rejected", wid, rrid,
-                                res.reason.value if res.reason else None,
-                                res.retryable, res.message))
+                            if resume_toks and ck is not None:
+                                # resume disabled: the baseline path —
+                                # every journaled token gets re-decoded
+                                ckpt.M_RECOVERED_REPLAYED.inc(
+                                    len(resume_toks))
+                            res = eng.try_submit(prompt, max_new)
+                            if res.ok:
+                                rid_map[res.rid] = rrid
+                                if journal is not None:
+                                    journal.submit(res.rid, rrid, prompt,
+                                                   max_new)
+                                    journal.sync()
+                                result_q.put(("accepted", wid, rrid))
+                            else:
+                                result_q.put((
+                                    "rejected", wid, rrid,
+                                    res.reason.value if res.reason else None,
+                                    res.retryable, res.message))
+                    elif op == "ping":
+                        result_q.put(("pong", wid, msg[1]))
                     elif op == "fault":
                         _, fkind, arg = msg
                         if fkind == "hog":
@@ -124,6 +260,8 @@ def worker_main(wid: int, model_spec: dict, engine_spec: dict,
                                 hogged = []
                         elif fkind == "stall":
                             stall_until = time.monotonic() + float(arg)
+                        elif fkind == "hang":
+                            hang = True
                         else:
                             result_q.put(("error", wid,
                                           f"unknown fault {fkind!r}"))
@@ -138,13 +276,24 @@ def worker_main(wid: int, model_spec: dict, engine_spec: dict,
                 continue
             if eng.pending or eng.live:
                 for erid, toks in eng.step():
-                    result_q.put(("done", wid, rid_map.pop(erid),
-                                  [int(t) for t in toks]))
+                    full = resume_prefix.pop(erid, []) \
+                        + [int(t) for t in toks]
+                    result_q.put(("done", wid, rid_map.pop(erid), full))
                     n_since_export += 1
+                    n_since_ckpt += 1
+                if ck is not None and ck.get("snapshot") \
+                        and n_since_ckpt >= int(ck.get("every", 2)):
+                    ckpt.save_snapshot(
+                        eng, ck["snapshot"],
+                        extra={"rid_map": rid_map,
+                               "resume_prefix": resume_prefix})
+                    n_since_ckpt = 0
                 if n_since_export >= export_every:
                     _export(obs_path, wid)
                     n_since_export = 0
             elif stopping:
+                if journal is not None:
+                    journal.close()
                 _export(obs_path, wid)
                 result_q.put(("stopped", wid))
                 return

@@ -100,7 +100,8 @@ class ServeEngine:
                  prefix_cache: bool = False, draft_params=None,
                  draft_cfg: Optional[ModelConfig] = None, spec_k: int = 4,
                  max_queue: Optional[int] = None,
-                 admission: Optional[AdmissionPolicy] = None):
+                 admission: Optional[AdmissionPolicy] = None,
+                 journal=None):
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -110,6 +111,10 @@ class ServeEngine:
         self.admission = admission
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
+        # optional write-ahead TokenJournal (serving/checkpoint.py): token
+        # appends / done / reset records per tick, fsynced once per step()
+        # BEFORE results are returned — crash recovery resumes from here
+        self.journal = journal
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.state, self.pool = init_paged_state(
             cfg, slots=slots, n_pages=n_pages, page=page,
@@ -285,6 +290,10 @@ class ServeEngine:
         for req in reversed(inflight):
             req.tokens = []
             self._queue.insert(0, req)
+            if self.journal is not None:
+                self.journal.reset(req.rid)
+        if self.journal is not None:
+            self.journal.sync()
         _M_QUEUE.set(len(self._queue))
         _M_LIVE.set(0)
         _M_POOL.set(self._occupancy())
@@ -390,6 +399,8 @@ class ServeEngine:
             # instead of silently dropping it
             self._queue.pop(0)
             req.tokens.append(int(tok))
+            if self.journal is not None:
+                self.journal.tokens(req.rid, [int(tok)])
             self.slots[slot] = req
             self._next_tok[slot] = int(tok)
             _M_ADMITTED.inc()
@@ -419,6 +430,8 @@ class ServeEngine:
                 self.slots[slot] = None
                 self._finished[req.rid] = req.tokens
                 done.append((req.rid, req.tokens))
+                if self.journal is not None:
+                    self.journal.done(req.rid)
                 _M_RETIRED.inc(cause="eos" if hit_eos else "budget")
         return done
 
@@ -441,6 +454,16 @@ class ServeEngine:
             _M_SPEC_RATE.set(rate)
 
     def step(self) -> List[Tuple[int, List[int]]]:
+        """One engine tick (see _step).  When a journal is attached this
+        is also the durability barrier: the tick's journal appends are
+        fsynced BEFORE its results are returned, so any token a caller
+        has seen survives a crash (write-ahead)."""
+        done = self._step()
+        if self.journal is not None:
+            self.journal.sync()
+        return done
+
+    def _step(self) -> List[Tuple[int, List[int]]]:
         """One engine tick: retire -> admit -> one decode advance for every
         live slot (a single token, or a whole speculative round when a
         draft model is attached).  Returns requests that finished THIS
@@ -480,6 +503,8 @@ class ServeEngine:
                     f"slot {slot} (rid {req.rid}) logits are NaN-poisoned: "
                     "a live slot was stepped without provisioned capacity")
             req.tokens.append(int(toks[slot]))
+            if self.journal is not None:
+                self.journal.tokens(req.rid, [int(toks[slot])])
             self._next_tok[slot] = int(toks[slot])
             added += 1
         self._note_tick(time.perf_counter() - t0, added)
@@ -549,6 +574,8 @@ class ServeEngine:
             if self.eos_id is not None and self.eos_id in new:
                 new = new[: new.index(self.eos_id) + 1]
             req.tokens += new
+            if self.journal is not None:
+                self.journal.tokens(req.rid, new)
             n_kept += len(new)
             self._next_tok[slot] = new[-1]
             undo[slot] = k + 1 - len(new)  # both states appended k+1

@@ -15,19 +15,44 @@ pieces:
     scheduler policy.
   * `serving.handoff` — the million-token path: ring-sharded prefill
     whose K/V lands DIRECTLY in pool pages (no re-layout copy), feeding
-    sequence-parallel paged decode (models/dist_decode.py).
+    sequence-parallel paged decode (models/dist_decode.py);
+    `handoff_decode` is the resumable/journaled decode surface.
+  * `serving.checkpoint` — crash consistency: atomic engine snapshots,
+    the write-ahead token journal, and resume-not-replay recovery
+    (`recover_engine`) for both engines and the bare handoff state.
 
 docs/serving.md walks the batch layout, page-table format, scheduler
-policy, and the handoff diagram.
+policy, the handoff diagram, and the recovery protocol.
 """
 
 from .engine import RaggedServeEngine
 from .model import ragged_model_step
-from .handoff import ring_prefill_to_pages, handoff_generate
+from .handoff import handoff_decode, handoff_generate, ring_prefill_to_pages
+from .checkpoint import (
+    RecoveryInfo, TokenJournal, journal_tokens_by_ext, journal_view,
+    load_paged_snapshot, load_snapshot, read_journal, recover_engine,
+    restore_into, rewrite_journal, run_recovered, save_paged_snapshot,
+    save_snapshot, trim_complete,
+)
 
 __all__ = [
     "RaggedServeEngine",
-    "ragged_model_step",
-    "ring_prefill_to_pages",
+    "RecoveryInfo",
+    "TokenJournal",
+    "handoff_decode",
     "handoff_generate",
+    "journal_tokens_by_ext",
+    "journal_view",
+    "load_paged_snapshot",
+    "load_snapshot",
+    "ragged_model_step",
+    "read_journal",
+    "recover_engine",
+    "restore_into",
+    "rewrite_journal",
+    "ring_prefill_to_pages",
+    "run_recovered",
+    "save_paged_snapshot",
+    "save_snapshot",
+    "trim_complete",
 ]

@@ -1,0 +1,692 @@
+"""Crash-consistent serving: engine snapshots + the write-ahead token
+journal (ROADMAP item 5's single-host remainder).
+
+A SIGKILL'd worker used to cost every in-flight request a full
+regeneration from scratch — at million-token prompts that is an entire
+ring prefill re-burned per crash.  This module makes recovery RESUME
+instead of replay, with two durability layers that compose:
+
+  SNAPSHOT  `save_snapshot(engine, path)` serializes the engine's whole
+            serving state — page-pool contents (every layer's K/V pages
+            + scales), page tables, per-sequence request metadata
+            (prompt, budget, generated tokens, prefill cursor), the
+            admission queue, the sampler RNG key, and the host pool's
+            free-list/refcounts — into ONE atomic `.npz` (tmp + fsync +
+            rename, so a crash mid-save leaves the previous snapshot
+            intact).  `restore_into` rebuilds a fresh same-spec engine
+            bit-for-bit: its subsequent `run()` is token-exact with the
+            uninterrupted oracle because the RNG key, pool order, and
+            device state are all restored exactly.  Works for both
+            `RaggedServeEngine` and the legacy `ServeEngine`.
+
+  JOURNAL   `TokenJournal` is a write-ahead fsynced JSONL of per-tick
+            token records (the engines append under their step() sync
+            barrier: tokens are DURABLE before the result leaves the
+            process).  The reader is torn-tail tolerant with exactly
+            `obs.aggregate.load_records_tolerant`'s semantics — a kill
+            mid-append tears at most the final line, which is skipped
+            and counted; corruption anywhere else stays loud.
+
+Recovery (`recover_engine`) composes them: restore the last snapshot if
+one exists, then roll the journal forward — sequences present in the
+snapshot re-decode only the journal LAG (tokens journaled after the
+snapshot), sequences known only to the journal resume via prompt-concat
+prefill (the journaled prefix is teacher-forced as prompt, never
+re-decoded; greedy continuation of a greedy prefix is identical).  The
+split is accounted on two counters the acceptance gate reads:
+
+  serve.recovered_tokens_replayed   tokens a recovery had to RE-DECODE
+                                    (bounded by journal lag / snapshot
+                                    cadence — strictly below the
+                                    replay-from-scratch baseline)
+  serve.recovered_tokens_resumed    tokens recovered WITHOUT re-decoding
+                                    (snapshot state + journal prefixes)
+
+Journal-prefix resume requires greedy decoding (temperature 0): the
+prefix is only a valid continuation seed when the engine would have
+deterministically produced it.  Snapshot restore has no such limit —
+the RNG key is part of the snapshot, so sampled streams restore exactly.
+
+Unsupported for snapshot: engines with a draft model attached
+(speculative mirror state) or a PrefixCache / tp mesh on the legacy
+engine — `save_snapshot` raises rather than silently dropping state.
+"""
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+
+M_RECOVERED_REPLAYED = obs.counter(
+    "serve.recovered_tokens_replayed",
+    "previously generated tokens a recovery had to re-decode "
+    "(journal lag past the last snapshot)")
+M_RECOVERED_RESUMED = obs.counter(
+    "serve.recovered_tokens_resumed",
+    "previously generated tokens recovered without re-decoding "
+    "(snapshot state + journaled prefixes)")
+M_JOURNAL_RECORDS = obs.counter(
+    "serve.journal_records", "write-ahead token journal records appended")
+M_SNAPSHOT_SAVES = obs.counter(
+    "serve.checkpoint_saves", "atomic engine snapshots written")
+
+SNAPSHOT_VERSION = 1
+
+
+# -- write-ahead token journal ---------------------------------------------
+
+
+class TokenJournal:
+    """Append-only fsynced JSONL keyed by ENGINE rid.  Records:
+
+      {"record": "submit", "rid": R, "ext": E, "prompt": [...],
+       "max_new": M}                     ownership: engine rid R serves
+                                         external (router) rid E
+      {"record": "tokens", "rid": R, "toks": [...]}   tokens appended
+      {"record": "done",   "rid": R}     request finished (write-ahead:
+                                         journaled before the result is
+                                         reported anywhere)
+      {"record": "reset",  "rid": R}     drain() requeued the request —
+                                         its token prefix is void
+
+    Appends buffer in the file object; `sync()` (flush + fsync) is the
+    durability barrier — the engines call it once per step(), AFTER the
+    tick's appends and BEFORE returning results, so any token a caller
+    has seen is on disk."""
+
+    def __init__(self, path: str, *, truncate: bool = False):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "w" if truncate else "a", encoding="utf-8")
+        self._dirty = False
+
+    def _append(self, rec: dict) -> None:
+        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._dirty = True
+        M_JOURNAL_RECORDS.inc()
+
+    def submit(self, rid: int, ext: int, prompt, max_new: int) -> None:
+        self._append({"record": "submit", "rid": int(rid), "ext": int(ext),
+                      "prompt": [int(x) for x in prompt],
+                      "max_new": int(max_new)})
+
+    def tokens(self, rid: int, toks) -> None:
+        toks = [int(t) for t in toks]
+        if toks:
+            self._append({"record": "tokens", "rid": int(rid), "toks": toks})
+
+    def done(self, rid: int) -> None:
+        self._append({"record": "done", "rid": int(rid)})
+
+    def reset(self, rid: int) -> None:
+        self._append({"record": "reset", "rid": int(rid)})
+
+    def sync(self) -> None:
+        if self._dirty and not self._f.closed:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._dirty = False
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self.sync()
+            self._f.close()
+
+
+def read_journal(path: str) -> Tuple[List[dict], int]:
+    """(records, n_skipped) — torn-tail tolerant with
+    `obs.aggregate.load_records_tolerant`'s exact semantics: a SIGKILL
+    lands mid-append at most once, at the END of the file, so a bad
+    FINAL line (with valid records before it) is skipped and counted;
+    a bad line anywhere ELSE is real corruption and raises."""
+    records: List[dict] = []
+    n_skipped = 0
+    with open(path, "r", encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    last = len(lines) - 1
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict) or "record" not in rec:
+                raise ValueError("not a journal record")
+        except ValueError:
+            if i == last and records:
+                n_skipped += 1
+                continue
+            raise ValueError(
+                f"corrupt journal line {i + 1} in {path!r}: {line[:120]!r}")
+        records.append(rec)
+    return records, n_skipped
+
+
+@dataclass
+class JournalView:
+    """The journal folded into per-request state (resets applied)."""
+
+    submits: Dict[int, dict] = field(default_factory=dict)   # rid -> record
+    tokens: Dict[int, List[int]] = field(default_factory=dict)
+    done: set = field(default_factory=set)
+    n_skipped: int = 0
+
+
+def journal_view(path: Optional[str]) -> JournalView:
+    """Fold a journal file; a missing path is an empty view (a worker
+    killed before its first sync left nothing — recovery starts from
+    the prompt)."""
+    view = JournalView()
+    if not path or not os.path.exists(path):
+        return view
+    records, view.n_skipped = read_journal(path)
+    for rec in records:
+        rid = int(rec["rid"])
+        kind = rec["record"]
+        if kind == "submit":
+            view.submits[rid] = rec
+            view.tokens.setdefault(rid, [])
+        elif kind == "tokens":
+            view.tokens.setdefault(rid, []).extend(
+                int(t) for t in rec["toks"])
+        elif kind == "done":
+            view.done.add(rid)
+        elif kind == "reset":
+            view.tokens[rid] = []
+    return view
+
+
+def journal_tokens_by_ext(path: Optional[str]) -> Dict[int, List[int]]:
+    """external rid -> journaled tokens, for every request the journal
+    knows (the router's reroute map: a dead worker's journal tells the
+    replacement route how far each sequence already got)."""
+    view = journal_view(path)
+    out: Dict[int, List[int]] = {}
+    for rid, sub in view.submits.items():
+        out[int(sub["ext"])] = list(view.tokens.get(rid, []))
+    return out
+
+
+def trim_complete(toks: List[int], max_new: int,
+                  eos_id: Optional[int]) -> Optional[List[int]]:
+    """If a journaled prefix already satisfies the request (budget hit or
+    EOS emitted), return the trimmed final stream; else None.  Matches
+    the engines' retirement rule (first EOS wins, then the budget)."""
+    toks = [int(t) for t in toks]
+    if eos_id is not None and eos_id in toks:
+        return toks[: toks.index(eos_id) + 1]
+    if len(toks) >= max_new:
+        return toks[:max_new]
+    return None
+
+
+# -- atomic npz snapshot ----------------------------------------------------
+
+
+def _atomic_savez(path: str, meta: dict,
+                  arrays: Dict[str, np.ndarray]) -> None:
+    """Write meta (JSON, as a uint8 entry) + arrays as ONE npz, atomically:
+    tmp file, fsync, rename — a crash mid-save never clobbers the
+    previous snapshot."""
+    payload = dict(arrays)
+    payload["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_snapshot(path: str) -> dict:
+    """{"meta": dict, "arrays": {name: np.ndarray}} from one snapshot."""
+    with np.load(path) as z:
+        arrays = {k: np.asarray(z[k]) for k in z.files if k != "__meta__"}
+        meta = json.loads(z["__meta__"].tobytes().decode("utf-8"))
+    if meta.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(f"snapshot {path!r} has version "
+                         f"{meta.get('version')!r}, this build reads "
+                         f"{SNAPSHOT_VERSION}")
+    return {"meta": meta, "arrays": arrays}
+
+
+def _paged_arrays(state) -> Dict[str, np.ndarray]:
+    """PagedState -> host arrays (np.asarray gathers sharded pools)."""
+    arrays: Dict[str, np.ndarray] = {
+        "page_table": np.asarray(state.page_table),
+        "lengths": np.asarray(state.lengths),
+    }
+    quant = state.k_scales is not None
+    for li in range(len(state.k_pages)):
+        arrays[f"k_pages_{li}"] = np.asarray(state.k_pages[li])
+        arrays[f"v_pages_{li}"] = np.asarray(state.v_pages[li])
+        if quant:
+            arrays[f"k_scales_{li}"] = np.asarray(state.k_scales[li])
+            arrays[f"v_scales_{li}"] = np.asarray(state.v_scales[li])
+    return arrays
+
+
+def _paged_from_arrays(arrays: Dict[str, np.ndarray], n_layers: int):
+    import jax.numpy as jnp
+
+    from ..models.paged_decode import PagedState
+
+    quant = "k_scales_0" in arrays
+    return PagedState(
+        tuple(jnp.asarray(arrays[f"k_pages_{li}"]) for li in range(n_layers)),
+        tuple(jnp.asarray(arrays[f"v_pages_{li}"]) for li in range(n_layers)),
+        jnp.asarray(arrays["page_table"]),
+        jnp.asarray(arrays["lengths"]),
+        tuple(jnp.asarray(arrays[f"k_scales_{li}"])
+              for li in range(n_layers)) if quant else None,
+        tuple(jnp.asarray(arrays[f"v_scales_{li}"])
+              for li in range(n_layers)) if quant else None,
+    )
+
+
+def _pool_meta(pool) -> dict:
+    return {"n_pages": int(pool.n_pages),
+            "free": [int(p) for p in pool._free],
+            "refs": [int(r) for r in pool._refs]}
+
+
+def _pool_restore(pool, meta: dict) -> None:
+    if int(meta["n_pages"]) != int(pool.n_pages):
+        raise ValueError(f"snapshot pool has {meta['n_pages']} pages, "
+                         f"engine pool has {pool.n_pages}")
+    pool._free = [int(p) for p in meta["free"]]
+    pool._refs = [int(r) for r in meta["refs"]]
+
+
+def _new_pool(meta: dict):
+    from ..models.paged_decode import PagePool
+
+    pool = PagePool(int(meta["n_pages"]))
+    _pool_restore(pool, meta)
+    return pool
+
+
+# -- engine snapshot --------------------------------------------------------
+
+
+def _engine_kind(engine) -> str:
+    from ..models.serve import ServeEngine
+    from .engine import RaggedServeEngine
+
+    if isinstance(engine, RaggedServeEngine):
+        return "ragged"
+    if isinstance(engine, ServeEngine):
+        return "legacy"
+    raise TypeError(f"cannot snapshot a {type(engine).__name__}")
+
+
+def _check_snapshotable(engine, kind: str) -> None:
+    if engine.draft is not None:
+        raise ValueError("snapshot does not support engines with a draft "
+                         "model attached (speculative mirror state)")
+    if kind == "legacy":
+        if getattr(engine, "cache", None) is not None:
+            raise ValueError("snapshot does not support a PrefixCache "
+                             "(shared-page refcounts are not serialized)")
+        if getattr(engine, "mesh", None) is not None:
+            raise ValueError("snapshot does not support a tp-sharded "
+                             "legacy engine")
+
+
+def _req_to_dict(req, kind: str) -> dict:
+    d = {"rid": int(req.rid), "prompt": [int(x) for x in req.prompt],
+         "max_new": int(req.max_new_tokens),
+         "tokens": [int(t) for t in req.tokens]}
+    if kind == "ragged":
+        d["n_prefilled"] = int(req.n_prefilled)
+    return d
+
+
+def _req_from_dict(d: dict, kind: str):
+    if kind == "ragged":
+        from .engine import _Request
+
+        return _Request(int(d["rid"]), np.asarray(d["prompt"], np.int32),
+                        int(d["max_new"]),
+                        tokens=[int(t) for t in d["tokens"]],
+                        t_submit=time.perf_counter(),
+                        n_prefilled=int(d.get("n_prefilled", 0)))
+    from ..models.serve import _Request
+
+    return _Request(int(d["rid"]), np.asarray(d["prompt"], np.int32),
+                    int(d["max_new"]),
+                    tokens=[int(t) for t in d["tokens"]],
+                    t_submit=time.perf_counter())
+
+
+def _rng_meta(key) -> dict:
+    import jax
+
+    try:
+        typed = jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        typed = False
+    if typed:
+        return {"typed": True, "impl": str(jax.random.key_impl(key)),
+                "data": np.asarray(jax.random.key_data(key)).tolist()}
+    return {"typed": False, "data": np.asarray(key).tolist()}
+
+
+def _rng_restore(meta: dict):
+    import jax
+    import jax.numpy as jnp
+
+    data = jnp.asarray(np.asarray(meta["data"], np.uint32))
+    if meta.get("typed"):
+        return jax.random.wrap_key_data(data)
+    return data
+
+
+def snapshot(engine, extra: Optional[dict] = None) -> Tuple[dict, dict]:
+    """(meta, arrays) for one engine — everything restore_into needs.
+    `extra` is caller payload carried verbatim (the loadgen worker stores
+    its engine-rid -> router-rid map and resume prefixes here)."""
+    kind = _engine_kind(engine)
+    _check_snapshotable(engine, kind)
+    meta = {
+        "version": SNAPSHOT_VERSION,
+        "kind": kind,
+        "n_layers": len(engine.state.k_pages),
+        "slots_n": len(engine.slots),
+        "page": int(engine.page),
+        "pool": _pool_meta(engine.pool),
+        "slots": [None if r is None else _req_to_dict(r, kind)
+                  for r in engine.slots],
+        "queue": [_req_to_dict(r, kind) for r in engine._queue],
+        "next_tok": [int(t) for t in engine._next_tok],
+        "next_id": int(engine._next_id),
+        "finished": [[int(rid), [int(t) for t in toks]]
+                     for rid, toks in sorted(engine._finished.items())],
+        "rng": _rng_meta(engine._rng),
+        "spec": [int(engine.spec_proposed), int(engine.spec_accepted),
+                 int(engine.spec_rounds)],
+        "extra": extra or {},
+    }
+    return meta, _paged_arrays(engine.state)
+
+
+def save_snapshot(engine, path: str, extra: Optional[dict] = None) -> None:
+    """Serialize `engine` to `path` atomically (see module docstring)."""
+    meta, arrays = snapshot(engine, extra)
+    _atomic_savez(path, meta, arrays)
+    M_SNAPSHOT_SAVES.inc()
+
+
+def restore_into(engine, snap: dict) -> dict:
+    """Apply a loaded snapshot to a FRESHLY BUILT engine with identical
+    specs (same model/params, slots, pool size, page size).  Returns the
+    snapshot's `extra` payload.  The restored engine's run() is
+    token-exact with the uninterrupted original: device state, pool
+    order, request metadata, and the sampler RNG key are all exact."""
+    meta = snap["meta"]
+    kind = _engine_kind(engine)
+    _check_snapshotable(engine, kind)
+    if meta["kind"] != kind:
+        raise ValueError(f"snapshot is for a {meta['kind']!r} engine, "
+                         f"restore target is {kind!r}")
+    if meta["slots_n"] != len(engine.slots):
+        raise ValueError(f"snapshot has {meta['slots_n']} slots, engine "
+                         f"has {len(engine.slots)}")
+    if meta["page"] != int(engine.page):
+        raise ValueError(f"snapshot page size {meta['page']} != engine "
+                         f"page size {engine.page}")
+    if meta["n_layers"] != len(engine.state.k_pages):
+        raise ValueError(f"snapshot has {meta['n_layers']} layers, engine "
+                         f"model has {len(engine.state.k_pages)}")
+    want = snap["arrays"]["k_pages_0"].shape
+    have = tuple(engine.state.k_pages[0].shape)
+    if tuple(want) != have:
+        raise ValueError(f"snapshot pool geometry {tuple(want)} != engine "
+                         f"pool geometry {have}")
+    engine.state = _paged_from_arrays(snap["arrays"], meta["n_layers"])
+    _pool_restore(engine.pool, meta["pool"])
+    engine.slots = [None if d is None else _req_from_dict(d, kind)
+                    for d in meta["slots"]]
+    engine._queue = [_req_from_dict(d, kind) for d in meta["queue"]]
+    engine._next_tok = np.asarray(meta["next_tok"], np.int32)
+    engine._next_id = int(meta["next_id"])
+    engine._finished = {int(rid): [int(t) for t in toks]
+                        for rid, toks in meta["finished"]}
+    engine._rng = _rng_restore(meta["rng"])
+    engine.spec_proposed, engine.spec_accepted, engine.spec_rounds = \
+        meta["spec"]
+    return meta.get("extra", {})
+
+
+# -- paged-state-level snapshot (the handoff path has no engine) ------------
+
+
+def save_paged_snapshot(path: str, state, pool,
+                        extra: Optional[dict] = None) -> None:
+    """Snapshot a bare PagedState + PagePool (the ring->pages handoff
+    decode loop runs without an engine object).  Same atomic format."""
+    meta = {"version": SNAPSHOT_VERSION, "kind": "paged",
+            "n_layers": len(state.k_pages), "pool": _pool_meta(pool),
+            "extra": extra or {}}
+    _atomic_savez(path, meta, _paged_arrays(state))
+    M_SNAPSHOT_SAVES.inc()
+
+
+def load_paged_snapshot(path: str):
+    """(PagedState, PagePool, extra) from a save_paged_snapshot file —
+    fresh arrays/pool, nothing shared with the writer (restart semantics:
+    a replacement process rebuilds the whole serving state from disk)."""
+    snap = load_snapshot(path)
+    meta = snap["meta"]
+    if meta["kind"] != "paged":
+        raise ValueError(f"{path!r} is a {meta['kind']!r} snapshot, not a "
+                         "bare paged snapshot")
+    state = _paged_from_arrays(snap["arrays"], meta["n_layers"])
+    return state, _new_pool(meta["pool"]), meta.get("extra", {})
+
+
+# -- recovery ---------------------------------------------------------------
+
+
+@dataclass
+class RecoveryInfo:
+    """What recover_engine did, per EXTERNAL rid.  `replayed` tokens will
+    be re-decoded by the engine (journal lag past the snapshot);
+    `resumed` tokens were recovered without re-decoding; `done` requests
+    were already complete per the journal and need no engine time at
+    all.  `baseline_replay` is what a replay-from-scratch recovery would
+    have re-decoded (every journaled token of every unfinished request)
+    — the strict upper bound the acceptance test gates `replayed`
+    against."""
+
+    rid_map: Dict[int, int] = field(default_factory=dict)   # erid -> ext
+    resume_prefix: Dict[int, List[int]] = field(default_factory=dict)
+    replayed: Dict[int, int] = field(default_factory=dict)  # ext -> count
+    resumed: Dict[int, int] = field(default_factory=dict)   # ext -> count
+    done: Dict[int, List[int]] = field(default_factory=dict)
+    baseline_replay: int = 0
+    from_snapshot: bool = False
+    n_skipped: int = 0
+
+    @property
+    def total_replayed(self) -> int:
+        return sum(self.replayed.values())
+
+    @property
+    def total_resumed(self) -> int:
+        return sum(self.resumed.values())
+
+
+def _enqueue_raw(engine, prompt, max_new: int) -> int:
+    """Queue a recovered request BYPASSING admission shedding: work that
+    was already admitted before the crash must not be shed by its own
+    recovery."""
+    kind = _engine_kind(engine)
+    rid = engine._next_id
+    engine._next_id += 1
+    engine._queue.append(_req_from_dict(
+        {"rid": rid, "prompt": [int(x) for x in prompt],
+         "max_new": int(max_new), "tokens": []}, kind))
+    return rid
+
+
+def recover_engine(engine, snapshot_path: Optional[str],
+                   journal_path: Optional[str]) -> RecoveryInfo:
+    """Restore a freshly built engine from the last snapshot (if any) and
+    roll the journal forward (see module docstring).  The engine is left
+    ready to step(); attach a fresh journal with `rewrite_journal` before
+    doing so.  Journal-prefix resume teacher-forces via prompt concat,
+    which requires greedy decoding — raises for sampled engines when the
+    journal holds non-snapshot sequences."""
+    info = RecoveryInfo()
+    if snapshot_path and os.path.exists(snapshot_path):
+        extra = restore_into(engine, load_snapshot(snapshot_path))
+        info.from_snapshot = True
+        info.rid_map = {int(k): int(v)
+                        for k, v in (extra.get("rid_map") or {}).items()}
+        info.resume_prefix = {
+            int(k): [int(t) for t in v]
+            for k, v in (extra.get("resume_prefix") or {}).items()}
+    view = journal_view(journal_path)
+    info.n_skipped = view.n_skipped
+
+    def _journal_finished(rid, jt):
+        """The full journaled stream iff the journal proves `rid` done
+        (explicit done record, or complete by eos/budget against the
+        ORIGINAL submit's budget)."""
+        if rid in view.done:
+            return jt
+        sub = view.submits.get(rid)
+        if sub is not None and jt:
+            return trim_complete(jt, int(sub["max_new"]), engine.eos_id)
+        return None
+
+    owned = set()
+    # Slot residents: a journal-complete request takes its journaled
+    # stream and retires on the first step (no decode — _retire_finished
+    # runs before any launch and frees the slot's pages); the rest
+    # re-decode only the journal lag past the snapshot.
+    for req in [r for r in engine.slots if r is not None]:
+        owned.add(req.rid)
+        ext = info.rid_map.get(req.rid, req.rid)
+        pre = info.resume_prefix.get(req.rid, [])
+        jt = list(view.tokens.get(req.rid, []))
+        fin = _journal_finished(req.rid, jt)
+        if fin is not None:
+            req.tokens = [int(t) for t in fin[len(pre):]]
+            info.replayed[ext] = 0
+            info.resumed[ext] = len(fin)
+            if fin:
+                M_RECOVERED_RESUMED.inc(len(fin))
+            continue
+        have = len(pre) + len(req.tokens)
+        lag = max(0, len(jt) - have)
+        info.replayed[ext] = lag
+        info.resumed[ext] = have
+        if lag:
+            M_RECOVERED_REPLAYED.inc(lag)
+        if have:
+            M_RECOVERED_RESUMED.inc(have)
+    # Queued residents: journal-complete ones must LEAVE the queue
+    # (admission would prefill and append one token past the finished
+    # stream) — they surface straight through info.done.
+    for req in list(engine._queue):
+        owned.add(req.rid)
+        ext = info.rid_map.get(req.rid, req.rid)
+        pre = info.resume_prefix.get(req.rid, [])
+        jt = list(view.tokens.get(req.rid, []))
+        fin = _journal_finished(req.rid, jt)
+        if fin is not None:
+            engine._queue.remove(req)
+            info.done[ext] = [int(t) for t in fin]
+            info.replayed[ext] = 0
+            info.resumed[ext] = len(fin)
+            if fin:
+                M_RECOVERED_RESUMED.inc(len(fin))
+            continue
+        have = len(pre) + len(req.tokens)
+        lag = max(0, len(jt) - have)
+        info.replayed[ext] = lag
+        info.resumed[ext] = have
+        if lag:
+            M_RECOVERED_REPLAYED.inc(lag)
+        if have:
+            M_RECOVERED_RESUMED.inc(have)
+
+    for rid, sub in sorted(view.submits.items()):
+        if rid in owned:
+            continue
+        ext = int(sub["ext"])
+        toks = list(view.tokens.get(rid, []))
+        if rid in view.done:
+            info.done[ext] = toks
+            continue
+        complete = trim_complete(toks, int(sub["max_new"]), engine.eos_id)
+        if complete is not None:
+            # journaled past the finish line but never marked done (the
+            # kill landed between the append and the done record)
+            info.done[ext] = complete
+            info.resumed[ext] = len(complete)
+            M_RECOVERED_RESUMED.inc(len(complete))
+            continue
+        if toks and engine.temperature != 0.0:
+            raise ValueError(
+                "journal-prefix resume requires greedy decoding "
+                "(temperature 0); snapshot-only recovery supports "
+                "sampled engines")
+        new_rid = _enqueue_raw(engine, list(sub["prompt"]) + toks,
+                               int(sub["max_new"]) - len(toks))
+        info.rid_map[new_rid] = ext
+        if toks:
+            info.resume_prefix[new_rid] = toks
+            M_RECOVERED_RESUMED.inc(len(toks))
+        info.resumed[ext] = len(toks)
+        info.replayed[ext] = 0
+
+    info.baseline_replay = sum(
+        len(view.tokens.get(rid, []))
+        for rid in view.submits if rid not in view.done)
+    return info
+
+
+def rewrite_journal(engine, path: str, rid_map: Dict[int, int],
+                    resume_prefix: Dict[int, List[int]]) -> TokenJournal:
+    """Start a FRESH journal consistent with a just-recovered engine:
+    one submit + one tokens record per in-flight/queued request, so a
+    second failure recovers from this worker's journal alone (no
+    duplicate records from the previous life)."""
+    journal = TokenJournal(path, truncate=True)
+    reqs = [r for r in engine.slots if r is not None] + list(engine._queue)
+    for req in sorted(reqs, key=lambda r: r.rid):
+        pre = resume_prefix.get(req.rid, [])
+        # the engine-side prompt of a resumed request is orig_prompt +
+        # prefix; the journal records the ORIGINAL request shape
+        prompt = [int(x) for x in req.prompt]
+        if pre:
+            prompt = prompt[:len(prompt) - len(pre)]
+        journal.submit(req.rid, rid_map.get(req.rid, req.rid), prompt,
+                       req.max_new_tokens + len(pre))
+        journal.tokens(req.rid, list(pre) + [int(t) for t in req.tokens])
+    journal.sync()
+    return journal
+
+
+def run_recovered(engine, info: RecoveryInfo,
+                  max_steps: int = 100_000) -> Dict[int, List[int]]:
+    """Drive a recovered engine to completion and return the EXTERNAL
+    view: ext rid -> full token stream (journal-resumed prefixes
+    prepended, journal-complete requests included without engine time).
+    This is the single-process recovery harness the checkpoint fuzz and
+    the handoff fault tests assert token-exactness on."""
+    out = dict(info.done)
+    for erid, toks in engine.run(max_steps).items():
+        ext = info.rid_map.get(erid, erid)
+        out[ext] = info.resume_prefix.get(erid, []) + [int(t) for t in toks]
+    return out

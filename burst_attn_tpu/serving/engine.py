@@ -135,7 +135,8 @@ class RaggedServeEngine:
                  chunk: Optional[int] = None, max_queue: Optional[int] = None,
                  admission: Optional[AdmissionPolicy] = None,
                  draft_params=None, draft_cfg: Optional[ModelConfig] = None,
-                 spec_k: int = 4, use_ragged: Optional[bool] = None):
+                 spec_k: int = 4, use_ragged: Optional[bool] = None,
+                 journal=None):
         self.params = params
         self.cfg = cfg
         self.eos_id = eos_id
@@ -147,6 +148,10 @@ class RaggedServeEngine:
         self.admission = admission
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
+        # optional write-ahead TokenJournal (serving/checkpoint.py): token
+        # appends / done / reset records per tick, fsynced once per step()
+        # BEFORE results are returned — crash recovery resumes from here
+        self.journal = journal
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.state, self.pool = init_paged_state(
             cfg, slots=slots, n_pages=n_pages, page=page,
@@ -300,6 +305,10 @@ class RaggedServeEngine:
             req.tokens = []
             req.n_prefilled = 0
             self._queue.insert(0, req)
+            if self.journal is not None:
+                self.journal.reset(req.rid)
+        if self.journal is not None:
+            self.journal.sync()
         _M_QUEUE.set(len(self._queue))
         _M_LIVE.set(0)
         _M_POOL.set(self._occupancy())
@@ -395,6 +404,8 @@ class RaggedServeEngine:
                 self.slots[slot] = None
                 self._finished[req.rid] = req.tokens
                 done.append((req.rid, req.tokens))
+                if self.journal is not None:
+                    self.journal.done(req.rid)
                 _M_RETIRED.inc(cause="eos" if hit_eos else "budget")
         if done:
             # retirement frees pages AFTER the tick's _note_tick ran; keep
@@ -420,6 +431,16 @@ class RaggedServeEngine:
             _M_SPEC_RATE.set(rate)
 
     def step(self) -> List[Tuple[int, List[int]]]:
+        """One engine tick (see _step).  When a journal is attached this
+        is also the durability barrier: the tick's journal appends are
+        fsynced BEFORE its results are returned, so any token a caller
+        has seen survives a crash (write-ahead)."""
+        done = self._step()
+        if self.journal is not None:
+            self.journal.sync()
+        return done
+
+    def _step(self) -> List[Tuple[int, List[int]]]:
         """One engine tick: retire -> admit -> ONE ragged launch moving
         every active slot (prefill chunks + decode singles together, or a
         whole speculative round when a draft is attached and nothing is
@@ -485,12 +506,16 @@ class RaggedServeEngine:
                     # the first-token distribution (TTFT lands here)
                     tok = int(choice[slot])
                     req.tokens.append(tok)
+                    if self.journal is not None:
+                        self.journal.tokens(req.rid, [tok])
                     self._next_tok[slot] = tok
                     added += 1
                     _M_TTFT.observe(time.perf_counter() - req.t_submit)
             else:
                 tok = int(choice[slot])
                 req.tokens.append(tok)
+                if self.journal is not None:
+                    self.journal.tokens(req.rid, [tok])
                 # draft cache catch-up: it must absorb the token the target
                 # just consumed (the PREVIOUS next_tok) to stay aligned
                 dtoks[slot] = toks[slot, 0]
@@ -561,6 +586,8 @@ class RaggedServeEngine:
             if self.eos_id is not None and self.eos_id in new:
                 new = new[: new.index(self.eos_id) + 1]
             req.tokens += new
+            if self.journal is not None:
+                self.journal.tokens(req.rid, new)
             n_kept += len(new)
             _M_RB_DECODE.inc(len(new))
             self._next_tok[slot] = new[-1]

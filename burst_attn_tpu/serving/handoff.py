@@ -184,3 +184,43 @@ def handoff_generate(params, prompt, state: PagedState, pool: PagePool,
                 f"handoff decode step {i} logits are NaN-poisoned")
         out.append(tok)
     return out, state
+
+
+def handoff_decode(params, state: PagedState, cfg: ModelConfig, mesh, *,
+                   slot: int, last_token: int, n_steps: int, journal=None,
+                   rid: int = 0):
+    """Resumable greedy decode on an already-provisioned handoff slot:
+    `n_steps` sequence-parallel paged steps continuing from `last_token`
+    (the newest token already in the stream — prefill-sampled or
+    journal-recovered).  Returns ([n_steps] tokens, final state).
+
+    This is the crash-consistency surface for the million-token path:
+    handoff_generate fused prefill+decode in one call, so a fault left
+    nothing to resume FROM.  Here the caller owns the split — after
+    `ring_prefill_to_pages` + `provision_capacity` (or after
+    `load_paged_snapshot` rebuilt the state from a checkpoint), decode
+    proceeds in restartable strides, and each emitted token can be
+    journaled write-ahead (`journal.tokens(rid, [tok])` + sync per step)
+    so a killed decode resumes from its last durable token instead of
+    re-burning the ring prefill.  Greedy only (argmax == sample_logits
+    at temperature 0): a resumed stream must be the continuation the
+    dead decode would have produced."""
+    slots = state.lengths.shape[0]
+    feed = np.zeros((slots,), np.int32)
+    cur = int(last_token)
+    out = []
+    for i in range(n_steps):
+        feed[slot] = cur
+        logits, state = dist_paged_decode_step(
+            params, jnp.asarray(feed), state, cfg, mesh)
+        row = np.asarray(logits[slot])
+        if np.isnan(row).any():
+            raise RuntimeError(
+                f"handoff decode step {i} logits are NaN-poisoned: slot "
+                f"{slot} stepped without provisioned capacity")
+        cur = int(row.argmax())
+        out.append(cur)
+        if journal is not None:
+            journal.tokens(rid, [cur])
+            journal.sync()
+    return out, state

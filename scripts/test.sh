@@ -69,16 +69,25 @@ elif [[ $serve == 1 ]]; then
 elif [[ $loadgen == 1 ]]; then
   # production-serve hardening lane: trace/driver/SLO unit tests, the FULL
   # multi-process fault matrix (kill mid-decode, forced pool exhaustion,
-  # stall, legacy engine — slow-marked tests included here on purpose), and
-  # the admission/drain/typed-rejection engine tests
-  python -m pytest tests/test_loadgen.py tests/test_loadgen_cluster.py -q \
+  # stall, hang, restart-from-checkpoint, legacy engine — slow-marked tests
+  # included here on purpose), the checkpoint/journal recovery tests, the
+  # handoff-path fault matrix, and the admission/drain/typed-rejection
+  # engine tests
+  python -m pytest tests/test_loadgen.py tests/test_loadgen_cluster.py \
+    tests/test_checkpoint_serve.py tests/test_handoff_faults.py -q \
     ${filtered[@]+"${filtered[@]}"}
   python -m pytest tests/test_serving.py -q \
     -k "drain or typed_rejections or admission" \
     ${filtered[@]+"${filtered[@]}"}
+  # checkpoint-recovery fuzz: seeded random kill points through the
+  # snapshot+journal AND journal-only recovery paths — token-exact vs the
+  # uninterrupted oracle every time, recomputation bounded by journal lag
+  python scripts/fuzz_checkpoint.py --seeds 3
   # bench + REAL perf gate (not dry-run): replay the canonical trace, emit
-  # serve.load_p99_ttft (lower) + serve.load_goodput (higher) headlines,
-  # then gate them against BENCH history with a machine-readable verdict.
+  # serve.load_p99_ttft (lower) + serve.load_goodput (higher) +
+  # serve.load_recovery_p99 (lower; kill-mid-trace cluster recovery)
+  # headlines, then gate them against BENCH history with a machine-readable
+  # verdict.
   # --strict-cache: this lane must run the bench fresh, never a stale replay.
   python scripts/bench_loadgen.py
   python scripts/check_regression.py \

@@ -102,6 +102,19 @@ _SLOW = {
     ("test_loadgen_cluster.py", "test_cluster_legacy_engine_kill_token_exact"),
     ("test_loadgen_cluster.py",
      "test_cluster_forced_pool_exhaustion_bounded_recovery"),
+    ("test_loadgen_cluster.py",
+     "test_cluster_restart_fault_resumes_from_checkpoint"),
+    ("test_loadgen_cluster.py",
+     "test_cluster_resume_replays_strictly_less_than_scratch"),
+    ("test_loadgen_cluster.py", "test_cluster_heartbeat_detects_hang"),
+    ("test_handoff_faults.py",
+     "test_handoff_kill_journal_only_recovery_token_exact"),
+    ("test_handoff_faults.py",
+     "test_handoff_restart_paged_snapshot_roundtrip_token_exact"),
+    ("test_handoff_faults.py",
+     "test_handoff_hog_exhaustion_then_recovers_token_exact"),
+    ("test_handoff_faults.py",
+     "test_handoff_stall_restartable_strides_token_exact"),
     ("test_serving.py", "test_engine_speculative_policy_token_exact"),
     ("test_serving.py", "test_legacy_engine_load_shed_split"),
     ("test_serving.py", "test_engine_exhaustion_admission_waits_then_proceeds"),

@@ -38,7 +38,8 @@ def _rules_of(findings):
 
 
 def test_at_least_8_rules_registered():
-    from burst_attn_tpu.analysis import astlint, numerics, ringcheck  # noqa: F401
+    from burst_attn_tpu.analysis import astlint, numerics, obscheck, \
+        ringcheck  # noqa: F401
 
     assert len(RULES) >= 8
     for expected in ("silent-except", "mesh-shape-index",
@@ -47,7 +48,7 @@ def test_at_least_8_rules_registered():
                      "ring-order", "dq-return-home", "window-truncation",
                      "fp32-accum", "lse-fp32",
                      "fused-ring-schedule", "fused-ring-fused",
-                     "obs-jit-safe"):
+                     "obs-jit-safe", "ckpt-jit-safe"):
         assert expected in RULES, expected
 
 
@@ -508,6 +509,60 @@ def test_devstats_off_identity_divergence_fires():
     # by 0x... heap addresses must compare equal
     assert (obscheck._canon_jaxpr("f at 0x7f00aa") ==
             obscheck._canon_jaxpr("f at 0x7f11bb"))
+
+
+# ---------------------------------------------------------------------------
+# ckpt-jit-safe mutations (jaxpr)
+
+
+def _tiny_serve_trace(hook=None):
+    """Trace a ragged serve step, optionally smuggling a 'journal write'
+    callback INTO the compiled program (the defect ckpt-jit-safe exists to
+    catch: durability hooks belong in the engine's host loop)."""
+    from burst_attn_tpu.models.paged_decode import init_paged_state
+    from burst_attn_tpu.models.transformer import ModelConfig, init_params
+    from burst_attn_tpu.serving.model import ragged_model_step
+
+    cfg = ModelConfig(vocab=31, d_model=16, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_head=8, d_ff=32, attn_backend="jnp",
+                      remat=False, dtype=jnp.float32, batch_axis=None,
+                      head_axis=None)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    state, _ = init_paged_state(cfg, slots=2, n_pages=4, page=128,
+                                max_pages_per_seq=2)
+
+    def step(p, t, ql, st):
+        logits, st = ragged_model_step(p, t, ql, st, cfg, attn="dense")
+        if hook is not None:
+            hook(logits)
+        return logits, st
+
+    return jax.make_jaxpr(step)(params, jnp.zeros((2, 8), jnp.int32),
+                                jnp.ones((2,), jnp.int32), state)
+
+
+def test_ckpt_journal_callback_in_step_fires():
+    """A journal append spelled as jax.debug.callback inside the serve
+    step is exactly the smuggled durability hook ckpt-jit-safe bans."""
+    from burst_attn_tpu.analysis import obscheck
+
+    jx = _tiny_serve_trace(
+        hook=lambda logits: jax.debug.callback(lambda v: None, logits))
+    findings = obscheck.check_trace(jx, where="seeded serve step",
+                                    anchor=ANCHOR,
+                                    rule_name="ckpt-jit-safe")
+    assert _rules_of(findings) == {"ckpt-jit-safe"}
+    assert findings[0].file == "seeded.py" and findings[0].line == 7
+
+
+def test_ckpt_real_serve_step_is_quiet():
+    """The real serve step (journal hooks live in the host loop) traces
+    callback-free."""
+    from burst_attn_tpu.analysis import obscheck
+
+    jx = _tiny_serve_trace()
+    assert obscheck.check_trace(jx, where="serve step", anchor=ANCHOR,
+                                rule_name="ckpt-jit-safe") == []
 
 
 def test_cli_exits_zero_on_repo():

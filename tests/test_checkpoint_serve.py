@@ -1,0 +1,214 @@
+"""Crash-consistent serving (serving/checkpoint.py): snapshot/restore
+round-trips are token-exact, the write-ahead journal tolerates a torn
+tail, and resume-not-replay recovery re-decodes strictly less than a
+replay-from-scratch baseline.
+
+Fast canaries run in the tier-1 fast lane (tiny 1-layer engine, jit
+cache shared across the module); the legacy-engine round-trip and the
+multi-process matrix live in the --loadgen lane (test_loadgen_cluster
++ scripts/fuzz_checkpoint.py)."""
+
+import os
+
+import pytest
+
+from burst_attn_tpu.loadgen.worker import build_engine
+from burst_attn_tpu.serving import checkpoint as ckpt
+
+MODEL_SPEC = dict(vocab=97, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                  d_head=16, d_ff=64, seed=0)
+ENGINE_SPEC = dict(slots=2, n_pages=6, page=128, max_pages_per_seq=2,
+                   chunk=8)
+PROMPTS = [[1, 2, 3], [4, 5, 6, 7], [8, 9]]
+MAX_NEW = 8
+
+
+def _engine(journal=None, **over):
+    return build_engine(MODEL_SPEC, dict(ENGINE_SPEC, **over),
+                        journal=journal)
+
+
+def _submit_all(eng, journal=None):
+    rids = []
+    for i, p in enumerate(PROMPTS):
+        res = eng.try_submit(p, MAX_NEW)
+        assert res.ok, res
+        rids.append(res.rid)
+        if journal is not None:
+            journal.submit(res.rid, i + 100, p, MAX_NEW)
+    if journal is not None:
+        journal.sync()
+    return rids
+
+
+def test_snapshot_restore_roundtrip_token_exact(tmp_path):
+    """Mid-flight snapshot -> fresh engine -> bit-identical remaining
+    streams: page-pool contents, page tables, per-request metadata and
+    scheduler queue all survive the disk round-trip."""
+    path = str(tmp_path / "snap.npz")
+    eng = _engine()
+    _submit_all(eng)
+    for _ in range(3):
+        eng.step()
+    ckpt.save_snapshot(eng, path, extra={"tag": "roundtrip"})
+    free_at_snap = list(eng.pool._free)
+    expect = eng.run()
+
+    eng2 = _engine()
+    extra = ckpt.restore_into(eng2, ckpt.load_snapshot(path))
+    assert extra["tag"] == "roundtrip"
+    assert eng2.pool._free == free_at_snap  # allocator state round-trips
+    assert eng2.run() == expect
+
+
+def test_sampled_engine_rng_state_restores_stream(tmp_path):
+    """Sampler/RNG state is part of the snapshot: a temperature>0 engine
+    restored mid-run continues the SAME sampled stream."""
+    path = str(tmp_path / "snap.npz")
+    eng = _engine(temperature=0.8, top_k=8)
+    _submit_all(eng)
+    for _ in range(3):
+        eng.step()
+    ckpt.save_snapshot(eng, path)
+    expect = eng.run()
+
+    eng2 = _engine(temperature=0.8, top_k=8)
+    ckpt.restore_into(eng2, ckpt.load_snapshot(path))
+    assert eng2.run() == expect
+
+
+def test_journal_crash_recovery_resumes_not_replays(tmp_path):
+    """The tentpole acceptance property, single-process: crash with a
+    step-4 snapshot + step-6 journal, recover, finish — token-exact vs
+    the uninterrupted oracle AND recovered_tokens_replayed strictly
+    below the replay-from-scratch baseline."""
+    snap = str(tmp_path / "snap.npz")
+    jour = str(tmp_path / "journal.jsonl")
+    jour2 = str(tmp_path / "journal2.jsonl")
+    eng = _engine()
+    _submit_all(eng)
+    oracle = {i + 100: t for i, t in eng.run().items()}
+
+    journal = ckpt.TokenJournal(jour, truncate=True)
+    eng = _engine(journal=journal)
+    _submit_all(eng, journal=journal)
+    delivered = {}
+    for step in range(6):
+        for rid, toks in eng.step():
+            delivered[rid + 100] = toks
+        if step == 3:
+            ckpt.save_snapshot(
+                eng, snap,
+                extra={"rid_map": {i: i + 100 for i in range(3)},
+                       "resume_prefix": {}})
+    del eng, journal                        # the "SIGKILL"
+
+    eng = _engine()
+    info = ckpt.recover_engine(eng, snap, jour)
+    assert info.from_snapshot
+    eng.journal = ckpt.rewrite_journal(eng, jour2, info.rid_map,
+                                       info.resume_prefix)
+    out = dict(delivered)
+    out.update(ckpt.run_recovered(eng, info))
+    assert out == oracle
+    assert info.total_replayed < info.baseline_replay
+
+    # journal-only recovery (no snapshot survived) is also token-exact
+    eng = _engine()
+    info = ckpt.recover_engine(eng, None, jour)
+    assert not info.from_snapshot
+    out = dict(delivered)
+    out.update(ckpt.run_recovered(eng, info))
+    assert out == oracle
+
+
+def test_journal_torn_tail_tolerated_bad_middle_loud(tmp_path):
+    """Same contract as obs.aggregate: a torn FINAL line (the crash
+    landed mid-append) is skipped and counted; a bad line anywhere else
+    is corruption and stays loud."""
+    path = str(tmp_path / "j.jsonl")
+    j = ckpt.TokenJournal(path, truncate=True)
+    j.submit(0, 100, [1, 2], 4)
+    j.tokens(0, [5, 6])
+    j.sync()
+    j.close()
+    with open(path, "ab") as f:
+        f.write(b'{"kind": "tokens", "rid": 0, "toks": [7')
+    recs, n_skipped = ckpt.read_journal(path)
+    assert n_skipped == 1 and len(recs) == 2
+    view = ckpt.journal_view(path)
+    assert view.n_skipped == 1 and view.tokens[0] == [5, 6]
+
+    with open(path, "r+b") as f:
+        f.seek(0)
+        f.write(b"garbage")                 # corrupt the FIRST line
+    with pytest.raises(ValueError):
+        ckpt.read_journal(path)
+
+
+def test_trim_complete():
+    assert ckpt.trim_complete([3, 4, 9, 5], 8, 9) == [3, 4, 9]  # eos
+    assert ckpt.trim_complete([3, 4, 5], 3, 9) == [3, 4, 5]     # budget
+    assert ckpt.trim_complete([3, 4], 3, 9) is None             # mid-flight
+    assert ckpt.trim_complete([3, 4], 3, None) is None
+
+
+def test_sampled_journal_prefix_resume_rejected(tmp_path):
+    """Journal-prefix resume teacher-forces via prompt concat — only
+    sound for greedy decoding; a sampled engine must refuse loudly."""
+    path = str(tmp_path / "j.jsonl")
+    j = ckpt.TokenJournal(path, truncate=True)
+    j.submit(0, 100, [1, 2, 3], 6)
+    j.tokens(0, [5, 6])
+    j.sync()
+    j.close()
+    eng = _engine(temperature=0.8)
+    with pytest.raises(ValueError, match="greedy"):
+        ckpt.recover_engine(eng, None, path)
+
+
+def test_snapshot_kind_and_version_mismatch_raise(tmp_path):
+    path = str(tmp_path / "snap.npz")
+    eng = _engine()
+    _submit_all(eng)
+    eng.step()
+    ckpt.save_snapshot(eng, path)
+
+    legacy_spec = dict(ENGINE_SPEC, kind="legacy")
+    legacy_spec.pop("chunk")
+    leg = build_engine(MODEL_SPEC, legacy_spec)
+    with pytest.raises(ValueError, match="kind|ragged|legacy"):
+        ckpt.restore_into(leg, ckpt.load_snapshot(path))
+
+    bad = str(tmp_path / "bad.npz")
+    ckpt._atomic_savez(bad, {"version": 99, "kind": "ragged"}, {})
+    with pytest.raises(ValueError, match="version"):
+        ckpt.load_snapshot(bad)
+
+
+def test_atomic_save_leaves_no_tmp(tmp_path):
+    path = str(tmp_path / "snap.npz")
+    eng = _engine()
+    _submit_all(eng)
+    eng.step()
+    ckpt.save_snapshot(eng, path)
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_legacy_engine_snapshot_roundtrip_token_exact(tmp_path):
+    """models/serve.py's ServeEngine shares the snapshot format: dense
+    KV slabs round-trip just like the paged pool."""
+    path = str(tmp_path / "snap.npz")
+    spec = dict(ENGINE_SPEC, kind="legacy")
+    spec.pop("chunk")
+    eng = build_engine(MODEL_SPEC, spec)
+    _submit_all(eng)
+    for _ in range(3):
+        eng.step()
+    ckpt.save_snapshot(eng, path)
+    expect = eng.run()
+
+    eng2 = build_engine(MODEL_SPEC, spec)
+    ckpt.restore_into(eng2, ckpt.load_snapshot(path))
+    assert eng2.run() == expect

@@ -1,0 +1,154 @@
+"""Fault matrix on the ring->pages handoff path (serving/handoff.py +
+models/dist_decode.py): kill (journal-only recovery), restart (bare
+paged snapshot round-trip), pool-hog, and stall — each recovery must
+continue the stream token-exact vs generate().
+
+The single-host engines' crash consistency is covered by
+tests/test_checkpoint_serve.py; this file proves the same guarantees on
+the million-token path, where decode runs as restartable
+`handoff_decode` strides over a sequence-parallel pool instead of
+inside an engine loop."""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from burst_attn_tpu.models import ModelConfig, init_params, generate
+from burst_attn_tpu.models.paged_decode import (
+    init_paged_state, provision_capacity,
+)
+from burst_attn_tpu.models.train import make_mesh
+from burst_attn_tpu.serving import (
+    TokenJournal, handoff_decode, journal_tokens_by_ext,
+    load_paged_snapshot, ring_prefill_to_pages, save_paged_snapshot,
+)
+
+PAGE, S, STEPS = 128, 256, 6
+N_PAGES = 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_head=16,
+        d_ff=128, block_q=16, block_kv=16, attn_backend="jnp", remat=False,
+        dtype=jnp.float32, layout="zigzag", batch_axis=None, head_axis=None,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh({"sp": 4})
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (S,), 0, cfg.vocab)
+    return cfg, params, mesh, prompt
+
+
+@pytest.fixture(scope="module")
+def ref(setup):
+    cfg, params, _, prompt = setup
+    return list(np.asarray(generate(params, prompt[None], cfg, steps=STEPS,
+                                    max_seq=S + STEPS)[0]))
+
+
+def _prefilled(setup):
+    """Fresh pool, ring prefill into slot 0, STEPS of capacity
+    provisioned; returns (first sampled token, state, pool)."""
+    cfg, params, mesh, prompt = setup
+    state, pool = init_paged_state(cfg, slots=2, n_pages=N_PAGES, page=PAGE,
+                                   max_pages_per_seq=6)
+    last, state = ring_prefill_to_pages(params, prompt, state, pool, 0,
+                                        cfg, mesh)
+    state = provision_capacity(state, pool, 0, STEPS)
+    return int(np.argmax(np.asarray(last))), state, pool
+
+
+def test_handoff_kill_journal_only_recovery_token_exact(setup, ref,
+                                                        tmp_path):
+    """SIGKILL mid-decode with only the write-ahead journal surviving:
+    the replacement re-runs the ring prefill, re-decodes EXACTLY the
+    journal lag (bit-equal to the journaled tokens — the recomputation
+    bound), then continues the stream token-exact vs generate()."""
+    cfg, params, mesh, prompt = setup
+    jpath = str(tmp_path / "journal.jsonl")
+    journal = TokenJournal(jpath, truncate=True)
+    first, state, _pool = _prefilled(setup)
+    journal.submit(0, 0, [int(t) for t in np.asarray(prompt)], STEPS)
+    journal.tokens(0, [first])
+    journal.sync()
+    dead_out, state = handoff_decode(params, state, cfg, mesh, slot=0,
+                                     last_token=first, n_steps=2,
+                                     journal=journal, rid=0)
+    del state, journal                      # the "SIGKILL": state is gone
+    jt = journal_tokens_by_ext(jpath)[0]
+    assert jt == [first] + dead_out == ref[:3]
+
+    first2, state, _pool = _prefilled(setup)
+    assert first2 == jt[0]                  # prefill is deterministic
+    lag, state = handoff_decode(params, state, cfg, mesh, slot=0,
+                                last_token=jt[0], n_steps=len(jt) - 1)
+    assert lag == jt[1:]                    # re-decoded lag == journal
+    rest, state = handoff_decode(params, state, cfg, mesh, slot=0,
+                                 last_token=jt[-1],
+                                 n_steps=STEPS - len(jt))
+    assert jt + rest == ref[:STEPS]
+
+
+def test_handoff_restart_paged_snapshot_roundtrip_token_exact(setup, ref,
+                                                              tmp_path):
+    """The restart fault's recovery path: snapshot the bare
+    PagedState+pool mid-decode, rebuild BOTH from disk in a
+    "replacement", and continue — no re-prefill, no re-decode, stream
+    token-exact vs generate()."""
+    cfg, params, mesh, _prompt = setup
+    first, state, pool = _prefilled(setup)
+    out, state = handoff_decode(params, state, cfg, mesh, slot=0,
+                                last_token=first, n_steps=2)
+    path = str(tmp_path / "handoff.npz")
+    save_paged_snapshot(path, state, pool,
+                        extra={"stream": [first] + out})
+    avail = pool.available
+    del state, pool                         # replacement rebuilds from disk
+
+    state, pool, extra = load_paged_snapshot(path)
+    assert pool.available == avail
+    stream = [int(t) for t in extra["stream"]]
+    assert stream == ref[:3]
+    rest, state = handoff_decode(params, state, cfg, mesh, slot=0,
+                                 last_token=stream[-1],
+                                 n_steps=STEPS - len(stream))
+    assert stream + rest == ref[:STEPS]
+
+
+def test_handoff_hog_exhaustion_then_recovers_token_exact(setup, ref):
+    """Pool-hog fault: every free page grabbed before the decode budget
+    is provisioned — provisioning fails LOUDLY (never corrupts), and
+    once the pages come back the same slot decodes token-exact."""
+    cfg, params, mesh, prompt = setup
+    state, pool = init_paged_state(cfg, slots=2, n_pages=N_PAGES, page=PAGE,
+                                   max_pages_per_seq=6)
+    last, state = ring_prefill_to_pages(params, prompt, state, pool, 0,
+                                        cfg, mesh)
+    hogged = pool.acquire(pool.available)
+    with pytest.raises(RuntimeError):
+        provision_capacity(state, pool, 0, STEPS)
+    pool.release(hogged)                    # the unhog
+    state = provision_capacity(state, pool, 0, STEPS)
+    first = int(np.argmax(np.asarray(last)))
+    out, state = handoff_decode(params, state, cfg, mesh, slot=0,
+                                last_token=first, n_steps=STEPS - 1)
+    assert [first] + out == ref[:STEPS]
+
+
+def test_handoff_stall_restartable_strides_token_exact(setup, ref):
+    """Stall fault: the decode loop freezes between strides.  Because
+    handoff_decode strides are restartable (state is explicit), an
+    arbitrary pause pattern produces the identical stream."""
+    cfg, params, mesh, _prompt = setup
+    first, state, _pool = _prefilled(setup)
+    out = [first]
+    for stride in (1, 2, STEPS - 4):
+        toks, state = handoff_decode(params, state, cfg, mesh, slot=0,
+                                     last_token=out[-1], n_steps=stride)
+        out.extend(toks)
+        time.sleep(0.2)                     # the stall
+    assert out == ref[:STEPS]

@@ -9,9 +9,9 @@ import pytest
 
 from burst_attn_tpu.admission import AdmissionPolicy, RejectReason
 from burst_attn_tpu.loadgen import (
-    Objectives, Trace, assert_token_exact, compute_slo, diff_tokens,
-    evaluate, load_trace, oracle_replay, replay_trace, save_trace,
-    synthesize_trace,
+    Objectives, RetryBackoff, Trace, assert_token_exact, compute_slo,
+    diff_tokens, evaluate, load_trace, oracle_replay, recovery_stats,
+    replay_trace, save_trace, synthesize_trace,
 )
 from burst_attn_tpu.loadgen.slo import (
     quantile_from_record, quantile_from_window,
@@ -242,3 +242,78 @@ def test_cli_gen_writes_replayable_trace(tmp_path, capsys):
     assert "wrote 5 requests" in capsys.readouterr().out
     tr = load_trace(out)
     assert isinstance(tr, Trace) and len(tr.requests) == 5
+
+
+# -- retry backoff ----------------------------------------------------------
+
+
+def test_retry_backoff_deterministic_per_seed():
+    """Same (seed, rid, attempt) -> same delay, independent of call
+    order; a different seed gives a different schedule."""
+    a = RetryBackoff(seed=7)
+    b = RetryBackoff(seed=7)
+    sched_fwd = [a.delay(rid, att) for rid in range(4)
+                 for att in range(1, 5)]
+    sched_rev = [b.delay(rid, att) for rid in reversed(range(4))
+                 for att in reversed(range(1, 5))]
+    assert sched_fwd == list(reversed(sched_rev))
+    other = RetryBackoff(seed=8)
+    assert [other.delay(r, 1) for r in range(4)] != \
+        [a.delay(r, 1) for r in range(4)]
+
+
+def test_retry_backoff_exponential_growth_and_cap():
+    bo = RetryBackoff(base_s=0.1, cap_s=0.8, factor=2.0, jitter=0.0)
+    assert [bo.delay(0, a) for a in range(1, 6)] == \
+        pytest.approx([0.1, 0.2, 0.4, 0.8, 0.8])
+
+
+def test_retry_backoff_jitter_bounded_and_decorrelated():
+    bo = RetryBackoff(base_s=0.1, cap_s=10.0, factor=2.0, jitter=0.5,
+                      seed=3)
+    delays = [bo.delay(rid, 3) for rid in range(16)]
+    det = 0.4
+    for d in delays:
+        assert det * 0.5 <= d <= det * 1.5
+    # decorrelation: a shed wave of 16 rids does NOT come back in
+    # lockstep (the retry-storm failure mode of a constant backoff)
+    assert len({round(d, 9) for d in delays}) > 8
+
+
+def test_retry_backoff_validates():
+    with pytest.raises(ValueError):
+        RetryBackoff(base_s=0.0)
+    with pytest.raises(ValueError):
+        RetryBackoff(cap_s=0.01, base_s=0.05)
+    with pytest.raises(ValueError):
+        RetryBackoff(factor=0.5)
+    with pytest.raises(ValueError):
+        RetryBackoff(jitter=1.0)
+    with pytest.raises(ValueError):
+        RetryBackoff().delay(0, 0)
+
+
+# -- recovery stats ---------------------------------------------------------
+
+
+def test_recovery_stats_nearest_rank_and_empty():
+    assert recovery_stats([]) == {
+        "recovery_count": 0, "recovery_p50_s": 0.0, "recovery_p99_s": 0.0,
+        "recovery_max_s": 0.0}
+    stats = recovery_stats([3.0, 1.0, 2.0, 4.0])
+    assert stats["recovery_count"] == 4
+    assert stats["recovery_p50_s"] == 2.0     # nearest-rank ceil(0.5*4)=2nd
+    assert stats["recovery_p99_s"] == 4.0     # ceil(0.99*4)=4th
+    assert stats["recovery_max_s"] == 4.0
+    one = recovery_stats([1.5])
+    assert one["recovery_p50_s"] == one["recovery_p99_s"] == 1.5
+
+
+def test_compute_slo_carries_recovery_section():
+    report = compute_slo([], duration_s=2.0, recovery_s=[0.5, 1.5])
+    assert report["recovery_count"] == 2
+    assert report["recovery_p99_s"] == 1.5
+    from burst_attn_tpu.loadgen import format_slo
+
+    rendered = format_slo(report)
+    assert "recovery_p99_s" in rendered

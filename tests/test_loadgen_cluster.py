@@ -137,3 +137,97 @@ def test_cluster_forced_pool_exhaustion_bounded_recovery(tmp_path):
     # within the replay window
     t_dones = [o.t_done for o in report.by_status("done")]
     assert max(t_dones) >= 2.0 and max(t_dones) < 150.0
+
+
+# -- crash consistency: checkpoint / journal / restart / heartbeat ----------
+
+
+def test_cluster_restart_fault_resumes_from_checkpoint(tmp_path):
+    """The `restart` fault: SIGKILL + spawn a replacement that restores
+    from the dead worker's snapshot+journal.  The replacement reclaims
+    the in-flight work (resume, not replay) and every stream stays
+    oracle-exact.  Budgets are floored at 24 tokens so the armed kill
+    lands MID-decode — a request short enough to finish within the
+    arming tick would make the restart a no-op."""
+    trace = _trace(8, seed=7, max_new_min=24, max_new_mean=32,
+                   max_new_max=48)
+    # due immediately, but kill/restart arming holds fire until worker 0
+    # has journaled progress on in-flight work — i.e. is mid-decode
+    faults = [FaultEvent(t=0.05, kind="restart", worker=0,
+                         note="mid-decode restart")]
+    with LoadGenCluster(MODEL_SPEC, ENGINE_SPEC, n_workers=2,
+                        out_dir=str(tmp_path), checkpoint=True) as cluster:
+        report = cluster.replay(trace, faults, speed=1.0, max_wall_s=200)
+    assert len(report.kills) == 1
+    k = report.kills[0]
+    assert k["restarted"] and k["detected_by"] == "scheduled-restart"
+    assert report.n_done == len(trace.normal())
+    assert_token_exact(report.completed(), _oracle(trace))
+    # the replacement actually RESUMED: recovered work was carried over
+    # from the journal, not re-decoded from scratch
+    assert report.recovered_tokens_resumed > 0
+    for rec in report.recovery_s():
+        assert 0.0 <= rec < 200.0
+
+
+def test_cluster_restart_requires_checkpoint(tmp_path):
+    with LoadGenCluster(MODEL_SPEC, ENGINE_SPEC, n_workers=1,
+                        out_dir=str(tmp_path)) as cluster:
+        with pytest.raises(ValueError, match="checkpoint"):
+            cluster.replay(_trace(2, seed=1),
+                           [FaultEvent(t=0.1, kind="restart", worker=0)])
+
+
+def test_cluster_resume_replays_strictly_less_than_scratch(tmp_path):
+    """THE resume-not-replay acceptance gate: the same trace + kill
+    schedule run twice, journal resume ON vs OFF.  Both are token-exact;
+    the resumed run re-decodes STRICTLY fewer tokens (the kill is armed
+    on journal progress, so the baseline is never zero).  Long budgets
+    keep the victim mid-decode when the armed kill fires."""
+    trace = _trace(8, seed=11, max_new_min=24, max_new_mean=32,
+                   max_new_max=48)
+    # due immediately; arming fires it at the first journaled token
+    faults = [FaultEvent(t=0.05, kind="kill", worker=0)]
+    replayed = {}
+    for resume in (True, False):
+        out = tmp_path / ("resume" if resume else "scratch")
+        with LoadGenCluster(MODEL_SPEC, ENGINE_SPEC, n_workers=2,
+                            out_dir=str(out), checkpoint=True,
+                            resume=resume) as cluster:
+            report = cluster.replay(trace, faults, speed=1.0,
+                                    max_wall_s=200)
+            cluster.stop()
+            metrics, _spans, _meta = cluster.merged()
+        assert len(report.kills) == 1
+        assert report.n_done == len(trace.normal())
+        assert_token_exact(report.completed(), _oracle(trace))
+        replayed[resume] = report.recovered_tokens_replayed
+        # the workers' own counters tell the same story as the router's
+        # ledger (the obs surface the SLO/regression gates read)
+        from burst_attn_tpu.loadgen.slo import counter_total
+
+        ctr = counter_total(metrics, "serve.recovered_tokens_replayed")
+        if resume:
+            assert report.recovered_tokens_resumed > 0
+        else:
+            assert ctr >= report.recovered_tokens_replayed > 0
+    assert replayed[True] < replayed[False], replayed
+
+
+def test_cluster_heartbeat_detects_hang(tmp_path):
+    """A hung worker (alive process, wedged loop — answers nothing, not
+    even pings) is undetectable by liveness; the heartbeat detector
+    declares it dead after the miss threshold and its work reroutes,
+    token-exact.  Long budgets keep the victim mid-decode when it
+    wedges, so the reroute carries real work."""
+    trace = _trace(6, seed=13, max_new_min=24, max_new_mean=32,
+                   max_new_max=48)
+    faults = [FaultEvent(t=0.4, kind="hang", worker=0)]
+    with LoadGenCluster(MODEL_SPEC, ENGINE_SPEC, n_workers=2,
+                        out_dir=str(tmp_path), hb_interval_s=0.25,
+                        hb_timeout_s=4.0) as cluster:
+        report = cluster.replay(trace, faults, speed=1.0, max_wall_s=200)
+    assert len(report.kills) == 1
+    assert report.kills[0]["detected_by"] == "heartbeat"
+    assert report.n_done == len(trace.normal())
+    assert_token_exact(report.completed(), _oracle(trace))
