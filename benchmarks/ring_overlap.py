@@ -36,7 +36,14 @@ TPU ring.
 Usage:  python -m benchmarks.ring_overlap [--seqs 16384,65536]
         [--mesh 8] [--layout zigzag] [--heads 32] [--dim 128]
         [--pass fwd|bwd|fwd+bwd|all] [--topology uni|bidi|double|all]
-        [--out results/ring_overlap.jsonl]
+        [--window W] [--out results/ring_overlap.jsonl]
+
+--window W dispatches the occupancy-elided contig schedule
+(docs/schedule_ir.md "Occupancy compilation"): both ring legs run the
+r_live-round program, the floors are measured at r_live rounds/hops, and
+the row additionally records the DENSE full-ring floors
+(t_comm_dense_s / t_compute_dense_s) — the comm and compute the
+dead-round elision removed.
 
 --topology selects the compiled fused-ring schedule (parallel/schedule.py):
 "bidi" runs the counter-rotating ring and also records the per-direction
@@ -75,10 +82,12 @@ def _mesh(world):
     return Mesh(np.array(devs[:world]), ("sp",))
 
 
-def _shard_fwd(mesh, cfg, no_rotate=False):
+def _shard_fwd(mesh, cfg, no_rotate=False, n_rounds=None):
     """Shard-level forward launcher; no_rotate=True swaps every ring
     rotation for a no-op (the compute-only floor: same rounds, same tile
-    kernels, the resident chunk stands in for every arriving chunk)."""
+    kernels, the resident chunk stands in for every arriving chunk).
+    n_rounds overrides the floor's round count — the occupancy-elided
+    schedule's compute floor is r_live rounds, not the full ring."""
     spec4 = P(None, None, "sp", None)
     spec3 = P(None, None, "sp")
 
@@ -91,7 +100,7 @@ def _shard_fwd(mesh, cfg, no_rotate=False):
         from burst_attn_tpu.parallel.ring import my_partition
         from burst_attn_tpu.utils.compat import axis_size
 
-        world = axis_size(cfg.intra_axis)
+        world = n_rounds or axis_size(cfg.intra_axis)
         me = my_partition(cfg.intra_axis, None)
         s = q.shape[2]
         spec = round_spec(me, me, s, s, cfg.causal, cfg.layout)
@@ -108,8 +117,12 @@ def _shard_fwd(mesh, cfg, no_rotate=False):
     return jax.jit(lambda q, k, v: fn(q, k, v))
 
 
-def _comm_only(mesh, world, topology="uni", factor=None):
+def _comm_only(mesh, world, topology="uni", factor=None, n_rounds=None):
     """Comm-only floor of one forward topology, no compute.
+
+    n_rounds truncates the uni rotation count to an occupancy-elided
+    schedule's r_live (r_live - 1 hops: the elided program never sends the
+    dead rounds' chunks at all).
 
     uni     W-1 full-payload rotations of the (k, v) pair.
     bidi    the counter-rotating split: each round moves HALF the payload
@@ -158,7 +171,7 @@ def _comm_only(mesh, world, topology="uni", factor=None):
                 acc = acc + jnp.sum(kv[0].astype(jnp.float32))
             return acc + jnp.sum(kv[1].astype(jnp.float32))
         kv = (k, v)
-        for _ in range(world - 1):
+        for _ in range((n_rounds or world) - 1):
             kv = ppermute_next(kv, "sp")
         return jnp.sum(kv[0].astype(jnp.float32)) + jnp.sum(
             kv[1].astype(jnp.float32))
@@ -179,7 +192,7 @@ def _shard_fwd_residuals(mesh, cfg):
     return jax.jit(fn)
 
 
-def _shard_bwd(mesh, cfg, no_rotate=False):
+def _shard_bwd(mesh, cfg, no_rotate=False, n_rounds=None):
     """Shard-level backward launcher; no_rotate=True swaps both rotating
     streams for no-ops (the compute-only floor: same W rounds of tile_bwd
     against the resident bundle, zero inter-chip traffic)."""
@@ -195,7 +208,7 @@ def _shard_bwd(mesh, cfg, no_rotate=False):
         from burst_attn_tpu.parallel.ring import my_partition
         from burst_attn_tpu.utils.compat import axis_size
 
-        world = axis_size(cfg.intra_axis)
+        world = n_rounds or axis_size(cfg.intra_axis)
         me = my_partition(cfg.intra_axis, None)
         s = q.shape[2]
         scale = q.shape[3] ** -0.5
@@ -214,10 +227,12 @@ def _shard_bwd(mesh, cfg, no_rotate=False):
     return jax.jit(lambda *a: fn(*a))
 
 
-def _comm_only_bwd(mesh, world, opt_comm):
+def _comm_only_bwd(mesh, world, opt_comm, n_rounds=None):
     """Comm-only backward floor: W-1 rotations of the 4-operand q-side
     bundle (delta|o, do, q, lse) plus the dq ring's W add-and-forward hops
-    (W-1 in-ring + the return-home hop), no compute."""
+    (W-1 in-ring + the return-home hop), no compute.  n_rounds truncates
+    both streams to an elided schedule's r_live (the dq return-home hop
+    always remains)."""
     spec4 = P(None, None, "sp", None)
     spec3 = P(None, None, "sp")
     first_spec = spec3 if opt_comm else spec4
@@ -225,7 +240,7 @@ def _comm_only_bwd(mesh, world, opt_comm):
     def f(first, do, q, lse):
         pay = (first, do, q, lse)
         dq = jnp.zeros(q.shape, jnp.float32)
-        for _ in range(world - 1):
+        for _ in range((n_rounds or world) - 1):
             pay = ppermute_next(pay, "sp")
             dq = ppermute_next(dq, "sp")
         dq = ppermute_next(dq, "sp")  # return-home hop
@@ -256,9 +271,22 @@ def _shard_fwdbwd(mesh, cfg):
 
 
 def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
-               topology="uni"):
+               topology="uni", window=None):
     on_tpu = jax.default_backend() == "tpu"
     mesh = _mesh(world)
+    # --window W: occupancy-elided schedule (contig causal band).  Both ring
+    # legs dispatch the elided program; the floors are measured twice —
+    # r_live rounds/hops (what the elided schedule actually moves and
+    # computes) AND the dense full-ring floors, so the jsonl row shows the
+    # comm+compute the elision removed, not just the end-to-end time.
+    r_live = None
+    if window is not None:
+        from burst_attn_tpu.ops.masks import live_round_prefix
+
+        if layout != "contig" or not causal:
+            raise SystemExit("--window needs --layout contig and causal")
+        r_live = live_round_prefix("contig", seq // world, world,
+                                   causal=True, window=window)
     # topology -> fused-dispatch config + the factored double-ring shape
     factor = None
     topo_kw = {}
@@ -284,11 +312,13 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
                    for t in (q, k, v, do))
 
     tile_backend = "pallas" if on_tpu else "jnp"
+    win_kw = {} if window is None else {"window": window}
     scan_cfg = burst.BurstConfig(causal=causal, layout=layout,
-                                 intra_axis="sp", backend=tile_backend)
+                                 intra_axis="sp", backend=tile_backend,
+                                 **win_kw)
     fused_cfg = burst.BurstConfig(causal=causal, layout=layout,
                                   intra_axis="sp", backend="fused_ring",
-                                  **topo_kw)
+                                  **topo_kw, **win_kw)
 
     bench_kw = dict(warmup=2, iters=3, reps=2) if not on_tpu else {}
     os.environ["BURST_FUSED_INTERPRET"] = "1"  # fused legs off-TPU
@@ -296,10 +326,20 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
     if pass_ == "fwd":
         t_scan = bench_fn(_shard_fwd(mesh, scan_cfg), q, k, v, **bench_kw)
         t_fused = bench_fn(_shard_fwd(mesh, fused_cfg), q, k, v, **bench_kw)
-        t_compute = bench_fn(_shard_fwd(mesh, scan_cfg, no_rotate=True),
-                             q, k, v, **bench_kw)
-        t_comm = bench_fn(_comm_only(mesh, world, topology, factor),
-                          k, v, **bench_kw)
+        t_compute = bench_fn(
+            _shard_fwd(mesh, scan_cfg, no_rotate=True, n_rounds=r_live),
+            q, k, v, **bench_kw)
+        t_comm = bench_fn(
+            _comm_only(mesh, world, topology, factor, n_rounds=r_live),
+            k, v, **bench_kw)
+        if r_live is not None:
+            # the dense floors: what a non-elided schedule would move
+            dir_floors["t_compute_dense_s"] = round(bench_fn(
+                _shard_fwd(mesh, scan_cfg, no_rotate=True),
+                q, k, v, **bench_kw), 6)
+            dir_floors["t_comm_dense_s"] = round(bench_fn(
+                _comm_only(mesh, world, topology, factor),
+                k, v, **bench_kw), 6)
         if topology == "bidi":
             # per-direction floors: what each ICI direction costs alone —
             # the gap between t_comm_uni and t_comm is the latency the
@@ -320,14 +360,23 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
                           **bench_kw)
         t_fused = bench_fn(_shard_bwd(mesh, fused_cfg), q, k, v, o, lse, do,
                            **bench_kw)
-        t_compute = bench_fn(_shard_bwd(mesh, scan_cfg, no_rotate=True),
-                             q, k, v, o, lse, do, **bench_kw)
+        t_compute = bench_fn(
+            _shard_bwd(mesh, scan_cfg, no_rotate=True, n_rounds=r_live),
+            q, k, v, o, lse, do, **bench_kw)
         delta_or_o = (jnp.sum(o.astype(jnp.float32)
                               * do.astype(jnp.float32), axis=-1)
                       if scan_cfg.optimize_bwd_comm else o)
         t_comm = bench_fn(
-            _comm_only_bwd(mesh, world, scan_cfg.optimize_bwd_comm),
+            _comm_only_bwd(mesh, world, scan_cfg.optimize_bwd_comm,
+                           n_rounds=r_live),
             delta_or_o, do, q, lse.astype(jnp.float32), **bench_kw)
+        if r_live is not None:
+            dir_floors["t_compute_dense_s"] = round(bench_fn(
+                _shard_bwd(mesh, scan_cfg, no_rotate=True),
+                q, k, v, o, lse, do, **bench_kw), 6)
+            dir_floors["t_comm_dense_s"] = round(bench_fn(
+                _comm_only_bwd(mesh, world, scan_cfg.optimize_bwd_comm),
+                delta_or_o, do, q, lse.astype(jnp.float32), **bench_kw), 6)
     elif pass_ == "fwd+bwd":
         # one value_and_grad program per backend; floors are the sum of the
         # per-pass floors, so none are (re)measured here
@@ -354,6 +403,7 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
         "topology": topology,
         "seq": seq, "world": world, "layout": layout, "heads": n, "dim": d,
         "causal": causal,
+        **({} if window is None else {"window": window, "r_live": r_live}),
         **dir_floors,
         "t_scan_s": round(t_scan, 6),
         "t_fused_s": round(t_fused, 6),
@@ -401,6 +451,11 @@ def main():
     ap.add_argument("--heads", type=int, default=32 if on_tpu else 2)
     ap.add_argument("--dim", type=int, default=128 if on_tpu else 16)
     ap.add_argument("--noncausal", action="store_true")
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding-window width: dispatch the occupancy-"
+                         "elided contig schedule and record its r_live "
+                         "floors next to the dense ones (needs --layout "
+                         "contig)")
     ap.add_argument("--pass", dest="pass_", default="fwd",
                     choices=["fwd", "bwd", "fwd+bwd", "all"],
                     help="which pass(es) to measure; 'all' runs the three "
@@ -419,12 +474,16 @@ def main():
               else [args.pass_])
     topologies = (["uni", "bidi", "double"] if args.topology == "all"
                   else [args.topology])
+    if args.window is not None and args.layout != "contig":
+        # the band structure only exists in natural token order
+        print("note: --window implies --layout contig")
+        args.layout = "contig"
     for seq in [int(s) for s in args.seqs.split(",")]:
         for topo in topologies:
             for p in passes:
                 run_config(seq, args.mesh, args.layout, args.heads,
                            args.dim, not args.noncausal, args.out,
-                           pass_=p, topology=topo)
+                           pass_=p, topology=topo, window=args.window)
     # one obs export per invocation, beside the jsonl results
     from burst_attn_tpu import obs
 

@@ -474,6 +474,37 @@ def live_rounds_contig(seq: int, world: int, window: int) -> Set[int]:
     return live
 
 
+def live_rounds_contig_seg(seq: int, world: int,
+                           max_segment_len: int) -> Set[int]:
+    """Independent (dense numpy) derivation of the live round set of a
+    length-bounded packed-segment causal CONTIG single ring: round r is
+    live iff SOME admissible segment-id assignment (every segment at most
+    `max_segment_len` tokens) puts a shared segment across some device's
+    (q chunk, kv chunk at round r) causal block.  Sweeping a length-L
+    tiling over all L phase offsets realizes every achievable chunk-to-
+    chunk segment reach, so the union over offsets is the adversarial
+    (worst-case) live set the compiler's contract-based elision must keep
+    exactly."""
+    s = seq // world
+    live = set()
+    L = max_segment_len
+    for r in range(world):
+        found = False
+        for off in range(L):
+            for d in range(world):
+                kv_part = (d - r) % world
+                qs = np.arange(d * s, (d + 1) * s)[:, None]
+                ks = np.arange(kv_part * s, (kv_part + 1) * s)[None, :]
+                m = (ks <= qs) & ((qs + off) // L == (ks + off) // L)
+                if m.any():
+                    live.add(r)
+                    found = True
+                    break
+            if found:
+                break
+    return live
+
+
 def encode_runs(events: List[Event]) -> List[Tuple[str, str, int, int]]:
     """Run-length encode consecutive identical events: (cls, axis, hops,
     count).  Both oracle and extracted streams are compared in this form
@@ -762,23 +793,54 @@ def _prove_dq_return_home(prog) -> None:
                 home[dst] |= val
     expected_homes = sum(
         1 for r in range(n_rounds) if rows["dq_send"][r] in (2, 4))
+    # contributors are derived from the ROTATION, not assumed dense: an
+    # occupancy-truncated program only ever serves partition p on the
+    # devices its kept rounds visit, and exactly those contributions (no
+    # more, no fewer) must come home — a dense program reduces to the
+    # historical all-`world` set.
+    contributors = [set() for _ in range(world)]
+    for r in range(n_rounds):
+        for d in range(world):
+            contributors[_expected_part(prog, d, r)].add(d)
     for d in range(world):
         assert homes_written[d] == expected_homes, (
             f"device {d}: {homes_written[d]} home arrivals, expected "
             f"{expected_homes}")
-        want = {(src, d) for src in range(world)}
+        want = {(src, d) for src in contributors[d]}
         assert home[d] == want, (
-            f"device {d}: home dq carries {sorted(home[d])}, expected all "
-            f"{world} contributions of partition {d}")
+            f"device {d}: home dq carries {sorted(home[d])}, expected the "
+            f"{len(want)} scheduled contributions of partition {d}")
 
 
-def verify_ring_program(prog: dict) -> None:
+def served_deltas(prog: dict) -> Set[int]:
+    """Ring offsets (q_part - kv_part mod world) the program's kept rounds
+    serve.  Forward programs rotate the KV side (offset = flat rotation);
+    backward programs rotate the q side (offset = NEGATED flat rotation).
+    This is the skip-safety vocabulary: an occupancy-elided program is
+    correct iff this set equals the mask's live-offset set."""
+    world = prog["n_inter"] * prog["n_intra"]
+    n_s = prog["n_intra"]
+    flat = [(prog["rot_inter"][r] * n_s + prog["rot_intra"][r]) % world
+            for r in range(len(prog["rot_intra"]))]
+    if prog["kind"] == "bwd":
+        return {(-f) % world for f in flat}
+    return set(flat)
+
+
+def verify_ring_program(prog: dict, live_deltas=None) -> None:
     """Prove one compiled ring program (RingProgram.export() dict) by
     simulation; raises AssertionError with a specific message on the first
     violated obligation.  Called by burstlint's fused-ring-schedule rule
     for every topology the compiler can emit, and by the mutation tests
     with deliberately-corrupted programs (flipped direction, shortened
-    prefetch distance, aliased slot) to prove the proof has teeth."""
+    prefetch distance, aliased slot) to prove the proof has teeth.
+
+    live_deltas (optional iterable of ints): SKIP-SAFETY obligation for
+    occupancy-elided programs — the kept rounds must serve exactly these
+    ring offsets (ops/masks.live_delta_table's True entries): eliding a
+    live offset loses attention mass, keeping a dead one reinstates the
+    RDMA/sweep cost elision exists to remove.  Both directions fire the
+    mutation tests in tests/test_analysis.py."""
     assert prog["n_inter"] >= 1 and prog["n_intra"] >= 1
     world = prog["n_inter"] * prog["n_intra"]
     rows = prog["rows"]
@@ -790,6 +852,16 @@ def verify_ring_program(prog: dict) -> None:
         assert 0 <= rows["consume_slot"][r] < prog["slots"][b], (
             f"round {r}: consume slot {rows['consume_slot'][r]} out of "
             f"range for bank {b} ({prog['slots'][b]} slots)")
+    if live_deltas is not None:
+        got = served_deltas(prog)
+        want = set(int(x) for x in live_deltas)
+        missing, extra = sorted(want - got), sorted(got - want)
+        assert not missing, (
+            f"elision dropped LIVE ring offsets {missing}: rounds with "
+            "attending pairs would never be computed")
+        assert not extra, (
+            f"program keeps DEAD ring offsets {extra}: fully-masked rounds "
+            "still cost RDMA + sweep — not elided")
     _prove_payload_delivery(prog)
     for bank in range(len(prog["slots"])):
         _prove_bank_safety(prog, bank)

@@ -48,7 +48,8 @@ rule("ring-order", "jaxpr",
 rule("dq-return-home", "jaxpr",
      "bwd dq ring stream matches the proven return-home schedule")(None)
 rule("window-truncation", "jaxpr",
-     "windowed ring truncation matches the dense band-mask live set")(None)
+     "occupancy truncation (window band / max_segment_len reach) matches "
+     "the independent dense live-set derivation")(None)
 rule("fused-ring-schedule", "jaxpr",
      "every schedule the compiler emits (uni, bidi, double; fwd AND bwd) "
      "is simulation-proven: delivery of the declared rotation, hop "
@@ -69,6 +70,7 @@ class RingEntry:
     layout: str
     causal: bool
     window: Optional[int] = None
+    max_segment_len: Optional[int] = None
     case_split: bool = True
     s_local: int = 16
 
@@ -87,6 +89,8 @@ ENTRIES = [
               case_split=False),
     RingEntry("double-2x4-zigzag", {"inter": 2, "intra": 4}, "zigzag", True),
     RingEntry("window-contig", {"sp": 4}, "contig", True, window=20),
+    RingEntry("segments-contig", {"sp": 4}, "contig", True,
+              max_segment_len=16),
 ]
 
 
@@ -259,6 +263,7 @@ def verify_ring_entry(entry: RingEntry) -> List[Finding]:
     cfg = burst.BurstConfig(
         causal=entry.causal, layout=entry.layout, intra_axis=intra_axis,
         inter_axis=inter_axis, backend="jnp", window=entry.window,
+        max_segment_len=entry.max_segment_len,
         case_split=entry.case_split)
 
     b, n, d = 1, 2, 8
@@ -269,10 +274,20 @@ def verify_ring_entry(entry: RingEntry) -> List[Finding]:
     spec4 = P(None, None, names if len(names) > 1 else names[0], None)
     spec3 = P(None, None, names if len(names) > 1 else names[0])
 
-    # expected streams — the bwd one is only trusted after its proof
+    # expected streams — the bwd one is only trusted after its proof.
+    # The truncated live set comes from the INDEPENDENT dense derivations
+    # (live_rounds_contig / live_rounds_contig_seg), not from the
+    # implementation's masks.live_round_prefix — agreement between the two
+    # is exactly what window-truncation proves.
     r_live = None
-    if entry.window is not None and n_inter == 1:
-        live = oracle.live_rounds_contig(seq, entry.world, entry.window)
+    truncating = (entry.window is not None
+                  or entry.max_segment_len is not None)
+    if truncating and n_inter == 1:
+        if entry.window is not None:
+            live = oracle.live_rounds_contig(seq, entry.world, entry.window)
+        else:
+            live = oracle.live_rounds_contig_seg(seq, entry.world,
+                                                 entry.max_segment_len)
         if live != set(range(len(live))):
             findings.append(Finding(
                 rule="window-truncation", file=_anchor(burst._fwd_impl)[0],
@@ -290,7 +305,7 @@ def verify_ring_entry(entry: RingEntry) -> List[Finding]:
         jax.make_jaxpr(fwd)(q, q, q), kind="fwd", n_inter=n_inter,
         n_intra=n_intra, r_live=r_live, leaves_pay=2, axis_map=axis_map,
         where=f"{entry.name} fwd", anchor=_anchor(burst._fwd_impl),
-        window=entry.window is not None)
+        window=truncating)
 
     # ---- backward ----
     bwd = shard_map(
@@ -301,7 +316,7 @@ def verify_ring_entry(entry: RingEntry) -> List[Finding]:
         jax.make_jaxpr(bwd)(q, q, q, q, lse, q), kind="bwd", n_inter=n_inter,
         n_intra=n_intra, r_live=r_live, leaves_pay=4, axis_map=axis_map,
         where=f"{entry.name} bwd", anchor=_anchor(burst._bwd_impl),
-        window=entry.window is not None)
+        window=truncating)
     return findings
 
 
@@ -404,7 +419,47 @@ IR_PROOF_CONFIGS = (
     ("double", 4, 2, {}),
     ("double", 2, 4, {"slots": 3, "slots1": 3}),
     ("double", 3, 3, {}),
+    # occupancy-elided programs (r_live < world): the schedules a windowed
+    # or length-bounded packed-segment contig ring compiles to after dead-
+    # round elision.  verify_ring_programs proves these with the matching
+    # live-offset set: the program must serve EXACTLY offsets {0..r_live-1}
+    # — keeping a dead offset or dropping a live one both fire.  (The
+    # double-ring BWD ignores r_live by design — its interleaved visit
+    # order makes the live set a non-prefix, so dead rounds stay in the
+    # program and the kernel's mask predication zeroes them.)
+    ("uni", 1, 8, {"r_live": 3}),
+    ("uni", 1, 8, {"r_live": 2}),
+    ("uni", 1, 4, {"r_live": 3}),
+    ("bidi", 1, 8, {"r_live": 3}),
+    ("bidi", 1, 5, {"r_live": 2}),
+    ("bidi", 1, 8, {"r_live": 4, "slots": 3}),
+    ("double", 2, 4, {"r_live": 3}),
+    ("double", 4, 2, {"r_live": 5}),
 )
+
+
+def verify_elided_program(prog_export: dict, r_live: int, *, where: str,
+                          anchor=None) -> List[Finding]:
+    """fused-ring-schedule, elision obligation: an occupancy-compiled
+    program claiming live prefix {0..r_live-1} must serve EXACTLY those
+    ring offsets — a compiler that fails to elide a dead round (wasted
+    RDMA, possible garbage reads) or elides a live one (dropped attention
+    mass) both fire.  Shared by verify_ring_programs (proving the real
+    compiler's matrix) and the mutation tests (proving seeded-bad programs
+    are caught)."""
+    if anchor is None:
+        from ..parallel import schedule as sched
+
+        anchor = _anchor(sched.compile_fwd)
+    findings: List[Finding] = []
+    try:
+        oracle.verify_ring_program(prog_export,
+                                   live_deltas=tuple(range(r_live)))
+    except AssertionError as e:
+        findings.append(Finding(
+            rule="fused-ring-schedule", file=anchor[0], line=anchor[1],
+            message=f"{where}: elision proof failed: {e}"))
+    return findings
 
 
 def verify_ring_programs() -> List[Finding]:
@@ -414,12 +469,16 @@ def verify_ring_programs() -> List[Finding]:
     declared rotation, per-slot overwrite-before-read safety per direction
     under a maximally-ahead sender, the double ring's >= one-intra-cycle
     prefetch distance, and (bwd) the dq streams' exactly-once return-home
-    with all `world` contributions."""
+    with all `world` contributions.  r_live configs additionally prove the
+    served-offset set equals the live prefix (dead rounds elided, live
+    rounds kept) and that elision strictly shrinks the remote-DMA census
+    vs the dense compile of the same topology."""
     from ..parallel import schedule as sched
 
     findings: List[Finding] = []
     anchor_ir = _anchor(sched.compile_fwd)
     for topology, n_inter, n_intra, kw in IR_PROOF_CONFIGS:
+        r_live = kw.get("r_live")
         for kind, compiler in (("fwd", sched.compile_fwd),
                                ("bwd", sched.compile_bwd)):
             tag = (f"{kind} {topology} {n_inter}x{n_intra}"
@@ -433,13 +492,40 @@ def verify_ring_programs() -> List[Finding]:
                     message=f"{tag}: compiler refused a supported "
                             f"topology: {e}"))
                 continue
-            try:
-                oracle.verify_ring_program(prog.export())
-            except AssertionError as e:
-                findings.append(Finding(
-                    rule="fused-ring-schedule", file=anchor_ir[0],
-                    line=anchor_ir[1],
-                    message=f"{tag}: simulation proof failed: {e}"))
+            # double-ring bwd keeps the dense program under r_live by
+            # design (non-prefix visit order; in-kernel mask predication
+            # covers the dead rounds) — prove it as dense
+            elide = (r_live is not None
+                     and not (kind == "bwd" and topology == "double"))
+            if elide:
+                findings += verify_elided_program(
+                    prog.export(), r_live, where=tag, anchor=anchor_ir)
+                dense_kw = {k: w for k, w in kw.items() if k != "r_live"}
+                dense = compiler(topology, n_intra, n_inter, **dense_kw)
+                payload = 2 if kind == "fwd" else 4
+                got = sched.expected_remote_dma(prog, payload)
+                ref = sched.expected_remote_dma(dense, payload)
+                if prog.n_rounds >= dense.n_rounds:
+                    findings.append(Finding(
+                        rule="fused-ring-schedule", file=anchor_ir[0],
+                        line=anchor_ir[1],
+                        message=f"{tag}: elided program keeps "
+                                f"{prog.n_rounds} rounds, dense has "
+                                f"{dense.n_rounds} — nothing was elided"))
+                if got > ref:
+                    findings.append(Finding(
+                        rule="fused-ring-schedule", file=anchor_ir[0],
+                        line=anchor_ir[1],
+                        message=f"{tag}: elided remote-DMA census {got} "
+                                f"exceeds the dense census {ref}"))
+            else:
+                try:
+                    oracle.verify_ring_program(prog.export())
+                except AssertionError as e:
+                    findings.append(Finding(
+                        rule="fused-ring-schedule", file=anchor_ir[0],
+                        line=anchor_ir[1],
+                        message=f"{tag}: simulation proof failed: {e}"))
     return findings
 
 
@@ -640,7 +726,11 @@ def verify_fused_topologies() -> List[Finding]:
     b, n, d, s_local = 1, 2, 8, 16
     S = jax.ShapeDtypeStruct
 
-    # (name, env flag, mesh axes+sizes, ring axes, cfg extras, q specs)
+    # (name, env flag, mesh axes+sizes, ring axes, cfg extras, q specs).
+    # The windowed-* / segments-* rows are OCCUPANCY-ELIDED programs: the
+    # compiler truncates them to the live prefix, and the census assertion
+    # below proves the elided program's remote-DMA call-site count never
+    # exceeds — and for bidi strictly undercuts — the dense compile's.
     CASES = (
         ("bidi-4", "BURST_FUSED_INTERPRET", (("sp", 4),), ("sp", None),
          {"fused_topology": "bidi"}),
@@ -651,14 +741,23 @@ def verify_fused_topologies() -> List[Finding]:
         ("multiaxis-pp2-tp2-sp2", "BURST_FUSED_ASSUME_TPU",
          (("pp", 2), ("tp", 2), ("sp", 2)), ("sp", None),
          {"mesh_axes": (("pp", 2), ("tp", 2), ("sp", 2))}),
+        ("windowed-uni-8", "BURST_FUSED_INTERPRET", (("sp", 8),),
+         ("sp", None), {"layout": "contig", "window": 20}),
+        ("windowed-bidi-8", "BURST_FUSED_INTERPRET", (("sp", 8),),
+         ("sp", None), {"layout": "contig", "window": 20,
+                        "fused_topology": "bidi"}),
+        ("segments-uni-8", "BURST_FUSED_INTERPRET", (("sp", 8),),
+         ("sp", None), {"layout": "contig", "max_segment_len": 16}),
     )
     for name, env, axes, (intra_axis, inter_axis), extras in CASES:
         names = tuple(a for a, _ in axes)
         sizes = tuple(sz for _, sz in axes)
         mesh = Mesh(np.asarray(devs[:int(np.prod(sizes))]).reshape(sizes),
                     names)
+        extras = dict(extras)
+        layout = extras.pop("layout", "zigzag")
         cfg = burst.BurstConfig(
-            causal=True, layout="zigzag", intra_axis=intra_axis,
+            causal=True, layout=layout, intra_axis=intra_axis,
             inter_axis=inter_axis, backend="fused_ring", **extras)
         ring_names = tuple(a for a in (inter_axis, intra_axis) if a)
         world = int(np.prod([dict(axes)[a] for a in ring_names]))
@@ -670,10 +769,11 @@ def verify_fused_topologies() -> List[Finding]:
         spec3 = P(None, None, seq_spec)
         n_inter = dict(axes).get(inter_axis, 1) if inter_axis else 1
         topo, t_i, t_s = fr.resolve_topology(cfg, world // n_inter, n_inter)
+        elided = fr.occupancy_r_live(cfg, world, s_local) is not None
         prev = os.environ.get(env)
         os.environ[env] = "1"
         try:
-            prog_f = fr._compile_for(cfg, topo, t_i, t_s, "fwd")
+            prog_f = fr._compile_for(cfg, topo, t_i, t_s, "fwd", s=s_local)
             fwd = shard_map(lambda q, k, v: burst._fwd_impl(q, k, v, cfg),
                             mesh=mesh, in_specs=(spec4,) * 3,
                             out_specs=(spec4, spec3), check_vma=False)
@@ -684,7 +784,7 @@ def verify_fused_topologies() -> List[Finding]:
 
             from ..ops import fused_ring_bwd as frb
 
-            prog_b = fr._compile_for(cfg, topo, t_i, t_s, "bwd")
+            prog_b = fr._compile_for(cfg, topo, t_i, t_s, "bwd", s=s_local)
             bwd = shard_map(
                 lambda q, k, v, o, l, do: burst._bwd_impl(
                     cfg, q, k, v, o, l, do),
@@ -694,6 +794,32 @@ def verify_fused_topologies() -> List[Finding]:
                 jax.make_jaxpr(bwd)(q, q, q, q, lse, q),
                 where=f"fused-{name}-bwd", anchor=_anchor(frb.fused_ring_bwd),
                 expected_dma=sched.expected_remote_dma(prog_b, 4))
+            if elided:
+                # elision census: the dense compile of the SAME topology
+                # must never undercut the elided program, and the bidi
+                # ring must strictly shrink (its dead ccw bank vanishes)
+                dense_f = fr._compile_for(cfg, topo, t_i, t_s, "fwd")
+                dense_b = fr._compile_for(cfg, topo, t_i, t_s, "bwd")
+                for pss, prog, dense, payload in (
+                        ("fwd", prog_f, dense_f, 2),
+                        ("bwd", prog_b, dense_b, 4)):
+                    got = sched.expected_remote_dma(prog, payload)
+                    ref = sched.expected_remote_dma(dense, payload)
+                    strict = topo == "bidi"
+                    if got > ref or (strict and got >= ref):
+                        findings.append(Finding(
+                            rule="fused-ring-fused", file=anchor_fwd[0],
+                            line=anchor_fwd[1],
+                            message=f"fused-{name}-{pss}: elided remote-"
+                                    f"DMA census {got} does not undercut "
+                                    f"the dense census {ref}"))
+                    if prog.n_rounds >= dense.n_rounds:
+                        findings.append(Finding(
+                            rule="fused-ring-fused", file=anchor_fwd[0],
+                            line=anchor_fwd[1],
+                            message=f"fused-{name}-{pss}: elided program "
+                                    f"keeps {prog.n_rounds} rounds, dense "
+                                    f"has {dense.n_rounds}"))
         finally:
             if prev is None:
                 os.environ.pop(env, None)

@@ -36,6 +36,10 @@ caller sees per-device arrays of leading length `world`:
                  row, not an error)
   nonfinite_acc  count of non-finite accumulator/output entries
   fused_rounds   rounds executed inside the fused RDMA kernel (0 on scan)
+  rounds_elided  rounds the occupancy compiler removed from the schedule
+                 entirely (windowed/segment-bounded contig rings); these
+                 never launched, unlike (rounds - rounds_live) which ran
+                 fully masked
   slot_use       [MAX_SLOTS] per-KV-slot consume counts from the fused
                  forward kernel's in-kernel scalar output (zeros on the
                  scan path)
@@ -86,6 +90,14 @@ class DevStats(NamedTuple):
     nonfinite_lse: jnp.ndarray   # i32
     nonfinite_acc: jnp.ndarray   # i32
     fused_rounds: jnp.ndarray    # i32
+    # rounds the occupancy compiler ELIDED from the schedule (windowed /
+    # length-bounded packed-segment contig rings): world minus the
+    # compiled round count.  Executed-vs-live accounting: rounds +
+    # rounds_elided == world on single-ring schedules, and an elided
+    # round never launched — no RDMA, no sweep, no slot traffic — which
+    # is what distinguishes this counter from (rounds - rounds_live),
+    # the rounds that RAN fully masked.
+    rounds_elided: jnp.ndarray   # i32
     slot_use: jnp.ndarray        # i32[MAX_SLOTS]
     slot_use_bwd: jnp.ndarray    # i32[MAX_SLOTS]
     # second-direction banks of the schedule-IR kernels: the ccw ring of a
@@ -125,6 +137,10 @@ class DevStats(NamedTuple):
             reg.gauge("devstats.rounds_live",
                       "rounds with any attending pair").set(
                 leaves["rounds_live"][dev], **lab)
+            reg.gauge("devstats.rounds_elided",
+                      "rounds the occupancy compiler removed from the "
+                      "schedule (never launched)").set(
+                leaves["rounds_elided"][dev], **lab)
             total = leaves["total_pairs"][dev]
             occ = leaves["attn_pairs"][dev] / total if total > 0 else 0.0
             reg.gauge("devstats.mask_occupancy",
@@ -182,7 +198,7 @@ def _slot_vec(slot_use):
 
 
 def ring_stats(rounds, rounds_live, attn_pairs, total_pairs, head_dim,
-               m, lse, acc, fused_rounds=0, slot_use=None,
+               m, lse, acc, fused_rounds=0, rounds_elided=0, slot_use=None,
                slot_use_bwd=None, slot_use_ccw=None,
                slot_use_bwd_ccw=None) -> DevStats:
     """Assemble a per-shard DevStats from ring results (traced context).
@@ -213,6 +229,7 @@ def ring_stats(rounds, rounds_live, attn_pairs, total_pairs, head_dim,
             jnp.isnan(lse) | (lse == _POS_INF)).astype(i32),
         nonfinite_acc=jnp.sum(~jnp.isfinite(acc)).astype(i32),
         fused_rounds=jnp.asarray(fused_rounds, i32),
+        rounds_elided=jnp.asarray(rounds_elided, i32),
         slot_use=_slot_vec(slot_use),
         slot_use_bwd=_slot_vec(slot_use_bwd),
         slot_use_ccw=_slot_vec(slot_use_ccw),

@@ -77,7 +77,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .masks import round_spec, spec_live, spec_pair_count
+from .masks import live_round_prefix, round_spec, spec_live, spec_pair_count
 from .pallas_flash import (
     LN2,
     LOG2E,
@@ -87,6 +87,7 @@ from .pallas_flash import (
     _block_has_work,
     _block_mask,
     _pick_block,
+    _seg_uniform_eq,
     _spec_array,
     _unpack,
 )
@@ -169,8 +170,24 @@ def resolve_topology(cfg, n_intra: int, n_inter: int = 1):
     raise ValueError(f"unknown fused_topology {topo!r}")
 
 
+def occupancy_r_live(cfg, world: int, s):
+    """Static live-round prefix the occupancy compiler should truncate the
+    schedule to, or None for a dense program.  Windowed and length-bounded
+    packed-segment contig-causal rings have a closed-form live set
+    {0..r_live-1} (masks.live_round_prefix); handing it to the schedule
+    compiler ELIDES the dead rounds outright — no RDMA, no KV sweep, no
+    slot traffic.  `s` is the per-shard sequence length (None when the
+    caller has no shape in hand, e.g. a shape-free structural probe)."""
+    seg_l = getattr(cfg, "max_segment_len", None)
+    if s is None or (cfg.window is None and seg_l is None):
+        return None
+    r_live = live_round_prefix(cfg.layout, s, world, causal=cfg.causal,
+                               window=cfg.window, max_segment_len=seg_l)
+    return None if r_live >= world else r_live
+
+
 def _compile_for(cfg, topology: str, n_inter: int, n_intra: int,
-                 pass_: str = "fwd"):
+                 pass_: str = "fwd", s=None):
     rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
                        cfg.fused_kv_slots,
                        block_q_bwd=getattr(cfg, "fused_block_q_bwd", None),
@@ -179,12 +196,14 @@ def _compile_for(cfg, topology: str, n_inter: int, n_intra: int,
                        ccw_slots=getattr(cfg, "fused_ccw_slots", None),
                        bwd_ccw_slots=getattr(cfg, "fused_bwd_ccw_slots",
                                              None))
+    r_live = occupancy_r_live(cfg, n_inter * n_intra, s)
     if pass_ == "fwd":
         return sched_ir.compile_fwd(topology, n_intra, n_inter,
-                                    slots=rf.kv_slots, slots1=rf.ccw_slots)
+                                    slots=rf.kv_slots, slots1=rf.ccw_slots,
+                                    r_live=r_live)
     return sched_ir.compile_bwd(topology, n_intra, n_inter,
                                 slots=rf.bwd_slots, slots1=rf.bwd_ccw_slots,
-                                dq_slots=rf.bwd_slots)
+                                dq_slots=rf.bwd_slots, r_live=r_live)
 
 
 def supported(cfg, q_shape, k_shape, has_segments: bool, *,
@@ -212,10 +231,11 @@ def supported(cfg, q_shape, k_shape, has_segments: bool, *,
         interpret = jax.default_backend() != "tpu" and not hw_trace_forced()
     if interpret and not interpret_enabled():
         return "off-TPU (set BURST_FUSED_INTERPRET=1 to run interpreted)"
-    if cfg.window is not None:
-        return "sliding window not fused yet"
-    if has_segments:
-        return "packed segments not fused yet"
+    # sliding window and packed segments are fused configs since the
+    # occupancy compiler: the window is a static band the sweeps predicate
+    # on, segment ids ride a gathered side table, and dead rounds are
+    # ELIDED from the program — the only windowed decline left is the
+    # degenerate r_live == 1 bwd (via the schedule-compiler probe below)
     b, n, s, d = q_shape
     if k_shape[2] != s:
         return "cross-attention shard lengths"
@@ -260,7 +280,7 @@ def supported(cfg, q_shape, k_shape, has_segments: bool, *,
                     f"(found {extra}; pass mesh_axes via burst_attn to "
                     "prove ring isolation)")
     try:
-        prog = _compile_for(cfg, topology, t_inter, t_intra, pass_)
+        prog = _compile_for(cfg, topology, t_inter, t_intra, pass_, s=s)
     except sched_ir.ScheduleError as e:
         return f"schedule compiler declined: {e}"
     rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
@@ -372,10 +392,9 @@ _GRANTC = {0: (sched_ir.GRANT0, sched_ir.META_CH0_SRC),
 def _fused_fwd_kernel(
     sched_ref,
     q_ref, k_hbm, v_hbm,
-    o_ref, lse_ref,
     *rest,
     prog, statics, scale, bq, bkv, lp, nqb, nkb, group, n_b, n_h, hw_sync,
-    collect,
+    collect, wnd, has_seg,
 ):
     """One grid step = q-block i of head h, batch b_, ring round r.
 
@@ -409,6 +428,13 @@ def _fused_fwd_kernel(
     R = prog.n_rounds
     n_banks = prog.n_banks
     rest = list(rest)
+    # remaining positional refs: [segq, sega] inputs when has_seg, then the
+    # two outputs, the optional stats output, then the scratch refs
+    if has_seg:
+        segq_ref = rest.pop(0)   # [1, s, 1] VMEM block: LOCAL segment ids
+        sega_hbm = rest.pop(0)   # [B, world, 1, s] ANY: every shard's ids
+    o_ref = rest.pop(0)
+    lse_ref = rest.pop(0)
     if collect:
         slot_use_ref = rest.pop(0)
     kbufs, vbufs = [], []
@@ -425,6 +451,9 @@ def _fused_fwd_kernel(
         vsend.append(rest.pop(0))
         vrecv.append(rest.pop(0))
         free.append(rest.pop(0))
+    if has_seg:
+        segbuf = rest.pop(0)     # VMEM (1, s) int32: this round's kv ids
+        seg_sem = rest.pop(0)
 
     r = pl.program_id(0)
     b_ = pl.program_id(1)
@@ -553,6 +582,18 @@ def _fused_fwd_kernel(
                 lk.wait()
                 lv.wait()
 
+    # ---- per-(round, batch) segment-id row: gathered table -> VMEM ----
+    if has_seg:
+        @pl.when((i == 0) & (h == 0))
+        def _seg_load():
+            # the rotating side's partition (appended table column) selects
+            # which shard's ids this round's kv chunk carries
+            part = sched_ref[r, sched_ir.FWD_COLS]
+            cp = pltpu.make_async_copy(sega_hbm.at[b_, part], segbuf,
+                                       seg_sem.at[0])
+            cp.start()
+            cp.wait()
+
     # ---- start the acc carry load early: it overlaps the whole sweep ----
     @pl.when(r > 0)
     def _acc_load_start():
@@ -586,18 +627,29 @@ def _fused_fwd_kernel(
             p.astype(vchunk.dtype), vchunk[pl.ds(c0, bkv), :],
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
+    segq = segq_ref[0, pl.ds(r0, bq), :] if has_seg else None   # (bq, 1)
     for j in range(nkb):
         c0 = j * bkv
-        live = _block_has_work(spec_r, r0, c0, bq, bkv)
-        full = _block_full(spec_r, r0, c0, bq, bkv)
+        live = _block_has_work(spec_r, r0, c0, bq, bkv, wnd)
+        full = _block_full(spec_r, r0, c0, bq, bkv, wnd)
+        if has_seg:
+            segk = segbuf[:, pl.ds(c0, bkv)]                    # (1, bkv)
+            seg_pair = (segq, segk)
+            # the fast path must also be single-segment-uniform: a
+            # structurally-full block can still straddle a packing boundary
+            fast = full & _seg_uniform_eq(segq, segk)
+        else:
+            seg_pair = None
+            fast = full
 
-        @pl.when(live & full)
+        @pl.when(live & fast)
         def _fast(c0=c0):
             _fold(c0, None)
 
-        @pl.when(live & ~full)
-        def _masked(c0=c0):
-            _fold(c0, _block_mask(spec_r, r0, c0, bq, bkv))
+        @pl.when(live & ~fast)
+        def _masked(c0=c0, seg_pair=seg_pair):
+            _fold(c0, _block_mask(spec_r, r0, c0, bq, bkv, wnd,
+                                  seg=seg_pair))
 
     # ---- merge with the carried state (split-k style combine) ----
     @pl.when(r == 0)
@@ -671,20 +723,24 @@ def _fused_fwd_kernel(
 # shard-level entry point
 
 
-def build_sched_table(cfg, prog, s_q: int, s_kv: int, *, swap_roles=False):
+def build_sched_table(cfg, prog, s_q: int, s_kv: int, *, swap_roles=False,
+                      with_part=False):
     """The [R + 1, cols] traced prefetch table for a compiled program:
     per-round mask-spec scalars (the partition each round holds comes from
     the program's rotation applied to this device's ring coordinates) next
     to the program's op columns, plus the META neighbor-id row from
     parallel/ring.device_roles.  `swap_roles` builds backward-orientation
     specs (the rotating payload is the q side, the resident chunk the kv
-    side).  Returns (table, specs) — the per-round MaskSpecs are reused
-    for devstats occupancy tallies."""
+    side).  `with_part` appends one extra column holding the rotating
+    side's PARTITION id per round — the packed-segment kernels use it to
+    pick that round's segment-id row out of the gathered side table.
+    Returns (table, specs) — the per-round MaskSpecs are reused for
+    devstats occupancy tallies."""
     inter_rank, intra_rank, _, _ = ring_coords(
         cfg.intra_axis, cfg.inter_axis, cfg.fused_seq_factor)
     me_part = inter_rank * prog.n_intra + intra_rank
     op_table = prog.to_table()
-    ncols = op_table.shape[1]
+    ncols = op_table.shape[1] + int(with_part)
     rows = []
     specs = []
     for r in range(prog.n_rounds):
@@ -692,13 +748,15 @@ def build_sched_table(cfg, prog, s_q: int, s_kv: int, *, swap_roles=False):
                                               intra_rank)
         if swap_roles:
             sp = round_spec(part_r, me_part, s_q, s_kv, cfg.causal,
-                            cfg.layout)
+                            cfg.layout, window=cfg.window)
         else:
             sp = round_spec(me_part, part_r, s_q, s_kv, cfg.causal,
-                            cfg.layout)
+                            cfg.layout, window=cfg.window)
         specs.append(sp)
-        rows.append(jnp.concatenate(
-            [_spec_array(sp), jnp.asarray(op_table[r, 5:], jnp.int32)]))
+        row = [_spec_array(sp), jnp.asarray(op_table[r, 5:], jnp.int32)]
+        if with_part:
+            row.append(jnp.reshape(jnp.asarray(part_r, jnp.int32), (1,)))
+        rows.append(jnp.concatenate(row))
     roles = device_roles(cfg.intra_axis, cfg.inter_axis,
                          mesh_axes=cfg.mesh_axes,
                          factor=cfg.fused_seq_factor,
@@ -719,12 +777,34 @@ def build_sched_table(cfg, prog, s_q: int, s_kv: int, *, swap_roles=False):
     return jnp.stack(rows), specs
 
 
-def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
+def gather_seg_table(seg, cfg):
+    """[B, world, 1, S] int32 side table of EVERY ring shard's segment ids,
+    in partition order, from this shard's [B, S] local ids.  One all_gather
+    at entry (ids are tiny next to KV) — the ring itself still moves zero
+    XLA collectives; burstlint's zero-collective census counts ppermute/
+    all_to_all, and the fused-path contract is "no per-round collectives",
+    which a single O(S) prologue gather keeps.  Partition id ordering:
+    inter-major (inter_rank * n_intra + intra_rank), which for both the
+    flat and the factored ring equals the gather order (ring.ring_coords
+    maps flat rank f to (f // n_s, f % n_s))."""
+    x = jax.lax.all_gather(seg.astype(jnp.int32), cfg.intra_axis)
+    if cfg.inter_axis is not None:
+        x = jax.lax.all_gather(x, cfg.inter_axis)
+        x = x.reshape((-1,) + x.shape[2:])
+    x = jnp.moveaxis(x, 0, 1)          # [B, world, S]
+    return x[:, :, None, :]
+
+
+def fused_ring_fwd(q, k, v, cfg, *, seg=None, interpret=None,
+                   collect_stats=False):
     """Forward burst attention on per-shard arrays via the fused ring kernel.
 
     Call inside shard_map on the ring axis (same contract as
     parallel/burst._fwd_impl): q [B, N, S, D], k/v [B, Nk, S, D] in layout
-    order.  Returns (o [B, N, S, D] in q.dtype, lse [B, N, S] f32) — plus a
+    order, `seg` [B, S] optional packed-segment ids (attention never
+    crosses a segment boundary; ids are gathered ring-wide once at entry
+    and each round's row rides the prefetch table's partition column).
+    Returns (o [B, N, S, D] in q.dtype, lse [B, N, S] f32) — plus a
     per-shard obs.devstats.DevStats when `collect_stats`: mask occupancy and
     liveness are derived in-graph from the SAME sched-table specs the kernel
     masks by, per-(bank, slot) reuse counts come out of the kernel itself as
@@ -745,7 +825,7 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
                   if cfg.inter_axis is not None else 1)
     topology, t_inter, t_intra = resolve_topology(cfg, n_intra_ax,
                                                   n_inter_ax)
-    prog = _compile_for(cfg, topology, t_inter, t_intra, "fwd")
+    prog = _compile_for(cfg, topology, t_inter, t_intra, "fwd", s=s)
     statics = kernel_statics(prog)
     R = prog.n_rounds
     rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
@@ -757,12 +837,14 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
     nqb = s // bq
     nkb = s // bkv
 
-    sched, specs = build_sched_table(cfg, prog, s, s)
+    sched, specs = build_sched_table(cfg, prog, s, s,
+                                     with_part=seg is not None)
 
     kernel = functools.partial(
         _fused_fwd_kernel, prog=prog, statics=statics, scale=scale, bq=bq,
         bkv=bkv, lp=lp, nqb=nqb, nkb=nkb, group=group, n_b=b, n_h=n,
         hw_sync=not interpret, collect=collect_stats,
+        wnd=cfg.window, has_seg=seg is not None,
     )
 
     def q_map(r, b_, h, i, sp):
@@ -816,14 +898,29 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
             pltpu.SemaphoreType.REGULAR((prog.slots[bank],)),  # free[bank]
         ]
 
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+    ]
+    inputs = [sched, q, k, v]
+    if seg is not None:
+        # local ids resident per batch; the gathered ring-wide table stays
+        # in ANY space and the kernel pulls one partition's row per round
+        in_specs.append(pl.BlockSpec((1, s, 1),
+                                     lambda r, b_, h, i, sp: (b_, 0, 0)))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY))
+        inputs.append(seg.astype(jnp.int32)[:, :, None])
+        inputs.append(gather_seg_table(seg, cfg))
+        scratch += [
+            pltpu.VMEM((1, s), jnp.int32),       # segbuf
+            pltpu.SemaphoreType.DMA((1,)),       # seg_sem
+        ]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(R, b, n, nqb),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), q_map),
-            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=scratch,
     )
@@ -840,7 +937,7 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
             collective_id=_COLLECTIVE_ID,
         ),
         interpret=interpret,
-    )(sched, q, k, v)
+    )(*inputs)
     o, lse_packed = outs[0], outs[1]
     lse = _unpack(lse_packed)
     if not collect_stats:
@@ -848,15 +945,19 @@ def fused_ring_fwd(q, k, v, cfg, *, interpret=None, collect_stats=False):
     from ..obs import devstats
 
     # occupancy/liveness from the SAME per-round specs the kernel masks by;
-    # the fused ring executes every scheduled round (dead contig-causal
-    # rounds run fully masked instead of being cond-skipped)
-    pairs = sum(spec_pair_count(sp, s, s) for sp in specs)
-    live = sum(spec_live(sp).astype(jnp.int32) for sp in specs)
+    # the fused ring executes every scheduled round (band-dead blocks are
+    # in-kernel masked) and the occupancy compiler has already ELIDED the
+    # fully-dead rounds — rounds_elided counts what never launched.
+    # Segment occupancy is data-dependent and NOT in these tallies: pair
+    # counts stay band-only (documented in docs/observability.md).
+    pairs = sum(spec_pair_count(sp, s, s, window=cfg.window) for sp in specs)
+    live = sum(spec_live(sp, cfg.window).astype(jnp.int32) for sp in specs)
     slot_use = outs[2]
     stats = devstats.ring_stats(
         rounds=R, rounds_live=live, attn_pairs=pairs,
         total_pairs=float(R) * s * s, head_dim=d,
         m=None,  # the running row max never leaves the kernel
-        lse=lse, acc=o, fused_rounds=R, slot_use=slot_use[0],
+        lse=lse, acc=o, fused_rounds=R, rounds_elided=prog.world - R,
+        slot_use=slot_use[0],
         slot_use_ccw=slot_use[1] if prog.n_banks > 1 else None)
     return o, lse, stats

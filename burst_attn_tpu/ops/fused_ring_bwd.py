@@ -90,10 +90,11 @@ from .pallas_flash import (
     _block_mask,
     _pack,
     _pick_block,
+    _seg_uniform_eq,
 )
 from .tuning import resolve_fused
-from .fused_ring import (build_sched_table, dma_sem_wait, kernel_statics,
-                         _SENDC, _GRANTC)
+from .fused_ring import (build_sched_table, dma_sem_wait, gather_seg_table,
+                         kernel_statics, _SENDC, _GRANTC)
 from ..parallel import schedule as sched_ir
 from ..utils.compat import axis_size, tpu_compiler_params
 
@@ -154,7 +155,7 @@ def _fused_bwd_kernel(
     first_hbm, do_hbm, q_hbm, lse_hbm, k_hbm, v_hbm,
     *refs,
     prog, statics, dq_statics, scale, bq, bkv, lp, nqb, nkb, group,
-    n_b, n_h, hw_sync, collect, opt_comm,
+    n_b, n_h, hw_sync, collect, opt_comm, wnd, has_seg,
 ):
     """One grid step = bundle q-block i of head h, batch b_, bwd ring round r.
 
@@ -176,6 +177,12 @@ def _fused_bwd_kernel(
     home_banks = sorted(dq_statics["home_rounds"])
     has_dqi = dq_statics["has_dqi"]
     refs = list(refs)
+    # optional segment-id inputs ride after the six bundle/kv operands:
+    # local KV-side ids resident in VMEM, the gathered ring-wide table in
+    # ANY (roles swapped vs the forward — the ROTATING side is q here)
+    if has_seg:
+        segkv_ref = refs.pop(0)  # [1, 1, s] VMEM block: LOCAL kv ids
+        sega_hbm = refs.pop(0)   # [B, world, s, 1] ANY: every shard's ids
     # outputs first: dq per home bank, dk, dv, (slot_use)
     dq_refs = [refs.pop(0) for _ in home_banks]
     dk_ref = refs.pop(0)
@@ -209,6 +216,9 @@ def _fused_bwd_kernel(
         dqi_recv = refs.pop(0)
         free_dqi = refs.pop(0)
     home_sems = {b: refs.pop(0) for b in home_banks}
+    if has_seg:
+        segbuf = refs.pop(0)     # VMEM (s, 1) int32: this round's q ids
+        seg_sem = refs.pop(0)
     assert not refs, f"{len(refs)} scratch refs left over"
 
     LOGICAL = pltpu.DeviceIdType.LOGICAL
@@ -374,6 +384,18 @@ def _fused_bwd_kernel(
         lk.wait()
         lv.wait()
 
+    # ---- per-(round, batch) segment-id row: gathered table -> VMEM ----
+    if has_seg:
+        @pl.when((i == 0) & (h == 0))
+        def _seg_load():
+            # the rotating bundle's partition (appended table column)
+            # selects which shard's ids this round's q blocks carry
+            part = sched_ref[r, sched_ir.BWD_COLS]
+            cp = pltpu.make_async_copy(sega_hbm.at[b_, part], segbuf,
+                                       seg_sem.at[0])
+            cp.start()
+            cp.wait()
+
     # ---- per-step bundle tile loads: slot HBM -> VMEM (started in the
     # consume bank's branch, awaited unconditionally so the arriving-dq
     # load below overlaps them) ----
@@ -455,18 +477,28 @@ def _fused_bwd_kernel(
             ds.astype(ks.dtype), ks, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    segq = segbuf[pl.ds(r0, bq), :] if has_seg else None      # (bq, 1)
     for j in range(nkb):
         c0 = j * bkv
-        live = _block_has_work(spec_r, r0, c0, bq, bkv)
-        full = _block_full(spec_r, r0, c0, bq, bkv)
+        live = _block_has_work(spec_r, r0, c0, bq, bkv, wnd)
+        full = _block_full(spec_r, r0, c0, bq, bkv, wnd)
+        if has_seg:
+            segk = segkv_ref[0, :, pl.ds(c0, bkv)]            # (1, bkv)
+            seg_pair = (segq, segk)
+            # fast path also needs single-segment uniformity (see fwd)
+            fast = full & _seg_uniform_eq(segq, segk)
+        else:
+            seg_pair = None
+            fast = full
 
-        @pl.when(live & full)
+        @pl.when(live & fast)
         def _fast(c0=c0):
             _fold(c0, None)
 
-        @pl.when(live & ~full)
-        def _masked(c0=c0):
-            _fold(c0, _block_mask(spec_r, r0, c0, bq, bkv))
+        @pl.when(live & ~fast)
+        def _masked(c0=c0, seg_pair=seg_pair):
+            _fold(c0, _block_mask(spec_r, r0, c0, bq, bkv, wnd,
+                                  seg=seg_pair))
 
     # ---- dq merge: arriving partial (one hop behind) + local contribution
     # (+ the held inter partial at double-ring boundaries), staged back into
@@ -663,14 +695,17 @@ def _fused_bwd_kernel(
 # shard-level entry point
 
 
-def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
+def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, seg=None, interpret=None,
                    collect_stats=False):
     """Backward burst attention on per-shard arrays via the fused ring
     kernel — the drop-in twin of parallel/burst._bwd_impl's scan ring.
 
     Call inside shard_map on the ring axis: q/o/do [B, N, S, D], k/v
     [B, Nk, S, D], lse [B, N, S] f32 (the forward residuals in layout
-    order).  Returns (dq, dk, dv) in float32 — the caller casts back to
+    order), `seg` [B, S] optional packed-segment ids (gathered ring-wide
+    once at entry; the ROTATING side here is the q bundle, so each
+    round's q ids come off the side table and the kv ids stay local).
+    Returns (dq, dk, dv) in float32 — the caller casts back to
     the input dtypes, exactly like the scan backward — plus the kernel's
     [n_banks, slots] int32 bundle slot-consume counters when
     `collect_stats` (the devstats bwd slot-reuse channel, one row per
@@ -691,7 +726,7 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
                   if cfg.inter_axis is not None else 1)
     topology, t_inter, t_intra = resolve_topology(cfg, n_intra_ax,
                                                   n_inter_ax)
-    prog = _compile_for(cfg, topology, t_inter, t_intra, "bwd")
+    prog = _compile_for(cfg, topology, t_inter, t_intra, "bwd", s=s)
     statics = kernel_statics(prog)
     dq_statics = bwd_statics(prog)
     R = prog.n_rounds
@@ -712,7 +747,8 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
 
     # mask scalars with q/kv roles swapped: q side = rotating bundle
     # partition, kv side = resident local chunk
-    sched, _specs = build_sched_table(cfg, prog, s, s, swap_roles=True)
+    sched, _specs = build_sched_table(cfg, prog, s, s, swap_roles=True,
+                                      with_part=seg is not None)
 
     # bundle operands, pre-blocked so every slot/tile address is integer
     # indexing ([B, N, nqb, bq, D] is the same memory as [B, N, S, D]);
@@ -740,6 +776,7 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
         dq_statics=dq_statics, scale=scale, bq=bq, bkv=bkv, lp=lp, nqb=nqb,
         nkb=nkb, group=group, n_b=b, n_h=n, hw_sync=not interpret,
         collect=collect_stats, opt_comm=cfg.optimize_bwd_comm,
+        wnd=cfg.window, has_seg=seg is not None,
     )
 
     home_banks = sorted(dq_statics["home_rounds"])
@@ -824,10 +861,25 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
     for _ in home_banks:
         scratch.append(pltpu.SemaphoreType.DMA((2,)))  # home_sems[b]
 
+    in_specs = [pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)] * 6
+    inputs = [sched, first_in, do_in, q_in, lse_in, k, v]
+    if seg is not None:
+        # local KV-side ids resident per batch; the gathered table (q-side
+        # orientation: [B, world, S, 1]) stays in ANY space
+        in_specs.append(pl.BlockSpec((1, 1, s),
+                                     lambda r, b_, h, i, sp: (b_, 0, 0)))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY))
+        inputs.append(seg.astype(jnp.int32)[:, None, :])
+        inputs.append(jnp.swapaxes(gather_seg_table(seg, cfg), 2, 3))
+        scratch += [
+            pltpu.VMEM((s, 1), jnp.int32),       # segbuf
+            pltpu.SemaphoreType.DMA((1,)),       # seg_sem
+        ]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(R, b, n, nqb),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)] * 6,
+        in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=scratch,
     )
@@ -844,7 +896,7 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, interpret=None,
             collective_id=_COLLECTIVE_ID,
         ),
         interpret=interpret,
-    )(sched, first_in, do_in, q_in, lse_in, k, v)
+    )(*inputs)
     # a bidi owner receives its gradient as two complementary directional
     # partials; the sum is one fused XLA add — everything else already
     # happened in-kernel

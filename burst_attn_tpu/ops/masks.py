@@ -38,6 +38,7 @@ the ring-attention baseline (reference benchmarks/ring_attn.py).
 
 from typing import NamedTuple
 
+import numpy as np
 import jax.numpy as jnp
 
 LAYOUTS = ("contig", "zigzag", "striped")
@@ -151,6 +152,114 @@ def spec_pair_count(spec: MaskSpec, s_q: int, s_kv: int, window=None):
                        jnp.maximum(lo, rows + spec.offset - window + 1), lo)
     n = jnp.clip(hi - lo + 1, 0, s_kv)
     return jnp.sum(jnp.where(in_row, n, 0)).astype(jnp.float32)
+
+
+def _host_round_pairs(layout: str, q_part: int, kv_part: int, s: int,
+                      causal: bool, window=None) -> int:
+    """Host (numpy) twin of `spec_pair_count(round_spec(...))` for CONCRETE
+    partition ids.  live_delta_table runs from inside traced callers
+    (fused_ring.supported under shard_map), where even constant jnp ops
+    become tracers — so the occupancy table needs an all-host evaluation.
+    Mirrors round_spec's spec algebra field by field; pinned equal to the
+    traced closed form and the dense-mask sum in tests/test_masks.py."""
+    if window is not None:
+        if layout != "contig":
+            raise ValueError(
+                f"window attention supports layout='contig' only, got "
+                f"{layout!r}")
+        if not causal:
+            raise ValueError("window attention requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        q_lo, q_hi, kv_hi, caus, off = 0, s, s, 1, (q_part - kv_part) * s
+    elif not causal:
+        q_lo, q_hi, kv_hi, caus, off = 0, s, s, 0, 0
+    elif layout == "zigzag":
+        q_lo = s // 2 if kv_part > q_part else 0
+        kv_hi = s // 2 if kv_part < q_part else s
+        q_hi, caus, off = s, int(kv_part == q_part), 0
+    elif layout == "striped":
+        q_lo, q_hi, kv_hi, caus = 0, s, s, 1
+        off = 0 if kv_part <= q_part else -1
+    elif layout == "contig":
+        q_lo, kv_hi, off = 0, s, 0
+        q_hi = 0 if kv_part > q_part else s
+        caus = int(q_part == kv_part)
+    else:
+        raise ValueError(f"unknown layout {layout!r}; expected one of {LAYOUTS}")
+    rows = np.arange(s, dtype=np.int64)
+    in_row = (rows >= q_lo) & (rows < q_hi)
+    hi = np.minimum(kv_hi - 1, rows + off) if caus else np.full_like(rows, kv_hi - 1)
+    lo = np.zeros_like(rows)
+    if window is not None and caus:
+        lo = np.maximum(lo, rows + off - window + 1)
+    n = np.clip(hi - lo + 1, 0, s)
+    return int(np.sum(np.where(in_row, n, 0)))
+
+
+def live_delta_table(layout: str, s: int, world: int, *, causal: bool,
+                     window=None, max_segment_len=None):
+    """Per-ring-offset occupancy of a schedule: `live[delta]` is True iff
+    ANY device's round at ring offset `delta` (q_part - kv_part = delta mod
+    world, equal local chunk lengths `s`) attends at least one (row, col)
+    pair.  This is `spec_pair_count` — the closed-form per-round occupancy —
+    evaluated over the whole ring, and it is what the schedule compiler
+    (parallel/schedule.py) consumes to ELIDE dead rounds: a False entry
+    means no consume/send/recv/credit op for that offset anywhere on the
+    ring, so the compiled program simply omits the round.
+
+    `max_segment_len` (static int, contig layout only) adds the packed-
+    segment reach bound: two chunks `delta` apart hold tokens at least
+    `(delta-1)*s + 1` positions apart (adjacent chunks touch at distance 1),
+    and tokens of one segment are at most `max_segment_len - 1` apart — so
+    offsets past the bound cannot share a segment on any device.  It is a
+    CONTRACT about the ids the caller will feed (not validated per batch
+    under jit — document, don't trace); zigzag/striped interleave token
+    ranges per shard, so no per-offset segment bound exists there and the
+    argument is ignored for those layouts.
+
+    Offset 0 (the self round) is always live.  All inputs are concrete
+    host ints; the result is a host tuple of bools.
+    """
+    if world < 1:
+        raise ValueError(f"need world >= 1, got {world}")
+    live = [True]
+    for delta in range(1, world):
+        if not causal:
+            alive = True
+        else:
+            alive = any(
+                _host_round_pairs(layout, p, (p - delta) % world, s,
+                                  True, window=window) > 0
+                for p in range(world))
+        if (alive and max_segment_len is not None and layout == "contig"):
+            # min token distance between chunks delta apart vs the max
+            # within-segment distance; without causality the kv chunk also
+            # sits (world - delta) chunks AHEAD on wrapping devices, so the
+            # live set is a prefix+suffix band and `live_round_prefix`
+            # correctly refuses to truncate it
+            dist = (delta - 1) * s + 1
+            if not causal:
+                dist = min(dist, (world - delta - 1) * s + 1)
+            alive = dist <= max_segment_len - 1
+        live.append(bool(alive))
+    return tuple(live)
+
+
+def live_round_prefix(layout: str, s: int, world: int, *, causal: bool,
+                      window=None, max_segment_len=None) -> int:
+    """Static live-round count when the live offsets form a PREFIX
+    {0..K}: returns K + 1, or `world` (no truncation) when the live set is
+    not a prefix (zigzag/striped, or any non-band structure).  This is the
+    `r_live` the schedule compiler and the scan ring's static truncation
+    share — contig windowed rings reproduce the historical closed form
+    min(world, (s + window - 2) // s + 1) (asserted in tests)."""
+    live = live_delta_table(layout, s, world, causal=causal, window=window,
+                            max_segment_len=max_segment_len)
+    k = max(i for i, alive in enumerate(live) if alive)
+    if all(live[:k + 1]):
+        return k + 1
+    return world
 
 
 def dense_mask(spec: MaskSpec, s_q: int, s_kv: int, window=None) -> jnp.ndarray:

@@ -50,7 +50,8 @@ from jax.sharding import PartitionSpec as P
 from .. import obs
 from ..obs import devstats
 from ..ops import tile as jnp_tile
-from ..ops.masks import full_spec, round_spec, spec_live, spec_pair_count
+from ..ops.masks import (full_spec, live_round_prefix, round_spec, spec_live,
+                         spec_pair_count)
 from .ring import (ppermute_by, ppermute_next, my_partition,
                    partition_at_round, ring_round_counts)
 from ..utils.compat import axis_size, shard_map
@@ -110,6 +111,17 @@ class BurstConfig:
     # why the load-balancing permutations can't express a band); rounds
     # wholly outside the band are dead and skipped block-wise.
     window: Optional[int] = None
+    # Packed-segment length bound: a PROMISE that no segment in the
+    # segment_ids the caller feeds spans more than this many tokens.  It is
+    # a CONTRACT, not a runtime check (ids are traced values — validating
+    # per batch would defeat jit): with contig-causal single rings the
+    # occupancy compiler uses it to ELIDE ring rounds whose chunk distance
+    # exceeds the bound (ops/masks.live_delta_table), exactly like `window`
+    # elides rounds past the band.  Ids that break the promise silently
+    # drop attention pairs.  Ignored by zigzag/striped (their token
+    # interleaving defeats any per-round distance bound) and by non-causal
+    # rings (wrap-around makes the live set a non-prefix band).
+    max_segment_len: Optional[int] = None
     # Fused ring kernel knobs (backend="fused_ring" only): KV communication
     # slot count (>= 2) and the fused grid's q-row / kv-sweep blocks; None =
     # the per-TPU-generation table (ops/tuning.py resolve_fused).  The
@@ -165,6 +177,9 @@ class BurstConfig:
                 raise ValueError("window attention requires causal=True")
             if self.window < 1:
                 raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.max_segment_len is not None and self.max_segment_len < 1:
+            raise ValueError(
+                f"max_segment_len must be >= 1, got {self.max_segment_len}")
         if self.fused_topology not in ("auto", "uni", "bidi", "double"):
             raise ValueError(
                 f"fused_topology must be auto|uni|bidi|double, got "
@@ -248,12 +263,19 @@ def _sizes(cfg):
 
 
 def _r_live(cfg, s, s_kv, n_inter, n_intra):
-    """Static live-round count of a windowed SINGLE contig ring (shared by
-    fwd and bwd — the two passes' truncation must stay in lockstep with
-    ops/masks.spec_live's band algebra).  n_intra = no truncation."""
-    if (cfg.window is not None and n_inter == 1 and n_intra > 1
-            and s_kv == s):
-        return min(n_intra, (s + cfg.window - 2) // s + 1)
+    """Static live-round count of a truncatable SINGLE contig ring (shared
+    by fwd and bwd — the two passes' truncation must stay in lockstep with
+    ops/masks' occupancy algebra).  Both the window band and the
+    max_segment_len reach bound produce a live-round PREFIX on contig
+    causal rings (masks.live_round_prefix; windowed rings reproduce the
+    historical closed form min(W, (s + window - 2) // s + 1)).  n_intra =
+    no truncation."""
+    if ((cfg.window is not None or cfg.max_segment_len is not None)
+            and cfg.layout == "contig" and cfg.causal
+            and n_inter == 1 and n_intra > 1 and s_kv == s):
+        return live_round_prefix(
+            "contig", s, n_intra, causal=True, window=cfg.window,
+            max_segment_len=cfg.max_segment_len)
     return n_intra
 
 
@@ -287,7 +309,7 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None, collect=False):
 
         reason = fused_ring.supported(cfg, q.shape, k.shape, seg is not None)
         if reason is None:
-            return fused_ring.fused_ring_fwd(q, k, v, cfg,
+            return fused_ring.fused_ring_fwd(q, k, v, cfg, seg=seg,
                                              collect_stats=collect)
         logger.info("fused_ring backend falling back to the scan ring: %s",
                     reason)
@@ -473,6 +495,7 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None, collect=False):
         stats = devstats.ring_stats(
             rounds=rounds_exec, rounds_live=dv[0], attn_pairs=dv[1],
             total_pairs=float(rounds_exec) * s * k.shape[2], head_dim=d,
+            rounds_elided=n_inter * n_intra - rounds_exec,
             m=m, lse=lse, acc=acc)
         return o, lse, stats
     return o, lse
@@ -503,7 +526,8 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
         reason = fused_ring.supported(cfg, q.shape, k.shape, seg is not None,
                                       pass_="bwd")
         if reason is None:
-            return fused_ring_bwd.fused_ring_bwd(cfg, q, k, v, o, lse, do)
+            return fused_ring_bwd.fused_ring_bwd(cfg, q, k, v, o, lse, do,
+                                                 seg=seg)
         logger.info("fused_ring backward falling back to the scan ring: %s",
                     reason)
 
@@ -821,8 +845,10 @@ _FALLBACK_LABELS = (
     ("off-TPU", "off-tpu"),
     ("interpret-mode remote DMA", "interpret-single-axis"),
     ("double ring inter axis", "double-ring-axis-unbound"),
-    ("sliding window", "window"),
-    ("packed segments", "segments"),
+    # "sliding window" / "packed segments" rows are GONE: since the
+    # occupancy compiler both run fused (window as a static band predicate,
+    # segments via a gathered id side table) with dead rounds elided from
+    # the program — there is no such decline reason left to label
     ("cross-attention", "cross-attn"),
     ("world < 2", "world-lt-2"),
     ("ring axis", "multi-axis-no-mesh"),
@@ -937,6 +963,7 @@ def burst_attn(
     case_split: bool = True,
     window: Optional[int] = None,
     segment_ids=None,
+    max_segment_len: Optional[int] = None,
     fused_kv_slots: Optional[int] = None,
     fused_block_q: Optional[int] = None,
     fused_block_kv: Optional[int] = None,
@@ -960,6 +987,10 @@ def burst_attn(
     segment_ids: optional [B, S] int32 packed-sequence ids (non-negative),
     permuted into the SAME layout order as the sequence; attention never
     crosses a segment boundary — the kv-side ids ride the KV ring.
+    max_segment_len: optional PROMISE that no segment spans more than this
+    many tokens (a contract, not a runtime check — see
+    BurstConfig.max_segment_len); contig-causal single rings use it to
+    statically elide ring rounds no segment can reach.
     collect_stats: return `(o, obs.devstats.DevStats)` instead of `o` —
     in-graph ring telemetry with a leading per-device axis of length
     `world` (batch/head replica groups are pre-reduced in-graph).  Fold it
@@ -993,6 +1024,7 @@ def burst_attn(
         block_kv_bwd=block_kv_bwd,
         case_split=case_split,
         window=window,
+        max_segment_len=max_segment_len,
         fused_kv_slots=fused_kv_slots,
         fused_block_q=fused_block_q,
         fused_block_kv=fused_block_kv,

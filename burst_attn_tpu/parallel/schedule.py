@@ -340,8 +340,22 @@ def compile_fwd(topology: str, n_intra: int, n_inter: int = 1, *,
     n_intra/n_inter: ring factorization (uni/bidi use n_inter == 1; double
     requires both >= 2, world = n_inter * n_intra).  slots: payload slots
     of bank 0 (>= 2); slots1: bank 1 (default = slots for bidi, 2 for the
-    double prefetch bank).  r_live: uni only — windowed truncation keeps
-    the first r_live rounds (the scan ring's static prefix truncation).
+    double prefetch bank).
+
+    r_live: occupancy truncation (dead-round ELISION).  When the per-round
+    occupancy (ops/masks.live_round_prefix, built on spec_pair_count) says
+    only ring offsets {0..r_live-1} ever attend a pair, the compiled
+    program keeps exactly those rounds and OMITS every op of the dead
+    tail: no consume, no send/recv, no credit traffic — the elided rounds
+    do not exist in the table, so the kernel issues no RDMA and sweeps no
+    KV for them.  uni keeps its first r_live rounds; bidi degrades to the
+    cw-only prefix program (serving offsets 0..r_live-1 down one direction
+    is strictly cheaper than splitting a short prefix across two streams,
+    and the bidi interleave's tail is not a round prefix); double keeps
+    the first r_live rounds of its (cycle-major) visit order — whose flat
+    offset IS the round index, so prefix truncation applies directly, and
+    the inter prefetch for a cycle that would start at or past r_live is
+    elided with it.
     """
     if topology not in TOPOLOGIES:
         raise ScheduleError(f"unknown topology {topology!r}")
@@ -356,16 +370,25 @@ def compile_fwd(topology: str, n_intra: int, n_inter: int = 1, *,
         raise ScheduleError(
             f"double ring needs n_inter >= 2 and n_intra >= 1, got "
             f"{n_inter}x{n_intra}")
-    if r_live is not None and topology != "uni":
-        raise ScheduleError("r_live truncation is uni-only")
+    if r_live is not None:
+        if not (1 <= r_live <= world):
+            raise ScheduleError(
+                f"r_live must be in [1, world={world}], got {r_live}")
+        if r_live == world:
+            r_live = None  # no dead tail: compile the dense program
 
     if topology == "uni":
         return _compile_fwd_uni(world, slots, r_live)
     if topology == "bidi":
+        if r_live is not None:
+            # a truncated bidi degrades to the cw-only prefix program: the
+            # live offsets {0..r_live-1} all fit one direction, and the
+            # bidi interleave's own tail is not a round prefix
+            return _compile_fwd_uni(world, slots, r_live)
         return _compile_fwd_bidi(world, slots,
                                  slots if slots1 is None else slots1)
     return _compile_fwd_double(n_inter, n_intra, slots,
-                               2 if slots1 is None else slots1)
+                               2 if slots1 is None else slots1, r_live)
 
 
 def _compile_fwd_uni(world: int, slots: int, r_live=None) -> RingProgram:
@@ -455,11 +478,17 @@ def _compile_fwd_bidi(world: int, slots: int, slots1: int) -> RingProgram:
 
 
 def _compile_fwd_double(n_inter: int, n_intra: int, slots: int,
-                        slots1: int) -> RingProgram:
+                        slots1: int, r_live=None) -> RingProgram:
     if slots1 < 2:
         raise ScheduleError(f"double ring needs >= 2 prefetch slots, "
                             f"got {slots1}")
-    n_rounds = n_inter * n_intra
+    # dead-round elision: the double ring's visit order is cycle-major, so
+    # a round's flat ring offset IS its index — an occupancy prefix of
+    # r_live live offsets keeps exactly the first r_live rounds.  Every op
+    # whose PURPOSE lies past the horizon goes with them: the intra send
+    # feeding round r+1 >= r_live, and the whole inter prefetch of a cycle
+    # whose first round (c+1)*n_intra >= r_live.
+    n_rounds = n_inter * n_intra if r_live is None else r_live
     c0 = min(slots, n_intra)  # intra bank cycles within one cycle
     c1 = min(slots1, n_inter)
     rows = _blank_rows(n_rounds, FWD_COLS)
@@ -470,6 +499,8 @@ def _compile_fwd_double(n_inter: int, n_intra: int, slots: int,
         base_slot = c % c1
         for s in range(n_intra):
             r = c * n_intra + s
+            if r >= n_rounds:
+                break
             rot_i.append(c)
             rot_s.append(s)
             if s == 0:
@@ -478,7 +509,7 @@ def _compile_fwd_double(n_inter: int, n_intra: int, slots: int,
                 rows["consume_slot"][r] = base_slot
                 rows["recv"][r] = int(c > 0)
                 reads1.append((r, base_slot))
-                if c < n_inter - 1:
+                if c < n_inter - 1 and (c + 1) * n_intra < n_rounds:
                     # the signature move: next cycle's base leaves NOW, one
                     # full intra-cycle before its first-step consume
                     rows["send1"][r] = 1
@@ -486,7 +517,7 @@ def _compile_fwd_double(n_inter: int, n_intra: int, slots: int,
                     rows["dst_slot1"][r] = (c + 1) % c1
                     writes1.append((r, (c + 1) % c1))
                     reads1.append((r, base_slot))
-                if n_intra > 1:
+                if n_intra > 1 and r + 1 < n_rounds:
                     # intra ring launch: base -> intra-right's bank-0 slot
                     rows["send0"][r] = 1
                     rows["src_bank0"][r] = 1
@@ -499,7 +530,7 @@ def _compile_fwd_double(n_inter: int, n_intra: int, slots: int,
                 rows["consume_slot"][r] = slot
                 rows["recv"][r] = 1
                 reads0.append((r, slot))
-                if s < n_intra - 1:
+                if s < n_intra - 1 and r + 1 < n_rounds:
                     rows["send0"][r] = 1
                     rows["src_slot0"][r] = slot
                     rows["dst_slot0"][r] = (s + 1) % c0
@@ -523,11 +554,42 @@ def _compile_fwd_double(n_inter: int, n_intra: int, slots: int,
 
 def compile_bwd(topology: str, n_intra: int, n_inter: int = 1, *,
                 slots: int = 2, slots1: Optional[int] = None,
-                dq_slots: Optional[int] = None) -> RingProgram:
+                dq_slots: Optional[int] = None,
+                r_live: Optional[int] = None) -> RingProgram:
     """Compile a backward schedule: the bundle rotates exactly like the
     forward KV (same banks/channels/credits), and a dq plan rides along —
     one accumulating ring per direction, each one hop behind its bundle,
-    with a direct return-home RDMA at the end (see module docstring)."""
+    with a direct return-home RDMA at the end (see module docstring).
+
+    r_live: occupancy truncation (see compile_fwd).  The backward's roles
+    flip — the q bundle rotates past resident KV — so a live-offset
+    PREFIX {0..K} means the bundle must visit offsets 0..K of the OTHER
+    direction: the truncated program rotates the bundle counter-clockwise
+    for K hops (each device serves q-parts me, me+1, .., me+K in order)
+    and the dq partial rides one hop behind on the same ccw stream, with
+    a single +K return-home RDMA.  That is strictly fewer rounds, sends
+    and credits than the dense program's round-0-plus-tail live set.
+    uni/bidi only (a truncated bidi bwd uses the same single-direction
+    program); the double bwd keeps its dense dq plan — its cycle-boundary
+    folds are not prefix-truncatable — and relies on the in-kernel mask
+    predication for dead rounds.  r_live == 1 is refused: the program
+    would need a zero-offset self-home hop (callers route the self-only
+    case to the scan ring).
+    """
+    world = n_inter * n_intra
+    if r_live is not None:
+        if not (1 <= r_live <= world):
+            raise ScheduleError(
+                f"r_live must be in [1, world={world}], got {r_live}")
+        if r_live < world and topology in ("uni", "bidi"):
+            if r_live == 1:
+                raise ScheduleError(
+                    "bwd r_live truncation needs r_live >= 2 (a self-only "
+                    "ring has no dq return hop)")
+            return _compile_bwd_truncated(world, r_live, slots,
+                                          slots if dq_slots is None
+                                          else dq_slots)
+        r_live = None  # dense (r_live == world, or double: see docstring)
     fwd = compile_fwd(topology, n_intra, n_inter, slots=slots, slots1=slots1)
     n_rounds = fwd.n_rounds
     rows = {k: list(v) for k, v in fwd.rows.items()}
@@ -617,6 +679,63 @@ def compile_bwd(topology: str, n_intra: int, n_inter: int = 1, *,
         dq_slots=dq_slots_t, home_offsets=homes)
 
 
+def _compile_bwd_truncated(world: int, r_live: int, slots: int,
+                           dq_slots: int) -> RingProgram:
+    """Occupancy-truncated backward: one ccw bundle stream, one ccw dq ring.
+
+    Round j consumes the bundle of q-part me+j (rot_intra[j] = -j mod
+    world): the bundle seeds locally (copy_in), travels ccw one hop per
+    round, and stops after K = r_live - 1 hops — beyond that every q-part
+    is outside the live band on every device, so the rounds are simply
+    absent.  The dq partial for the held bundle accumulates one hop behind
+    on the same stream (seeded at round 0, ring-forwarded ccw, merged on
+    arrival), and at round K the finished partial — by then K devices
+    ccw-forward of its owner — returns home with one +K cw RDMA
+    (home_offsets (0, K)).  Credits come from the same assigners as every
+    other program; the oracle proves delivery/credits/home on the export
+    like any dense schedule."""
+    n_rounds = r_live
+    k_last = r_live - 1
+    c0 = max(min(slots, r_live), 1)
+    rows = _blank_rows(n_rounds, BWD_COLS)
+    writes = [(0, 0)]  # copy-in = version 0 of slot 0
+    reads = []
+    for j in range(n_rounds):
+        slot = j % c0
+        rows["consume_slot"][j] = slot
+        rows["recv"][j] = int(j > 0)
+        reads.append((j, slot))
+        if j < k_last:
+            rows["send0"][j] = 1
+            rows["src_slot0"][j] = slot
+            rows["dst_slot0"][j] = (j + 1) % c0
+            writes.append((j, (j + 1) % c0))
+            reads.append((j, slot))
+    grants, takes = _assign_credits(n_rounds, c0, writes, reads)
+    rows["grant0"], rows["take0"] = grants, takes
+    dq_c = min(max(2, dq_slots), r_live) if k_last else 1
+    servings = []
+    for j in range(n_rounds):
+        slot = j % dq_c
+        rows["dq_slot"][j] = slot
+        rows["dq_recv"][j] = int(j > 0)
+        servings.append((j, slot, j > 0))
+        if j < k_last:
+            rows["dq_send"][j] = DQ_RING
+            rows["dq_dst_slot"][j] = (j + 1) % dq_c
+        else:
+            rows["dq_send"][j] = DQ_HOME
+    grants, takes = _assign_dq_credits(n_rounds, servings)
+    rows["dq_grant0"], rows["dq_take0"] = grants, takes
+    return RingProgram(
+        kind="bwd", topology="uni", n_inter=1, n_intra=world,
+        slots=(c0,), channels=("ccw",), copy_in=((0, 0),),
+        rows={k: tuple(v) for k, v in rows.items()},
+        rot_inter=(0,) * n_rounds,
+        rot_intra=tuple((world - j) % world for j in range(n_rounds)),
+        dq_slots=(dq_c,), home_offsets=((0, k_last),))
+
+
 # ---------------------------------------------------------------------------
 # lowerings
 
@@ -630,13 +749,19 @@ def scan_events(program: RingProgram):
     around) but still lowers here so the verifier can account its hops."""
     ev = []
     if program.topology == "double":
-        for c in range(program.n_inter):
-            if c < program.n_inter - 1:
+        # row-driven so r_live-truncated programs account only the sends
+        # they kept; identical to the legacy cycle-major enumeration for
+        # dense programs (send1 precedes send0 within a round)
+        for r in range(program.n_rounds):
+            if program.rows["send1"][r]:
                 ev.append(("pay", "inter", 1))
-            ev += [("pay", "intra", 1)] * (program.n_intra - 1)
+            if program.rows["send0"][r]:
+                ev.append(("pay", "intra", 1))
         return ev
     if program.topology == "uni":
-        return [("pay", "intra", 1)] * (program.n_rounds - 1)
+        # the truncated bwd program rotates its single stream ccw
+        sign = -1 if program.channels == ("ccw",) else 1
+        return [("pay", "intra", sign)] * (program.n_rounds - 1)
     # bidi: one event per send, signed direction via hops +-1
     for r in range(program.n_rounds):
         if program.rows["send0"][r]:

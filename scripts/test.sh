@@ -98,11 +98,21 @@ elif [[ $schedule == 1 ]]; then
   # compiler/oracle unit tests, interpret-mode parity of the bidi and
   # double-ring fused schedules vs the scan ring + dense oracle, and the
   # schedule-proof mutation suite (flipped direction, shortened prefetch,
-  # aliased slot — each must fire).  The burstlint gate above already
-  # simulation-proved the full emitted matrix + the hardware-trace census.
+  # aliased slot, broken elider — each must fire).  The burstlint gate above
+  # already simulation-proved the full emitted matrix (including the
+  # occupancy-elided r_live entries) + the hardware-trace census.
   python -m pytest tests/test_schedule_ir.py tests/test_fused_topologies.py \
     tests/test_schedule.py -q ${filtered[@]+"${filtered[@]}"}
-  python -m pytest tests/test_analysis.py -q -k "ring_program or fused" \
+  python -m pytest tests/test_analysis.py -q -k "ring_program or fused or elision or elided" \
+    ${filtered[@]+"${filtered[@]}"}
+  # occupancy compilation: closed-form/live-set unit tests, then the
+  # elided windowed + packed-segment fused parity sweeps (incl. slow)
+  python -m pytest tests/test_masks.py -q \
+    -k "pair_count or elided or elision or truncate or segment or prefix" \
+    ${filtered[@]+"${filtered[@]}"}
+  python -m pytest tests/test_fused_ring.py tests/test_fused_ring_bwd.py \
+    tests/test_devstats.py -q \
+    -k "window or segment or elided or elision or supported" \
     ${filtered[@]+"${filtered[@]}"}
 elif [[ $fused == 1 ]]; then
   # focused lane for the fused RDMA-ring kernels' interpret-mode parity

@@ -761,3 +761,59 @@ def test_ring_program_dropped_home_hop_fires():
     prog["rows"] = {k: tuple(v) for k, v in rows.items()}
     with pytest.raises(AssertionError, match="home"):
         oracle.verify_ring_program(prog)
+
+
+# ---------------------------------------------------------------------------
+# occupancy elision (ISSUE 11): elided ring programs are proven, undercut
+# the dense remote-DMA census, and a broken elider is caught
+
+
+def test_elided_ring_program_census_undercut():
+    """Occupancy-truncated programs of every topology serve exactly the
+    live prefix (oracle-proven) and strictly undercut the dense program's
+    round count; the bidi topology also strictly undercuts the dense
+    remote-DMA census (uni's census is call-site-bounded, so only <=)."""
+    from burst_attn_tpu.parallel import schedule as sched
+
+    world, r_live = 8, 3
+    for topo, strict in (("uni", False), ("bidi", True)):
+        for compiler, payload in ((sched.compile_fwd, 2),
+                                  (sched.compile_bwd, 4)):
+            prog = compiler(topo, world, r_live=r_live)
+            dense = compiler(topo, world)
+            oracle.verify_ring_program(prog.export(),
+                                       live_deltas=tuple(range(r_live)))
+            assert prog.n_rounds < dense.n_rounds, (topo, compiler.__name__)
+            got = sched.expected_remote_dma(prog, payload)
+            ref = sched.expected_remote_dma(dense, payload)
+            assert got <= ref, (topo, compiler.__name__, got, ref)
+            if strict:
+                assert got < ref, (topo, compiler.__name__, got, ref)
+
+
+def test_elision_mutation_fires_fused_ring_schedule():
+    """Seeded-bad eliders are caught by the shared verify_elided_program
+    obligation: a compiler that fails to elide (ships the dense program)
+    keeps DEAD offsets; one that over-truncates drops LIVE offsets."""
+    from burst_attn_tpu.parallel import schedule as sched
+
+    world, r_live = 8, 3
+    good = sched.compile_fwd("uni", world, r_live=r_live)
+    assert ringcheck.verify_elided_program(good.export(), r_live,
+                                           where="mutation") == []
+    # mutation 1: no elision happened — the dense program claims r_live
+    dense = sched.compile_fwd("uni", world)
+    f1 = ringcheck.verify_elided_program(dense.export(), r_live,
+                                         where="mutation")
+    assert any(f.rule == "fused-ring-schedule" and "DEAD" in f.message
+               for f in f1), [f.format() for f in f1]
+    # mutation 2: over-eager elision dropped a live round
+    over = sched.compile_fwd("uni", world, r_live=r_live - 1)
+    f2 = ringcheck.verify_elided_program(over.export(), r_live,
+                                         where="mutation")
+    assert any(f.rule == "fused-ring-schedule" and "LIVE" in f.message
+               for f in f2), [f.format() for f in f2]
+    # same obligations hold for the backward compiler
+    f3 = ringcheck.verify_elided_program(
+        sched.compile_bwd("uni", world).export(), r_live, where="mutation")
+    assert any("DEAD" in f.message for f in f3)

@@ -289,3 +289,43 @@ def test_nonfinite_detection():
     assert int(st.nonfinite_lse) == 2
     assert int(st.nonfinite_acc) == 1
     assert float(st.lse_min) == 0.0 and float(st.lse_max) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# occupancy elision: live-vs-executed round accounting
+
+
+@pytest.mark.fused_ring
+def test_rounds_elided_live_vs_executed():
+    """Elided rounds never RAN: the in-shard round counters (incremented
+    per executed round) stop at r_live, and rounds_elided makes the split
+    sum back to the full ring on both the scan and the fused path."""
+    world = 8
+    mesh = _mesh(world)
+    ql = _qkv(world, layout="contig")
+
+    def stats(**kw):
+        _, st = burst_attn(ql, ql, ql, mesh=mesh, collect_stats=True,
+                           causal=True, layout="contig", **kw)
+        return st
+
+    r_live = masks.live_round_prefix("contig", 16, world, causal=True,
+                                     window=20)
+    assert r_live == 3  # the truncation bites: strictly fewer than world
+    for backend, field in (("jnp", "rounds"), ("fused_ring", "fused_rounds")):
+        st = stats(backend=backend, window=20)
+        executed = np.asarray(getattr(st, field))
+        assert (executed == r_live).all(), (backend, executed)
+        assert (np.asarray(st.rounds_elided) == world - r_live).all()
+
+    # packed segments under the max_segment_len contract: reach 15 < 17
+    # kills every offset past delta 1
+    seg = jnp.asarray(np.repeat(np.arange(world), 16)[None, :], jnp.int32)
+    st = stats(backend="fused_ring", segment_ids=seg, max_segment_len=16)
+    assert (np.asarray(st.fused_rounds) == 2).all()
+    assert (np.asarray(st.rounds_elided) == world - 2).all()
+
+    # dense schedules report zero elision
+    st = stats(backend="jnp")
+    assert (np.asarray(st.rounds) == world).all()
+    assert (np.asarray(st.rounds_elided) == 0).all()

@@ -191,15 +191,25 @@ def test_fallback_double_ring():
                 rtol=2e-4, atol=2e-4, msg="double-ring fallback")
 
 
-def test_fallback_window_and_segments():
+def test_window_and_segments_dispatch_fused():
+    """Since the occupancy compiler, windowed and packed-segment contig
+    rings RUN FUSED (the historical scan fallback rows are gone): the
+    dispatch counter must record path=fused and no window/segments
+    fallback reason exists to count, while staying correct vs the dense
+    oracle."""
+    from burst_attn_tpu import obs
+
     world, b, n, d = 8, 1, 2, 16
     S = 16 * world
     mesh = _mesh(world)
     q, k, v, _ = random_qkv(KEY, b, n, S, d, dtype=jnp.float32)
+    lab = dict(path="fused", backend="fused_ring", tile="jnp")
+    before = obs.counter("burst.dispatch").get(**lab)
     o = burst_attn(q, k, v, mesh=mesh, seq_axes=("sp",), causal=True,
                    layout="contig", backend="fused_ring", window=24)
     check_close(o, dense_attention(q, k, v, causal=True, window=24),
-                rtol=2e-4, atol=2e-4, msg="window fallback")
+                rtol=2e-4, atol=2e-4, msg="window fused")
+    assert obs.counter("burst.dispatch").get(**lab) == before + 1
 
     seg = jnp.concatenate(
         [jnp.zeros((b, S // 2), jnp.int32), jnp.ones((b, S - S // 2), jnp.int32)],
@@ -207,14 +217,20 @@ def test_fallback_window_and_segments():
     o = burst_attn(q, k, v, mesh=mesh, seq_axes=("sp",), causal=True,
                    layout="contig", backend="fused_ring", segment_ids=seg)
     check_close(o, dense_attention(q, k, v, causal=True, segment_ids=seg),
-                rtol=2e-4, atol=2e-4, msg="segments fallback")
+                rtol=2e-4, atol=2e-4, msg="segments fused")
+    assert obs.counter("burst.dispatch").get(**lab) == before + 2
+    # the stale decline reasons must be gone from the bounded label map
+    assert not any(lbl in ("window", "segments")
+                   for _, lbl in burst._FALLBACK_LABELS)
 
 
 def test_supported_reasons():
     """The dispatch gate's reason strings: every fallback row of the doc's
     matrix (docs/fused_ring.md) declines for the documented reason, and the
-    supported config returns None — checked inside the trace context the
-    gate runs in."""
+    supported configs return None — checked inside the trace context the
+    gate runs in.  Windowed and packed-segment contig rings are ADMITTED
+    since the occupancy compiler (the gate compiles an elided schedule for
+    them instead of declining)."""
     from burst_attn_tpu.ops import fused_ring
 
     mesh = _mesh(4)
@@ -244,7 +260,52 @@ def test_supported_reasons():
     x = jnp.zeros((1, 2, 64, 8), jnp.float32)
     jax.eval_shape(fn, x, x, x)
     assert reasons["ok"] is None
-    assert "window" in reasons["window"]
-    assert "segments" in reasons["segments"]
+    # window/segments are no longer decline reasons: the occupancy
+    # compiler admits both (dead-round elision handles the sparsity)
+    assert reasons["window"] is None
+    assert reasons["segments"] is None
     assert "double ring" in reasons["double"]
     assert "cross" in reasons["cross"]
+
+
+# ---------------------------------------------------------------------------
+# occupancy-elided schedules (ISSUE 11): windowed / packed-segment contig
+# rings run the fused kernel on a truncated program.  Fast canaries above
+# (test_window_and_segments_dispatch_fused); the sweeps ride the slow lane.
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("topo", ["uni", "bidi"])
+@pytest.mark.parametrize("window", [1, 20, 40])
+def test_windowed_fused_parity_sweep(topo, window):
+    """Elided windowed schedules across truncation depths (r_live 1, 3 and
+    4 of 8 rounds) on both single-ring topologies vs the dense banded
+    oracle."""
+    world, b, n, d = 8, 1, 2, 16
+    S = 16 * world
+    mesh = _mesh(world)
+    q, k, v, _ = random_qkv(KEY, b, n, S, d, dtype=jnp.float32)
+    o = burst_attn(q, k, v, mesh=mesh, seq_axes=("sp",), causal=True,
+                   layout="contig", backend="fused_ring", window=window,
+                   fused_topology=topo)
+    check_close(o, dense_attention(q, k, v, causal=True, window=window),
+                rtol=2e-4, atol=2e-4, msg=f"win{window} {topo}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("parts,msl", [(8, 16), (4, 32), (2, 64)])
+def test_segment_elided_fused_parity_sweep(parts, msl):
+    """Packed segments under the max_segment_len contract at several
+    truncation depths (r_live 2, 3, 5 of 8) vs the dense segment-masked
+    oracle."""
+    world, b, n, d = 8, 1, 2, 16
+    S = 16 * world
+    mesh = _mesh(world)
+    q, k, v, _ = random_qkv(KEY, b, n, S, d, dtype=jnp.float32)
+    seg = jnp.asarray(np.repeat(np.arange(parts), S // parts)[None, :],
+                      jnp.int32)
+    o = burst_attn(q, k, v, mesh=mesh, seq_axes=("sp",), causal=True,
+                   layout="contig", backend="fused_ring", segment_ids=seg,
+                   max_segment_len=msl)
+    check_close(o, dense_attention(q, k, v, causal=True, segment_ids=seg),
+                rtol=2e-4, atol=2e-4, msg=f"seg parts={parts} msl={msl}")

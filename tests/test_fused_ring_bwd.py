@@ -296,13 +296,17 @@ def _grad_check(mesh, seq_axes, layout, kw, q, k, v, do, world, tag,
         check_close(got, want, rtol=2e-4, atol=2e-4, msg=f"{tag} d{nm}")
 
 
-def test_fallback_window_grad():
+def test_window_grad_dispatch_fused():
+    """window=24 on a contig causal ring is ADMITTED by the occupancy
+    compiler (r_live=3 of 8 rounds), so this now exercises the FUSED
+    truncated backward — not the scan fallback — end to end through
+    jax.grad."""
     world, b, n, d = 8, 1, 2, 16
     S = 16 * world
     mesh = _mesh(world)
     q, k, v, do = random_qkv(KEY, b, n, S, d, dtype=jnp.float32)
     _grad_check(mesh, ("sp",), "contig", dict(window=24), q, k, v, do, world,
-                "window fallback", window=24)
+                "window fused grad", window=24)
 
 
 def test_fallback_double_ring_grad():
@@ -317,7 +321,8 @@ def test_fallback_double_ring_grad():
 
 def test_supported_bwd_reasons():
     """The extended gate: pass_="bwd" declines for the same documented
-    structural reasons as the forward, admits the supported config, and
+    structural reasons as the forward, admits the supported configs
+    (including windowed/segmented rings since the occupancy compiler), and
     rejects an unknown pass loudly."""
     from burst_attn_tpu.ops import fused_ring
 
@@ -334,6 +339,12 @@ def test_supported_bwd_reasons():
         reasons["window"] = fused_ring.supported(
             dataclasses.replace(base, layout="contig", window=8),
             q.shape, k.shape, False, pass_="bwd")
+        # degenerate truncation: window=1 leaves only the self round
+        # (r_live == 1) and a single-round ring has no return hop for dq,
+        # so the schedule compiler declines the backward
+        reasons["window1"] = fused_ring.supported(
+            dataclasses.replace(base, layout="contig", window=1),
+            q.shape, k.shape, False, pass_="bwd")
         reasons["segments"] = fused_ring.supported(base, q.shape, k.shape,
                                                    True, pass_="bwd")
         reasons["double"] = fused_ring.supported(
@@ -349,8 +360,14 @@ def test_supported_bwd_reasons():
     x = jnp.zeros((1, 2, 64, 8), jnp.float32)
     jax.eval_shape(fn, x, x, x)
     assert reasons["ok"] is None
-    assert "window" in reasons["window"]
-    assert "segments" in reasons["segments"]
+    # window/segments are ADMITTED since the occupancy compiler: the gate
+    # compiles an elided (or dense, for zigzag segments) schedule instead
+    # of declining
+    assert reasons["window"] is None
+    assert reasons["segments"] is None
+    # ... except the degenerate r_live == 1 truncation, which the schedule
+    # compiler itself declines for the backward
+    assert "declined" in reasons["window1"]
     assert "double ring" in reasons["double"]
     assert "cross" in reasons["cross"]
     with pytest.raises(ValueError):
@@ -359,3 +376,35 @@ def test_supported_bwd_reasons():
         fused_ring.supported(
             burst.BurstConfig(intra_axis="sp"), (1, 2, 64, 8), (1, 2, 64, 8),
             False, pass_="sideways")
+
+
+# ---------------------------------------------------------------------------
+# occupancy-elided backward (ISSUE 11): fast canaries here, sweeps slow
+
+
+def test_segments_elided_grad_dispatch_fused():
+    """Packed segments + the max_segment_len contract: the truncated fused
+    backward (r_live=2 of 8) reproduces the dense segment-masked grads."""
+    world, b, n, d = 8, 1, 2, 16
+    S = 16 * world
+    mesh = _mesh(world)
+    q, k, v, do = random_qkv(KEY, b, n, S, d, dtype=jnp.float32)
+    seg = jnp.asarray(np.repeat(np.arange(world), 16)[None, :], jnp.int32)
+    _grad_check(mesh, ("sp",), "contig", dict(segment_ids=seg), q, k, v, do,
+                world, "seg elided grad", segment_ids=seg,
+                max_segment_len=16)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("topo", ["uni", "bidi"])
+@pytest.mark.parametrize("window", [20, 40])
+def test_windowed_grad_parity_sweep(topo, window):
+    """Truncated fused backward across window depths and both single-ring
+    topologies vs the dense banded oracle's grads."""
+    world, b, n, d = 8, 1, 2, 16
+    S = 16 * world
+    mesh = _mesh(world)
+    q, k, v, do = random_qkv(KEY, b, n, S, d, dtype=jnp.float32)
+    _grad_check(mesh, ("sp",), "contig", dict(window=window), q, k, v, do,
+                world, f"win{window} {topo} grad", window=window,
+                fused_topology=topo)

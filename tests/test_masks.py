@@ -65,3 +65,140 @@ def test_spec_live():
     # non-causal full tiles are always live
     spec = round_spec(jnp.int32(3), jnp.int32(0), s, s, False, "contig")
     assert bool(spec_live(spec))
+
+
+# -- occupancy compilation: the closed-form per-round pair count and the
+# live-offset tables the schedule compiler elides dead rounds from
+
+
+def _pairs_3way(layout, qp, kp, s, causal, window=None):
+    """(traced closed form, host twin, dense-mask sum) for one round."""
+    from burst_attn_tpu.ops.masks import (_host_round_pairs, round_spec,
+                                          spec_pair_count)
+
+    spec = round_spec(jnp.int32(qp), jnp.int32(kp), s, s, causal, layout,
+                      window=window)
+    traced = int(np.asarray(spec_pair_count(spec, s, s, window=window)))
+    host = _host_round_pairs(layout, qp, kp, s, causal, window=window)
+    dense = int(np.asarray(dense_mask(spec, s, s, window=window)).sum())
+    return traced, host, dense
+
+
+@pytest.mark.parametrize("layout", ["contig", "zigzag", "striped"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_pair_count_closed_form_equals_dense_sum(layout, causal):
+    """spec_pair_count (the traced O(s) closed form), its host numpy twin
+    _host_round_pairs (what live_delta_table evaluates inside shard_map
+    traces), and the materialized dense-mask sum must agree on every
+    (q_part, kv_part) pair of every layout."""
+    s, W = 16, 4
+    for qp in range(W):
+        for kp in range(W):
+            traced, host, dense = _pairs_3way(layout, qp, kp, s, causal)
+            assert traced == host == dense, (layout, causal, qp, kp)
+
+
+@pytest.mark.parametrize("window", [1, 4, 16, 17, 40])
+def test_pair_count_windowed_contig(window):
+    """Windowed occupancy (contig-only by design: round_spec rejects the
+    zigzag/striped permutations) — same 3-way agreement, plus the global
+    ground truth from token order."""
+    s, W = 16, 4
+    S = s * W
+    for qp in range(W):
+        for kp in range(W):
+            traced, host, dense = _pairs_3way("contig", qp, kp, s, True,
+                                              window=window)
+            qa = np.arange(qp * s, (qp + 1) * s)[:, None]
+            kb = np.arange(kp * s, (kp + 1) * s)[None, :]
+            want = int(((kb <= qa) & (qa - kb <= window - 1)).sum())
+            assert traced == host == dense == want, (window, qp, kp)
+
+
+@pytest.mark.parametrize("window,s,world", [
+    (1, 16, 8), (4, 16, 8), (16, 16, 8), (20, 16, 8), (24, 16, 8),
+    (17, 16, 4), (100, 16, 8), (7, 8, 6), (1000, 16, 8),
+])
+def test_windowed_zero_rounds_are_exactly_the_elided_rounds(window, s, world):
+    """The compiler's truncation point: ring offsets whose closed-form
+    occupancy is zero on EVERY device are exactly the offsets >=
+    live_round_prefix, which reproduces the historical closed form
+    min(world, (s + window - 2) // s + 1)."""
+    from burst_attn_tpu.ops.masks import (_host_round_pairs,
+                                          live_round_prefix)
+
+    r_live = live_round_prefix("contig", s, world, causal=True, window=window)
+    assert r_live == min(world, (s + window - 2) // s + 1)
+    for delta in range(world):
+        occ = sum(_host_round_pairs("contig", p, (p - delta) % world, s,
+                                    True, window=window)
+                  for p in range(world))
+        assert (occ > 0) == (delta < r_live), (delta, occ, r_live)
+
+
+@pytest.mark.parametrize("layout", ["zigzag", "striped"])
+def test_nonband_layouts_never_truncate(layout):
+    """zigzag/striped interleave token ranges per shard: every ring offset
+    is live, so live_round_prefix refuses to truncate."""
+    from burst_attn_tpu.ops.masks import live_delta_table, live_round_prefix
+
+    assert live_delta_table(layout, 16, 8, causal=True) == (True,) * 8
+    assert live_round_prefix(layout, 16, 8, causal=True) == 8
+
+
+@pytest.mark.parametrize("L,s,world,want", [
+    (1, 16, 8, 1),    # self round only: tokens of a segment 0 apart
+    (16, 16, 8, 2),   # reach 15 < 17 = min dist at delta 2
+    (17, 16, 8, 2),
+    (18, 16, 8, 3),   # reach 17 >= 17
+    (20, 16, 8, 3),
+    (33, 16, 8, 3),
+    (34, 16, 8, 4),
+    (128, 16, 8, 8),  # a segment can span the whole ring: no truncation
+])
+def test_segment_reach_prefix(L, s, world, want):
+    """max_segment_len reach bound: chunks delta apart hold tokens at least
+    (delta-1)*s + 1 positions apart; live iff that <= L - 1 — and the live
+    set is a prefix, matching the independent dense adversarial derivation
+    in analysis/oracle.py (worst case over all segment phase offsets)."""
+    from burst_attn_tpu.analysis.oracle import live_rounds_contig_seg
+    from burst_attn_tpu.ops.masks import live_delta_table, live_round_prefix
+
+    r_live = live_round_prefix("contig", s, world, causal=True,
+                               max_segment_len=L)
+    assert r_live == want
+    live = live_delta_table("contig", s, world, causal=True,
+                            max_segment_len=L)
+    assert live == tuple(d < want for d in range(world))
+    assert live_rounds_contig_seg(s * world, world, L) == set(range(want))
+
+
+def test_segment_noncausal_wrap_is_not_a_prefix():
+    """Without causality the kv chunk also sits (world - delta) chunks
+    AHEAD on wrapping devices, so the live set is a prefix+suffix band;
+    live_round_prefix must refuse to truncate it (return world)."""
+    from burst_attn_tpu.ops.masks import live_delta_table, live_round_prefix
+
+    live = live_delta_table("contig", 16, 8, causal=False,
+                            max_segment_len=16)
+    # delta=1 (behind) and delta=7 (1 ahead after wrap) are live; the
+    # middle offsets are beyond any segment's reach
+    assert live == (True, True, False, False, False, False, False, True)
+    assert live_round_prefix("contig", 16, 8, causal=False,
+                             max_segment_len=16) == 8
+
+
+def test_elided_program_serves_exactly_the_live_offsets():
+    """End of the chain: the compiled RingProgram's served ring offsets are
+    exactly the nonzero-occupancy offsets — zero-reported rounds are the
+    compiler-elided rounds, nothing more, nothing less."""
+    from burst_attn_tpu.analysis.oracle import served_deltas
+    from burst_attn_tpu.ops.masks import live_round_prefix
+    from burst_attn_tpu.parallel.schedule import compile_fwd
+
+    s, world = 16, 8
+    for window, L in ((20, None), (None, 18), (1, None)):
+        r_live = live_round_prefix("contig", s, world, causal=True,
+                                   window=window, max_segment_len=L)
+        prog = compile_fwd("uni", world, r_live=r_live)
+        assert served_deltas(prog.export()) == set(range(r_live)), (window, L)

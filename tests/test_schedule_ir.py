@@ -150,8 +150,14 @@ def test_compiler_rejects_bad_shapes():
         schedule.compile_fwd("double", 4, 1)  # nothing to nest
     with pytest.raises(schedule.ScheduleError):
         schedule.compile_fwd("double", 4, 2, slots1=1)
+    # truncation is no longer rejected on bidi: a truncated bidi degrades
+    # to the cw-only uni prefix program (the live offsets fit one
+    # direction; the bidi interleave's tail is not a round prefix)
+    bidi_cut = schedule.compile_fwd("bidi", 4, r_live=2)
+    assert bidi_cut.export() == schedule.compile_fwd(
+        "uni", 4, r_live=2).export()
     with pytest.raises(schedule.ScheduleError):
-        schedule.compile_fwd("bidi", 4, r_live=2)  # truncation is uni-only
+        schedule.compile_fwd("bidi", 4, r_live=0)  # still bounds-checked
 
 
 def test_credit_assignment_catches_unread_overwrite():
