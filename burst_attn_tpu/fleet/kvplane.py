@@ -1,0 +1,197 @@
+"""The KV transfer plane: pool pages on the wire, transactionally.
+
+`ring_prefill_to_pages` (serving/handoff.py) lands a prompt's K/V in the
+PREFILL worker's pool pages, in layout order.  To hand the request to a
+decode replica on another host those pages must move — and the handoff's
+permutation-invariance argument (decode attends every cached position;
+validity is table membership, never ordering) means they move VERBATIM:
+page j of the slot's table row on the prefill side becomes page j of the
+replica's table row, whatever physical pool ids each side assigned.  No
+re-layout, no reordering, byte-identical payloads — the tests compare
+`page_bytes` on both ends.
+
+The transfer is TRANSACTIONAL on the receive side:
+
+    kv_begin(meta)  ->  stage (zero pool mutation)
+    kv_page(j) x n  ->  stage (zero pool mutation)
+    commit()        ->  precondition-check, acquire, scatter, table row
+    abort()         ->  drop staging (zero pool mutation, nothing leaks)
+
+`commit` checks EVERY precondition (page-shape match, table width, live
+slot, pool availability for pages + the decode budget) before acquiring
+a single page, and releases on any scatter failure — an aborted or
+half-shipped transfer leaves both pools exactly as they were, which the
+fleet's kill-mid-transfer tests assert as "zero page leaks".
+"""
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+from ..models.paged_decode import PagePool, PagedState, _write_table_row
+
+M_KV_PAGES_SHIPPED = obs.counter(
+    "fleet.kv_pages_shipped", "pool pages serialized onto the wire")
+M_KV_BYTES_SHIPPED = obs.counter(
+    "fleet.kv_bytes_shipped", "KV payload bytes serialized")
+M_KV_COMMITTED = obs.counter(
+    "fleet.kv_transfers_committed", "transfers admitted by a replica")
+M_KV_ABORTED = obs.counter(
+    "fleet.kv_transfers_aborted", "transfers aborted with staging dropped")
+
+
+def export_slot_pages(state: PagedState, slot: int) -> Tuple[dict, List[dict]]:
+    """Serialize one live slot's pages in TABLE ORDER.
+
+    Returns (meta, pages): meta describes the stream (page geometry,
+    layer/head counts, dtype, token length); pages[j] holds table column
+    j's per-layer K and V arrays [n_kv, page, d_head] as numpy — page j
+    on the wire is position range [j*page, (j+1)*page) in layout order,
+    exactly what the sender's table row j pointed at."""
+    if state.k_scales is not None:
+        raise ValueError("KV plane ships full-precision pools only "
+                         "(quantized transfer is a future lever)")
+    length = int(state.lengths[slot])
+    if length == 0:
+        raise ValueError(f"slot {slot} is not live; nothing to export")
+    page = int(state.k_pages[0].shape[2])
+    n_pages = -(-length // page)
+    row = np.asarray(state.page_table[slot])[:n_pages]
+    k_host = [np.asarray(kp) for kp in state.k_pages]
+    v_host = [np.asarray(vp) for vp in state.v_pages]
+    meta = {
+        "length": length,
+        "page": page,
+        "n_pages": int(n_pages),
+        "n_layers": len(state.k_pages),
+        "n_kv": int(state.k_pages[0].shape[1]),
+        "d_head": int(state.k_pages[0].shape[3]),
+        "dtype": str(np.asarray(state.k_pages[0]).dtype),
+    }
+    pages = []
+    for j, pid in enumerate(row):
+        pg = {"k": [k_host[li][int(pid)] for li in range(meta["n_layers"])],
+              "v": [v_host[li][int(pid)] for li in range(meta["n_layers"])]}
+        pages.append(pg)
+        M_KV_PAGES_SHIPPED.inc()
+        M_KV_BYTES_SHIPPED.inc(sum(a.nbytes for a in pg["k"] + pg["v"]))
+    return meta, pages
+
+
+def page_bytes(pg: dict) -> bytes:
+    """Canonical byte string of one page message (k then v, layer
+    order) — the unit the byte-identity tests and `page_digest` hash."""
+    return b"".join(np.ascontiguousarray(a).tobytes()
+                    for a in list(pg["k"]) + list(pg["v"]))
+
+
+def page_digest(pg: dict) -> str:
+    return hashlib.sha256(page_bytes(pg)).hexdigest()
+
+
+class KvReceiver:
+    """Staging area + transactional commit on the decode side.  Staging
+    never touches the pool; only `commit` does, and only after every
+    precondition passes."""
+
+    def __init__(self):
+        self._staging: Dict[int, dict] = {}
+
+    def begin(self, rid: int, meta: dict) -> None:
+        # a re-shipped attempt for the same rid replaces stale staging
+        self._staging[rid] = {"meta": dict(meta), "pages": {}}
+
+    def add_page(self, rid: int, j: int, pg: dict) -> None:
+        st = self._staging.get(rid)
+        if st is None:
+            raise KeyError(f"kv_page for rid {rid} with no kv_begin")
+        meta = st["meta"]
+        want = (meta["n_kv"], meta["page"], meta["d_head"])
+        for a in list(pg["k"]) + list(pg["v"]):
+            if tuple(np.shape(a)) != want:
+                raise ValueError(f"page {j} shape {np.shape(a)} != {want}")
+        if len(pg["k"]) != meta["n_layers"] \
+                or len(pg["v"]) != meta["n_layers"]:
+            raise ValueError(f"page {j} layer count mismatch")
+        st["pages"][int(j)] = pg
+
+    def complete(self, rid: int) -> bool:
+        st = self._staging.get(rid)
+        return (st is not None
+                and len(st["pages"]) == st["meta"]["n_pages"]
+                and all(j in st["pages"]
+                        for j in range(st["meta"]["n_pages"])))
+
+    def staged(self, rid: int) -> Optional[dict]:
+        return self._staging.get(rid)
+
+    def staging_count(self) -> int:
+        return len(self._staging)
+
+    def abort(self, rid: int) -> bool:
+        """Drop staging for `rid`.  Pool untouched by construction."""
+        dropped = self._staging.pop(rid, None) is not None
+        if dropped:
+            M_KV_ABORTED.inc()
+        return dropped
+
+    def commit(self, rid: int, state: PagedState, pool: PagePool,
+               slot: int) -> PagedState:
+        """Scatter the staged pages into `slot`: all preconditions
+        up-front, acquire-scatter-table under release-on-failure, then
+        drop staging.  Raises with ZERO pool mutation when the transfer
+        cannot be admitted (incomplete staging, live slot, table
+        overflow, pool exhaustion)."""
+        st = self._staging.get(rid)
+        if st is None:
+            raise KeyError(f"commit for rid {rid} with no staging")
+        if not self.complete(rid):
+            raise ValueError(
+                f"rid {rid} staged {len(st['pages'])}/"
+                f"{st['meta']['n_pages']} pages; transfer incomplete")
+        meta = st["meta"]
+        n = int(meta["n_pages"])
+        page = int(state.k_pages[0].shape[2])
+        if meta["page"] != page:
+            raise ValueError(f"sender page size {meta['page']} != pool "
+                             f"page size {page}")
+        if len(state.k_pages) != meta["n_layers"]:
+            raise ValueError("layer count mismatch")
+        if n > state.page_table.shape[1]:
+            raise ValueError(f"transfer needs {n} pages > table width "
+                             f"{state.page_table.shape[1]}")
+        if int(state.lengths[slot]) != 0:
+            raise RuntimeError(f"slot {slot} is still live; retire it first")
+        if pool.available < n:
+            raise RuntimeError(f"page pool exhausted: want {n}, have "
+                               f"{pool.available}")
+        ids = pool.acquire(n)
+        try:
+            idx = jnp.asarray(ids, jnp.int32)
+            k_pages, v_pages = list(state.k_pages), list(state.v_pages)
+            for li in range(meta["n_layers"]):
+                k_stack = np.stack([st["pages"][j]["k"][li]
+                                    for j in range(n)])
+                v_stack = np.stack([st["pages"][j]["v"][li]
+                                    for j in range(n)])
+                dt = k_pages[li].dtype
+                k_pages[li] = k_pages[li].at[idx].set(
+                    jnp.asarray(k_stack, dt))
+                v_pages[li] = v_pages[li].at[idx].set(
+                    jnp.asarray(v_stack, dt))
+            state = PagedState(tuple(k_pages), tuple(v_pages),
+                               state.page_table, state.lengths,
+                               state.k_scales, state.v_scales)
+            table = _write_table_row(state, slot, idx)
+            lengths = state.lengths.at[slot].set(int(meta["length"]))
+            state = PagedState(state.k_pages, state.v_pages, table,
+                               lengths, state.k_scales, state.v_scales)
+        except Exception:
+            pool.release(ids)
+            raise
+        del self._staging[rid]
+        M_KV_COMMITTED.inc()
+        return state

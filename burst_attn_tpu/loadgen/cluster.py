@@ -42,15 +42,18 @@ never exit.  hb_timeout_s defaults generous: a worker blocks on its
 first jit compile without draining its queue, and that must not read as
 death.
 
-Wire-safety note: worker->router messages are small (a done record for a
-canary request pickles well under PIPE_BUF = 4096 bytes; resume prefixes
-are bounded by max_new_tokens), so kernel pipe writes are atomic and a
-SIGKILL cannot tear a frame mid-message; each worker also gets its OWN
-result queue so a dead worker's stream never interleaves with a live
-one's.  Torn-write hazards that DO exist — a kill mid `export_jsonl` or
-mid journal append — land in files whose readers
-(`obs.aggregate.load_records_tolerant`, `checkpoint.read_journal`) are
-torn-tail tolerant by contract.
+Wire-safety note: router<->worker messages travel as CRC-framed
+transport frames (fleet/transport.py, QueueTransport over the spawn
+queues — the identical protocol the socket fleet ships cross-host), so
+every delivered message is integrity-checked, and the frames stay small
+(a done record for a canary request is well under PIPE_BUF = 4096
+bytes; resume prefixes are bounded by max_new_tokens) so kernel pipe
+writes are atomic and a SIGKILL cannot tear a frame mid-message; each
+worker also gets its OWN result queue so a dead worker's stream never
+interleaves with a live one's.  Torn-write hazards that DO exist — a
+kill mid `export_jsonl` or mid journal append — land in files whose
+readers (`obs.aggregate.load_records_tolerant`,
+`checkpoint.read_journal`) are torn-tail tolerant by contract.
 
 Every worker exports obs JSONL snapshots (`obs_w{wid}.jsonl`; restart
 replacements get generation-suffixed files so a dead life's last export
@@ -61,7 +64,6 @@ loadgen/slo.py evaluates.
 
 import multiprocessing as mp
 import os
-import queue
 import signal
 import time
 from dataclasses import dataclass, field
@@ -69,11 +71,13 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..fleet.transport import QueueTransport, TransportError
 from .driver import DONE, REJECTED, SHED, Outcome, ReplayReport, RetryBackoff
 from .trace import Trace
 from .worker import worker_main
 
-FAULT_KINDS = ("kill", "hog", "unhog", "stall", "hang", "restart")
+FAULT_KINDS = ("kill", "hog", "unhog", "stall", "hang", "restart",
+               "raise")
 
 
 @dataclass(frozen=True)
@@ -184,7 +188,9 @@ class LoadGenCluster:
         self._procs: Dict[int, mp.Process] = {}
         self._req_q: Dict[int, object] = {}
         self._res_q: Dict[int, object] = {}
+        self._tr: Dict[int, QueueTransport] = {}
         self._alive: set = set()
+        self.worker_errors: List[tuple] = []   # (wid, message) from stop()
         self._gen: Dict[int, int] = {}       # wid -> restart generation
         self._obs_files: List[str] = []
 
@@ -231,6 +237,8 @@ class LoadGenCluster:
                          "resume": self.resume, "restore": restore}
         self._req_q[wid] = self._ctx.Queue()
         self._res_q[wid] = self._ctx.Queue()
+        self._tr[wid] = QueueTransport(send_q=self._req_q[wid],
+                                       recv_q=self._res_q[wid])
         proc = self._ctx.Process(
             target=worker_main,
             args=(wid, self.model_spec, self.engine_spec, path,
@@ -279,20 +287,36 @@ class LoadGenCluster:
         SIGKILL where not.  Idempotent."""
         for wid in sorted(self._alive):
             try:
-                self._req_q[wid].put(("stop",))
-            except (OSError, ValueError):
+                self._send(wid, ("stop",))
+            except TransportError:
                 self._alive.discard(wid)
         deadline = time.monotonic() + timeout_s
         pending = set(self._alive)
         while pending and time.monotonic() < deadline:
             for wid in sorted(pending):
-                if not self._procs[wid].is_alive():
-                    pending.discard(wid)
-                    continue
+                alive = self._procs[wid].is_alive()
                 msg = self._poll(wid)
-                if msg is not None and msg[0] == "stopped":
+                if msg is None:
+                    if not alive:
+                        pending.discard(wid)
+                    continue
+                if msg[0] == "stopped":
                     pending.discard(wid)
+                elif msg[0] == "error":
+                    # a worker erroring DURING stop still reports — its
+                    # error frame is evidence, not noise (satellite: the
+                    # old loop dropped these on the floor)
+                    self.worker_errors.append((wid, msg[2]))
             time.sleep(0.01)
+        # final drain: a worker that flushed its error frame and died
+        # before we polled must not lose it to the terminate below
+        for wid in sorted(self._tr):
+            while True:
+                msg = self._poll(wid)
+                if msg is None:
+                    break
+                if msg[0] == "error":
+                    self.worker_errors.append((wid, msg[2]))
         for wid, proc in self._procs.items():
             if proc.is_alive():
                 proc.terminate()
@@ -305,12 +329,17 @@ class LoadGenCluster:
     # -- plumbing ----------------------------------------------------------
 
     def _poll(self, wid: int):
-        try:
-            return self._res_q[wid].get_nowait()
-        except queue.Empty:
-            return None
-        except (OSError, EOFError, ValueError):
-            return None  # queue torn down under us (dead worker)
+        return self._tr[wid].recv()
+
+    def _send(self, wid: int, msg) -> None:
+        self._tr[wid].send(msg)
+
+    def inject_fault(self, wid: int, kind: str, arg: float = 0.0) -> None:
+        """Send one fault message outside a replay schedule (tests use
+        this to provoke shutdown races, e.g. kind="raise" then stop())."""
+        if kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        self._send(wid, ("fault", kind, arg))
 
     def _kill(self, wid: int) -> None:
         proc = self._procs[wid]
@@ -421,7 +450,7 @@ class LoadGenCluster:
                    req.max_new_tokens)
             if resume_toks:
                 msg = msg + ([int(x) for x in resume_toks],)
-            self._req_q[wid].put(msg)
+            self._send(wid, msg)
             return True
 
         def settle(msg) -> None:
@@ -596,7 +625,7 @@ class LoadGenCluster:
                         reap(ev.worker, t, ev, detected="scheduled-kill")
                 else:
                     fault_q.pop(0)
-                    self._req_q[ev.worker].put(("fault", ev.kind, ev.arg))
+                    self._send(ev.worker, ("fault", ev.kind, ev.arg))
             # 2) unscheduled deaths (crash ≠ kill fault, same recovery)
             for wid in sorted(self._alive):
                 if not self._procs[wid].is_alive():
@@ -614,9 +643,9 @@ class LoadGenCluster:
                 hb_seq += 1
                 for wid in sorted(self._alive):
                     try:
-                        self._req_q[wid].put(("ping", hb_seq))
-                    except (OSError, ValueError):
-                        pass
+                        self._send(wid, ("ping", hb_seq))
+                    except TransportError:
+                        pass  # dying worker; the liveness reap covers it
                 for wid in sorted(self._alive):
                     if now_w - last_pong.get(wid, now_w) > self.hb_timeout_s:
                         self._kill(wid)

@@ -2,10 +2,13 @@
 
 Each worker is an isolated OS process (multiprocessing `spawn` context —
 a clean interpreter, its own single-process CPU JAX runtime, its own obs
-registry) running one serve engine and a small message loop:
+registry) running one serve engine and a small message loop.  Messages
+travel as CRC-framed transport frames (fleet/transport.py,
+QueueTransport over the spawn queues — the same protocol the socket
+fleet speaks cross-host):
 
   router -> worker   ("submit", rrid, prompt list, max_new[, resume_toks])
-                     ("fault", fault_kind, arg)   hog | unhog | stall | hang
+                     ("fault", fault_kind, arg)   hog|unhog|stall|hang|raise
                      ("ping", seq)                heartbeat probe
                      ("stop",)                    finish backlog, export, exit
   worker -> router   ("ready", wid, pid)
@@ -16,6 +19,12 @@ registry) running one serve engine and a small message loop:
                      ("pong", wid, seq)
                      ("stopped", wid)
                      ("error", wid, message)      engine loop blew up
+
+The "error" path is ordered for shutdown races: the worker exports its
+obs snapshot FIRST (never a torn registry export), then sends the error
+frame, then flushes the transport so the frame survives the process
+dying immediately after — a worker erroring DURING stop still reports,
+and the router's stop() collects it instead of dropping it.
 
 Request ids on the wire are the ROUTER's (trace rids): the worker maps
 its engine's local rids back before reporting, so the router never sees
@@ -62,7 +71,6 @@ cooperation is required.
 """
 
 import os
-import queue
 import time
 
 
@@ -127,6 +135,9 @@ def worker_main(wid: int, model_spec: dict, engine_spec: dict,
     # must land before the jax import inside build_engine: the cluster is
     # a CPU-mesh harness even on a TPU host
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ..fleet.transport import QueueTransport
+
+    tr = QueueTransport(send_q=result_q, recv_q=request_q)
     try:
         ck = dict(ckpt_spec) if ckpt_spec else None
         journal = None
@@ -156,7 +167,7 @@ def worker_main(wid: int, model_spec: dict, engine_spec: dict,
                 claimed = sorted(
                     {rid_map.get(r.rid, r.rid) for r in live}
                     | set(info.done))
-                result_q.put(("restored", wid, {
+                tr.send(("restored", wid, {
                     "claimed": claimed,
                     "replayed": {int(k): int(v)
                                  for k, v in info.replayed.items()},
@@ -166,13 +177,13 @@ def worker_main(wid: int, model_spec: dict, engine_spec: dict,
                 }))
                 # requests the journal proves complete need no engine time
                 for ext, toks in sorted(info.done.items()):
-                    result_q.put(("done", wid, int(ext),
+                    tr.send(("done", wid, int(ext),
                                   [int(t) for t in toks]))
             else:
                 journal = ckpt.TokenJournal(ck["journal"], truncate=True)
                 eng.journal = journal
         _export(obs_path, wid)  # baseline: even an early kill leaves a file
-        result_q.put(("ready", wid, os.getpid()))
+        tr.send(("ready", wid, os.getpid()))
         hogged = []                   # pages held by the "hog" fault
         stall_until = 0.0
         hang = False
@@ -186,91 +197,93 @@ def worker_main(wid: int, model_spec: dict, engine_spec: dict,
                 # heartbeat detector can declare this worker gone
                 time.sleep(0.05)
                 continue
-            try:
-                while True:
-                    msg = request_q.get_nowait()
-                    op = msg[0]
-                    if op == "submit":
-                        rrid, prompt, max_new = msg[1], msg[2], msg[3]
-                        resume_toks = msg[4] if len(msg) > 4 else None
-                        if resume_toks and ck is not None \
-                                and ck.get("resume", True):
-                            comp = ckpt.trim_complete(
-                                resume_toks, max_new, eng.eos_id)
-                            if comp is not None:
-                                # the dead worker journaled past the finish
-                                # line — complete with zero engine time
-                                ckpt.M_RECOVERED_RESUMED.inc(len(comp))
-                                result_q.put(("accepted", wid, rrid))
-                                result_q.put(("done", wid, rrid,
-                                              [int(t) for t in comp]))
-                                continue
-                            res = eng.try_submit(
-                                list(prompt) + [int(t) for t in resume_toks],
-                                max_new - len(resume_toks))
-                            if res.ok:
-                                ckpt.M_RECOVERED_RESUMED.inc(
-                                    len(resume_toks))
-                                rid_map[res.rid] = rrid
-                                resume_prefix[res.rid] = \
-                                    [int(t) for t in resume_toks]
-                                if journal is not None:
-                                    # journal the ORIGINAL request shape so
-                                    # a second recovery composes
-                                    journal.submit(res.rid, rrid, prompt,
-                                                   max_new)
-                                    journal.tokens(res.rid, resume_toks)
-                                    journal.sync()
-                                result_q.put(("accepted", wid, rrid))
-                            else:
-                                result_q.put((
-                                    "rejected", wid, rrid,
-                                    res.reason.value if res.reason else None,
-                                    res.retryable, res.message))
+            while True:
+                msg = tr.recv()
+                if msg is None:
+                    break
+                op = msg[0]
+                if op == "submit":
+                    rrid, prompt, max_new = msg[1], msg[2], msg[3]
+                    resume_toks = msg[4] if len(msg) > 4 else None
+                    if resume_toks and ck is not None \
+                            and ck.get("resume", True):
+                        comp = ckpt.trim_complete(
+                            resume_toks, max_new, eng.eos_id)
+                        if comp is not None:
+                            # the dead worker journaled past the finish
+                            # line — complete with zero engine time
+                            ckpt.M_RECOVERED_RESUMED.inc(len(comp))
+                            tr.send(("accepted", wid, rrid))
+                            tr.send(("done", wid, rrid,
+                                          [int(t) for t in comp]))
+                            continue
+                        res = eng.try_submit(
+                            list(prompt) + [int(t) for t in resume_toks],
+                            max_new - len(resume_toks))
+                        if res.ok:
+                            ckpt.M_RECOVERED_RESUMED.inc(
+                                len(resume_toks))
+                            rid_map[res.rid] = rrid
+                            resume_prefix[res.rid] = \
+                                [int(t) for t in resume_toks]
+                            if journal is not None:
+                                # journal the ORIGINAL request shape so
+                                # a second recovery composes
+                                journal.submit(res.rid, rrid, prompt,
+                                               max_new)
+                                journal.tokens(res.rid, resume_toks)
+                                journal.sync()
+                            tr.send(("accepted", wid, rrid))
                         else:
-                            if resume_toks and ck is not None:
-                                # resume disabled: the baseline path —
-                                # every journaled token gets re-decoded
-                                ckpt.M_RECOVERED_REPLAYED.inc(
-                                    len(resume_toks))
-                            res = eng.try_submit(prompt, max_new)
-                            if res.ok:
-                                rid_map[res.rid] = rrid
-                                if journal is not None:
-                                    journal.submit(res.rid, rrid, prompt,
-                                                   max_new)
-                                    journal.sync()
-                                result_q.put(("accepted", wid, rrid))
-                            else:
-                                result_q.put((
-                                    "rejected", wid, rrid,
-                                    res.reason.value if res.reason else None,
-                                    res.retryable, res.message))
-                    elif op == "ping":
-                        result_q.put(("pong", wid, msg[1]))
-                    elif op == "fault":
-                        _, fkind, arg = msg
-                        if fkind == "hog":
-                            n = min(int(arg), eng.pool.available)
-                            if n > 0:
-                                hogged += list(eng.pool.acquire(n))
-                        elif fkind == "unhog":
-                            if hogged:
-                                eng.pool.release(hogged)
-                                hogged = []
-                        elif fkind == "stall":
-                            stall_until = time.monotonic() + float(arg)
-                        elif fkind == "hang":
-                            hang = True
-                        else:
-                            result_q.put(("error", wid,
-                                          f"unknown fault {fkind!r}"))
-                    elif op == "stop":
-                        stopping = True
+                            tr.send((
+                                "rejected", wid, rrid,
+                                res.reason.value if res.reason else None,
+                                res.retryable, res.message))
                     else:
-                        result_q.put(("error", wid, f"unknown op {op!r}"))
-            except queue.Empty:
-                pass
+                        if resume_toks and ck is not None:
+                            # resume disabled: the baseline path —
+                            # every journaled token gets re-decoded
+                            ckpt.M_RECOVERED_REPLAYED.inc(
+                                len(resume_toks))
+                        res = eng.try_submit(prompt, max_new)
+                        if res.ok:
+                            rid_map[res.rid] = rrid
+                            if journal is not None:
+                                journal.submit(res.rid, rrid, prompt,
+                                               max_new)
+                                journal.sync()
+                            tr.send(("accepted", wid, rrid))
+                        else:
+                            tr.send((
+                                "rejected", wid, rrid,
+                                res.reason.value if res.reason else None,
+                                res.retryable, res.message))
+                elif op == "ping":
+                    tr.send(("pong", wid, msg[1]))
+                elif op == "fault":
+                    _, fkind, arg = msg
+                    if fkind == "hog":
+                        n = min(int(arg), eng.pool.available)
+                        if n > 0:
+                            hogged += list(eng.pool.acquire(n))
+                    elif fkind == "unhog":
+                        if hogged:
+                            eng.pool.release(hogged)
+                            hogged = []
+                    elif fkind == "stall":
+                        stall_until = time.monotonic() + float(arg)
+                    elif fkind == "hang":
+                        hang = True
+                    elif fkind == "raise":
+                        raise RuntimeError(
+                            "injected worker fault (raise)")
+                    else:
+                        tr.send(("error", wid,
+                                      f"unknown fault {fkind!r}"))
+                elif op == "stop":
+                    stopping = True
+                else:
+                    tr.send(("error", wid, f"unknown op {op!r}"))
             if time.monotonic() < stall_until:
                 time.sleep(0.002)
                 continue
@@ -278,7 +291,7 @@ def worker_main(wid: int, model_spec: dict, engine_spec: dict,
                 for erid, toks in eng.step():
                     full = resume_prefix.pop(erid, []) \
                         + [int(t) for t in toks]
-                    result_q.put(("done", wid, rid_map.pop(erid), full))
+                    tr.send(("done", wid, rid_map.pop(erid), full))
                     n_since_export += 1
                     n_since_ckpt += 1
                 if ck is not None and ck.get("snapshot") \
@@ -295,13 +308,22 @@ def worker_main(wid: int, model_spec: dict, engine_spec: dict,
                 if journal is not None:
                     journal.close()
                 _export(obs_path, wid)
-                result_q.put(("stopped", wid))
+                tr.send(("stopped", wid))
                 return
             else:
                 time.sleep(0.002)
     except Exception as e:  # noqa: BLE001 — report, then die visibly
+        # obs snapshot FIRST (a torn registry export must never be the
+        # price of an error), then the error frame, then a flush so the
+        # frame survives this process dying right after
         try:
-            result_q.put(("error", wid, f"{type(e).__name__}: {e}"))
-        except Exception:  # noqa: BLE001
+            _export(obs_path, wid)
+        except Exception as ee:  # noqa: BLE001 — export is best-effort
+            os.write(2, f"loadgen worker {wid}: obs export failed: "
+                        f"{ee}\n".encode())
+        try:
+            tr.send(("error", wid, f"{type(e).__name__}: {e}"))
+            tr.flush()
+        except Exception:  # noqa: BLE001 — router gone; stderr is all
             os.write(2, f"loadgen worker {wid}: {e}\n".encode())
         raise

@@ -27,7 +27,8 @@ policy, the handoff diagram, and the recovery protocol.
 
 from .engine import RaggedServeEngine
 from .model import ragged_model_step
-from .handoff import handoff_decode, handoff_generate, ring_prefill_to_pages
+from .handoff import check_handoff_preconditions, handoff_decode, \
+    handoff_generate, ring_prefill_to_pages
 from .checkpoint import (
     RecoveryInfo, TokenJournal, journal_tokens_by_ext, journal_view,
     load_paged_snapshot, load_snapshot, read_journal, recover_engine,
@@ -39,6 +40,7 @@ __all__ = [
     "RaggedServeEngine",
     "RecoveryInfo",
     "TokenJournal",
+    "check_handoff_preconditions",
     "handoff_decode",
     "handoff_generate",
     "journal_tokens_by_ext",

@@ -47,6 +47,47 @@ from ..parallel import layouts
 from ..parallel.burst import burst_attn
 
 
+def check_handoff_preconditions(state: PagedState, pool: PagePool,
+                                slot: int, n_tokens: int,
+                                cfg: ModelConfig, *, steps: int = 0) -> int:
+    """Validate EVERY admission precondition for a handoff — prompt
+    shape, window mode, slot state, table width, and pool availability
+    for prefill pages PLUS the decode budget (`steps`) — before a single
+    page is acquired or a single state field mutated.
+
+    Callers rely on the zero-mutation guarantee: any raise here leaves
+    pool occupancy and state byte-for-byte unchanged, so a rejected
+    request can be retried or re-routed with nothing to clean up.
+    Returns the number of prefill pages the prompt needs."""
+    page = int(state.k_pages[0].shape[2])
+    if cfg.window is not None:
+        raise ValueError("ring_prefill_to_pages requires cfg.window=None "
+                         "(layout-order pages; see module docstring)")
+    if n_tokens <= 0:
+        raise ValueError(f"empty prompt (n_tokens={n_tokens})")
+    if n_tokens % page:
+        raise ValueError(f"prompt length {n_tokens} must be a multiple of "
+                         f"the page size {page} for the direct-scatter "
+                         f"handoff")
+    if steps < 0:
+        raise ValueError(f"negative decode budget ({steps})")
+    if not 0 <= slot < state.lengths.shape[0]:
+        raise ValueError(f"slot {slot} out of range "
+                         f"[0, {state.lengths.shape[0]})")
+    n_prefill = n_tokens // page
+    n_total = -(-(n_tokens + steps) // page)
+    if n_total > state.page_table.shape[1]:
+        raise ValueError(f"request needs {n_total} pages (prompt "
+                         f"{n_prefill} + decode budget {steps} tokens) > "
+                         f"table width {state.page_table.shape[1]}")
+    if int(state.lengths[slot]) != 0:
+        raise RuntimeError(f"slot {slot} is still live; retire it first")
+    if pool.available < n_total:
+        raise RuntimeError(f"page pool exhausted: want {n_total}, have "
+                           f"{pool.available}")
+    return n_prefill
+
+
 def ring_prefill_to_pages(params, tokens, state: PagedState, pool: PagePool,
                           slot: int, cfg: ModelConfig, mesh):
     """Absorb a [S] prompt into batch slot `slot` with the ring-sharded
@@ -58,22 +99,11 @@ def ring_prefill_to_pages(params, tokens, state: PagedState, pool: PagePool,
     S must be a page multiple (ring shards are page-aligned by
     construction: S divides by the sp world and page | S/world in any
     deployment this path targets) and cfg.window must be None (see the
-    module docstring's permutation-invariance argument).
-    """
+    module docstring's permutation-invariance argument).  All
+    preconditions are checked up-front (`check_handoff_preconditions`);
+    any rejection leaves the pool untouched."""
     t = int(tokens.shape[0])
-    page = state.k_pages[0].shape[2]
-    if cfg.window is not None:
-        raise ValueError("ring_prefill_to_pages requires cfg.window=None "
-                         "(layout-order pages; see module docstring)")
-    if t % page:
-        raise ValueError(f"prompt length {t} must be a multiple of the "
-                         f"page size {page} for the direct-scatter handoff")
-    n_need = t // page
-    if n_need > state.page_table.shape[1]:
-        raise ValueError(f"prompt needs {n_need} pages > table width "
-                         f"{state.page_table.shape[1]}")
-    if int(state.lengths[slot]) != 0:
-        raise RuntimeError(f"slot {slot} is still live; retire it first")
+    n_need = check_handoff_preconditions(state, pool, slot, t, cfg)
     ids = pool.acquire(n_need)
     try:
         logits, state = _ring_prefill_jit(
@@ -154,9 +184,20 @@ def handoff_generate(params, prompt, state: PagedState, pool: PagePool,
     paged decode steps.  Returns ([steps] tokens, final state).
 
     Greedy/sampled semantics are decode.sample_logits's; the decode loop
-    is a python loop over one jitted step (static shapes — no retrace)."""
+    is a python loop over one jitted step (static shapes — no retrace).
+
+    Admission is all-or-nothing: the decode budget is validated together
+    with the prefill's page needs BEFORE the ring pass runs, so a
+    request whose budget cannot fit (table width or pool availability)
+    rejects with zero pool mutation — previously the provision ran after
+    prefill had already acquired pages and made the slot live, leaking
+    them on rejection."""
     from ..models.decode import sample_logits
 
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    check_handoff_preconditions(state, pool, slot, int(prompt.shape[0]),
+                                cfg, steps=steps)
     rng = jax.random.PRNGKey(0) if rng is None else rng
     last_logits, state = ring_prefill_to_pages(
         params, prompt, state, pool, slot, cfg, mesh)

@@ -29,7 +29,20 @@ itself are also written (results/loadgen_slo.json,
 results/traces/loadgen_bench.jsonl) so a regression can be diagnosed
 from artifacts alone.
 
+`--fleet` runs the disaggregated prefill/decode fleet phase instead
+(the `scripts/test.sh --fleet` lane): a fixed page-aligned trace
+replayed across a real prefill pool + decode replica pool (spawned
+processes, KV pages shipped over the frame transport), token-exact vs
+the single-process oracle, then the same trace with a decode replica
+SIGKILLed mid-stream and journal-resumed on its sibling:
+
+  headline_fleet_goodput.json     serve.fleet_goodput tokens/s
+                                  (direction: higher)
+  headline_fleet_recovery.json    serve.fleet_recovery_p99 seconds
+                                  (direction: lower)
+
     python scripts/bench_loadgen.py [--requests 24] [--speed 50] [--out results]
+    python scripts/bench_loadgen.py --fleet [--requests 8] [--out results]
 """
 
 import argparse
@@ -48,9 +61,14 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=11)
     ap.add_argument("--speed", type=float, default=50.0)
     ap.add_argument("--out", default=os.path.join(ROOT, "results"))
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the disaggregated fleet phase instead "
+                         "(serve.fleet_goodput / serve.fleet_recovery_p99)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.fleet:
+        return _fleet_phase(args)
     import jax
 
     from burst_attn_tpu import obs
@@ -178,6 +196,102 @@ def main(argv=None) -> int:
           f"rejected / {report.n_shed} shed, wall {report.wall_s:.2f}s, "
           "token-exact vs oracle")
     print(format_slo(slo))
+    return 0
+
+
+def _fleet_phase(args) -> int:
+    """The --fleet bench: clean fleet replay for goodput, then a decode
+    SIGKILL mid-stream for the recovery headline.  Both phases are
+    token-exact vs the single-process oracle or the bench fails."""
+    # the oracle's sp=2 mesh and every spawned worker (which inherits
+    # this environment) need the simulated multi-device host platform
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    from burst_attn_tpu.fleet import FleetCluster, FleetFault, fleet_oracle
+    from burst_attn_tpu.loadgen.slo import recovery_stats
+    from burst_attn_tpu.loadgen.trace import Trace, TraceRequest
+
+    model_spec = dict(vocab=97, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_head=16, d_ff=64, block_q=8,
+                      block_kv=8, seed=0)
+    pspec = dict(sp=2, page=128, n_pages=4, max_pages_per_seq=8)
+    dspec = dict(sp=2, slots=2, page=128, n_pages=8, max_pages_per_seq=4)
+    n = max(4, min(args.requests, 12))  # page-aligned prompts are heavy
+    reqs = [TraceRequest(rid=i, t_arrival=0.05 * i, prompt_len=128,
+                         prompt_seed=args.seed * 1000 + i,
+                         max_new_tokens=6)
+            for i in range(n)]
+    trace = Trace(meta={"vocab": 97, "label": "fleet-bench"}, requests=reqs)
+    oracle_toks, _ = fleet_oracle(trace, model_spec, prefill_spec=pspec,
+                                  decode_spec=dspec)
+
+    def check(rep):
+        for rid, o in rep.outcomes.items():
+            assert o.status == "done", (rid, o)
+            assert o.tokens == oracle_toks[rid], \
+                (rid, o.tokens, oracle_toks[rid])
+
+    with FleetCluster(model_spec, prefill_spec=pspec, decode_spec=dspec,
+                      n_prefill=1, n_decode=2,
+                      out_dir=os.path.join(args.out, "fleet_bench"),
+                      transport="queue", checkpoint_every=1) as fc:
+        rep = fc.replay(trace, speed=args.speed, max_wall_s=600.0)
+    check(rep)
+    tokens = sum(len(o.tokens) for o in rep.outcomes.values())
+    goodput = tokens / rep.wall_s if rep.wall_s > 0 else 0.0
+
+    with FleetCluster(model_spec, prefill_spec=pspec, decode_spec=dspec,
+                      n_prefill=1, n_decode=2,
+                      out_dir=os.path.join(args.out, "fleet_bench_kill"),
+                      transport="queue", checkpoint_every=1) as fc:
+        krep = fc.replay(trace, [FleetFault(t=0.2, pool="decode", worker=0,
+                                            kind="kill",
+                                            note="bench recovery kill")],
+                         speed=args.speed, max_wall_s=600.0)
+    check(krep)
+    assert krep.kills, "fault phase recorded no kill"
+    rec = recovery_stats(krep.recovery_s())
+    recovery_p99 = float(rec["recovery_p99_s"])
+    platform = jax.devices()[0].platform
+
+    os.makedirs(args.out, exist_ok=True)
+    records = [
+        ("headline_fleet_goodput.json", {
+            "metric": f"serve.fleet_goodput tokens/s @ fleet trace "
+                      f"seed={args.seed} n={n} 1p+2d {platform}",
+            "value": round(goodput, 3), "unit": "tokens/s",
+            "direction": "higher", "timestamp": time.time(),
+            "note": "bench_loadgen.py --fleet — disaggregated replay, KV "
+                    "pages over the frame transport, token-exact vs "
+                    "oracle"}),
+        ("headline_fleet_recovery.json", {
+            "metric": "serve.fleet_recovery_p99 s @ fleet trace "
+                      f"seed={args.seed} kill d0 1p+2d {platform}",
+            "value": round(recovery_p99, 6), "unit": "s",
+            "direction": "lower", "timestamp": time.time(),
+            "note": "bench_loadgen.py --fleet — p99 virtual span from "
+                    "decode SIGKILL to last journal-resumed completion "
+                    "(token-exact vs oracle)"}),
+    ]
+    for name, rec_obj in records:
+        path = os.path.join(args.out, name)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(rec_obj, f, indent=1)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        print(f"bench_loadgen: {rec_obj['metric']} = {rec_obj['value']} "
+              f"-> {path}")
+    print(f"bench_loadgen: fleet {len(rep.outcomes)} done clean "
+          f"(wall {rep.wall_s:.2f}s) + {len(krep.outcomes)} done through "
+          f"kill (wall {krep.wall_s:.2f}s), token-exact vs oracle, "
+          f"resumed={krep.recovered_tokens_resumed} "
+          f"replayed={krep.recovered_tokens_replayed}")
     return 0
 
 

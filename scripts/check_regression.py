@@ -119,9 +119,10 @@ def _cached_note(rec):
 
 
 def check(headlines, history, tolerance, max_cached_age=None):
-    """[(status, line)] verdicts; status in PASS/REGRESSION/NO-HISTORY/
-    STALE-CACHE.  STALE-CACHE entries are warnings riding NEXT TO the
-    metric's real verdict — they never gate."""
+    """[(status, line, direction)] verdicts; status in PASS/REGRESSION/
+    NO-HISTORY/STALE-CACHE, direction in "higher"/"lower" (the metric's
+    regression sense).  STALE-CACHE entries are warnings riding NEXT TO
+    the metric's real verdict — they never gate."""
     verdicts = []
     for path, metric, value, rec in headlines:
         note = _cached_note(rec)
@@ -131,11 +132,12 @@ def check(headlines, history, tolerance, max_cached_age=None):
         # the default "higher" (throughput-style) regresses DOWN past a
         # floor.  History's best follows the same sense.
         lower = str(rec.get("direction", "higher")).lower() == "lower"
+        sense = "lower" if lower else "higher"
         if prior is None:
             verdicts.append(("NO-HISTORY",
                              f"NO-HISTORY  {metric}: {value:g} "
                              f"({os.path.basename(path)}){note} — nothing "
-                             "to compare against"))
+                             "to compare against", sense))
         else:
             best, source = (min if lower else max)(prior,
                                                    key=lambda vs: vs[0])
@@ -153,9 +155,9 @@ def check(headlines, history, tolerance, max_cached_age=None):
                     f"tolerance {tolerance:g}"
                     + (", direction=lower)" if lower else ")"))
             if regressed:
-                verdicts.append(("REGRESSION", f"REGRESSION  {line}"))
+                verdicts.append(("REGRESSION", f"REGRESSION  {line}", sense))
             else:
-                verdicts.append(("PASS", f"PASS        {line}"))
+                verdicts.append(("PASS", f"PASS        {line}", sense))
         if (max_cached_age is not None and rec.get("cached")
                 and float(rec.get("cached_age_hours", float("inf")))
                 > max_cached_age):
@@ -164,7 +166,7 @@ def check(headlines, history, tolerance, max_cached_age=None):
                 "STALE-CACHE",
                 f"STALE-CACHE {metric}: replayed record is {age}h old "
                 f"(> --max-cached-age {max_cached_age:g}) — warn only; "
-                "land a fresh on-chip run to refresh the cache"))
+                "land a fresh on-chip run to refresh the cache", sense))
     return verdicts
 
 
@@ -219,8 +221,8 @@ def main(argv=None) -> int:
         print(f"check_regression: {e}", file=sys.stderr)
         return 2
 
-    regressed = [line for st, line in verdicts if st == "REGRESSION"]
-    stale = [line for st, line in verdicts if st == "STALE-CACHE"]
+    regressed = [line for st, line, _ in verdicts if st == "REGRESSION"]
+    stale = [line for st, line, _ in verdicts if st == "STALE-CACHE"]
     gate_fail = bool(regressed) or (args.strict_cache and bool(stale))
     exit_code = 1 if gate_fail and not args.dry_run else 0
     summary = {
@@ -231,13 +233,13 @@ def main(argv=None) -> int:
         "n_stale_cached": len(stale),
         "exit_code": exit_code,
         "gate": "FAIL" if gate_fail else "PASS",
-        "verdicts": [{"status": st, "detail": line}
-                     for st, line in verdicts],
+        "verdicts": [{"status": st, "detail": line, "direction": sense}
+                     for st, line, sense in verdicts],
     }
     if args.as_json:
         print(json.dumps(summary, indent=1))
     else:
-        for _, line in verdicts:
+        for _, line, _ in verdicts:
             print(line)
         print(f"check_regression: {len(regressed)} regression(s), "
               f"{len(stale)} stale-cache "

@@ -16,7 +16,15 @@ replay-from-scratch baseline (strictly fewer on at least one seed —
 the resume-not-replay acceptance property).  A torn final journal line
 is injected on every seed and must be tolerated.
 
+`--transport-seeds N` additionally fuzzes the fleet wire protocol
+(burst_attn_tpu.fleet.transport): per seed a random message stream is
+framed, then truncated / bit-flipped / duplicated; the FrameBuffer must
+drop every corrupted frame on CRC (never accepting mangled bytes),
+count torn tails, dedup redelivery by (rid, seq), and a simulated
+sender-retry pass must complete the message set byte-exactly.
+
     python scripts/fuzz_checkpoint.py [--seeds 3] [--requests 4]
+                                      [--transport-seeds 0]
 """
 
 import argparse
@@ -115,21 +123,144 @@ def run_seed(seed: int, n_requests: int, out_dir: str) -> dict:
     return results
 
 
+def run_transport_seed(seed: int, n_messages: int = 24) -> dict:
+    """One seeded fuzz round over the fleet frame transport.
+
+    Builds `n_messages` framed messages (mixed msgpack/JSON codecs, each
+    carrying an ndarray payload keyed by (rid, seq)), then mutates the
+    byte stream: random frames get a payload bit flipped (framing stays
+    intact, so the CRC MUST reject them — a flipped frame being accepted
+    is the one unforgivable outcome), random clean frames are duplicated
+    (Dedup must drop the repeat), and the stream may be truncated mid-
+    frame (torn tail, counted).  Whatever went missing is then "resent"
+    clean — the retry path — after which the receiver must hold exactly
+    the original message set, byte-identical.  Raises AssertionError on
+    any violation; returns per-seed stats."""
+    import numpy as np
+
+    from burst_attn_tpu.fleet import transport as tp
+
+    rng = np.random.default_rng([0xF1EE7, int(seed)])
+    originals = {}
+    frames = []
+    for seq in range(n_messages):
+        rid = int(rng.integers(0, 4))
+        arr = rng.integers(0, 256, size=int(rng.integers(1, 64)),
+                           dtype=np.int64).astype(np.uint8)
+        originals[(rid, seq)] = arr
+        frames.append(tp.pack_frame(tp.encode_message(
+            ("blob", rid, seq, arr),
+            force_json=bool(rng.integers(0, 2)))))
+
+    # -- mutate: bit-flip some payloads, duplicate some clean frames ----
+    flipped = {i for i in range(n_messages) if rng.random() < 0.25}
+    mutated = []
+    flip_extents = []  # (start, end) of each flipped frame in the stream
+    pos = 0
+    n_dups = 0
+    for i, fr in enumerate(frames):
+        if i in flipped:
+            fr = bytearray(fr)
+            # flip strictly inside the payload so framing stays intact:
+            # the frame parses but its CRC check must fail
+            off = tp._HEADER.size + int(
+                rng.integers(0, len(fr) - tp._HEADER.size))
+            fr[off] ^= 1 << int(rng.integers(0, 8))
+            fr = bytes(fr)
+            flip_extents.append((pos, pos + len(fr)))
+            mutated.append(fr)
+            pos += len(fr)
+        else:
+            mutated.append(fr)
+            pos += len(fr)
+            if rng.random() < 0.25:
+                mutated.append(fr)  # redelivery: Dedup's job
+                pos += len(fr)
+                n_dups += 1
+    stream = b"".join(mutated)
+    cut = None
+    if rng.random() < 0.5:  # tear the tail mid-frame
+        cut = int(rng.integers(max(1, len(stream) // 2), len(stream)))
+        stream = stream[:cut]
+
+    # -- receive the mangled stream in random-sized chunks --------------
+    fb = tp.FrameBuffer()
+    dd = tp.Dedup()
+    accepted = {}
+    dup_dropped = 0
+
+    def drain():
+        nonlocal dup_dropped
+        while fb.frames:
+            _, rid, seq, arr = tp.decode_message(fb.frames.popleft())
+            if not dd.accept(rid, seq):
+                dup_dropped += 1
+                continue
+            accepted[(rid, seq)] = np.asarray(arr)
+
+    off = 0
+    while off < len(stream):
+        step = int(rng.integers(1, 1 << 12))
+        fb.feed(stream[off:off + step])
+        off += step
+        drain()
+    fb.eof()
+    drain()
+
+    for key, arr in accepted.items():  # NEVER accept corrupted bytes
+        assert np.array_equal(arr, originals[key]), \
+            f"seed={seed}: corrupted payload accepted for {key}"
+    n_flips_fed = sum(end <= len(stream) for _, end in flip_extents)
+    assert fb.crc_rejected == n_flips_fed, \
+        (f"seed={seed}: {n_flips_fed} flipped frames fed but "
+         f"{fb.crc_rejected} CRC-rejected")
+
+    # -- sender retry: re-ship everything unacked, clean ----------------
+    missing = sorted(set(originals) - set(accepted))
+    for rid, seq in missing:
+        fb.feed(tp.pack_frame(tp.encode_message(
+            ("blob", rid, seq, originals[(rid, seq)]))))
+    drain()
+    assert set(accepted) == set(originals), \
+        f"seed={seed}: retry left {set(originals) - set(accepted)} missing"
+    for key, arr in accepted.items():
+        assert np.array_equal(arr, originals[key]), \
+            f"seed={seed}: post-retry payload mismatch for {key}"
+    return dict(n_frames=n_messages, flipped=len(flipped), dups=n_dups,
+                crc_rejected=fb.crc_rejected, torn=fb.torn,
+                dup_dropped=dup_dropped, resent=len(missing),
+                truncated_at=cut)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python scripts/fuzz_checkpoint.py")
     ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--requests", type=int, default=4)
+    ap.add_argument("--transport-seeds", type=int, default=0,
+                    help="also fuzz the fleet frame transport for N seeds "
+                         "(truncate / bit-flip / duplicate mutations)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     failures = 0
-    any_strict = False
+    any_strict = args.seeds == 0  # strict-resume property needs ckpt seeds
     with tempfile.TemporaryDirectory(prefix="ckpt_fuzz_") as td:
         for seed in range(args.seeds):
             for label, r in run_seed(seed, args.requests, td).items():
                 if not r["exact"] or r["replayed"] > r["baseline"]:
                     failures += 1
                 any_strict = any_strict or r["strict"]
+    for seed in range(args.transport_seeds):
+        try:
+            st = run_transport_seed(seed)
+        except AssertionError as e:
+            print(f"  transport seed={seed}: FAIL {e}")
+            failures += 1
+            continue
+        print(f"  transport seed={seed}: OK "
+              f"flipped={st['flipped']} crc_rejected={st['crc_rejected']} "
+              f"dups={st['dups']}/{st['dup_dropped']} torn={st['torn']} "
+              f"resent={st['resent']}")
     if not any_strict:
         print("fuzz_checkpoint: FAIL — no seed demonstrated strict "
               "resume-not-replay (replayed < baseline)")
@@ -137,8 +268,14 @@ def main(argv=None) -> int:
     if failures:
         print(f"fuzz_checkpoint: {failures} FAILURES")
         return 1
-    print(f"fuzz_checkpoint: {args.seeds} seeds x 2 recovery paths "
-          "token-exact, recomputation bounded by journal lag")
+    parts = []
+    if args.seeds:
+        parts.append(f"{args.seeds} seeds x 2 recovery paths token-exact, "
+                     "recomputation bounded by journal lag")
+    if args.transport_seeds:
+        parts.append(f"{args.transport_seeds} transport seeds clean "
+                     "(CRC rejects, dedup holds, retry completes)")
+    print("fuzz_checkpoint: " + "; ".join(parts))
     return 0
 
 

@@ -7,7 +7,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 args=("$@")
 filtered=()
-fast=0; tpu=0; fused=0; obs=0; schedule=0; serve=0; loadgen=0
+fast=0; tpu=0; fused=0; obs=0; schedule=0; serve=0; loadgen=0; fleet=0
 for a in "${args[@]}"; do
   case "$a" in
     --fast) fast=1 ;;
@@ -17,6 +17,7 @@ for a in "${args[@]}"; do
     --schedule) schedule=1 ;;
     --serve) serve=1 ;;
     --loadgen) loadgen=1 ;;
+    --fleet) fleet=1 ;;
     *) filtered+=("$a") ;;
   esac
 done
@@ -93,6 +94,29 @@ elif [[ $loadgen == 1 ]]; then
   python scripts/check_regression.py \
     --headline 'results/headline_loadgen_*.json' \
     --strict-cache --summary-json results/loadgen_gate.json
+elif [[ $fleet == 1 ]]; then
+  # disaggregated prefill/decode fleet lane: the wire-protocol unit +
+  # fuzz canaries, then the FULL cross-boundary fault matrix (kill /
+  # restart / hog / stall / hang on both pools, kills mid-KV-transfer in
+  # both directions, heartbeat detection, autoscale) — slow-marked tests
+  # included here on purpose — plus the refactored loadgen cluster and
+  # handoff precondition tests the fleet builds on
+  python -m pytest tests/test_fleet_transport.py tests/test_fleet.py \
+    tests/test_loadgen_cluster.py tests/test_serving_handoff.py -q \
+    ${filtered[@]+"${filtered[@]}"}
+  # seeded frame-transport fuzz, the full sweep: truncated / bit-flipped /
+  # duplicated frame streams — CRC rejects every mangled frame, dedup
+  # holds under redelivery, the retry path always completes byte-exactly
+  python scripts/fuzz_checkpoint.py --seeds 0 --transport-seeds 50
+  # fleet bench + REAL perf gate: disaggregated replay (KV pages over the
+  # frame transport) for serve.fleet_goodput (higher), then a decode
+  # SIGKILL mid-stream for serve.fleet_recovery_p99 (lower) — both
+  # token-exact vs the single-process oracle, gated against BENCH history.
+  # --strict-cache: this lane must run the bench fresh, never a stale replay.
+  python scripts/bench_loadgen.py --fleet
+  python scripts/check_regression.py \
+    --headline 'results/headline_fleet_*.json' \
+    --strict-cache --summary-json results/fleet_gate.json
 elif [[ $schedule == 1 ]]; then
   # focused lane for the ring-schedule IR + compiler (parallel/schedule.py):
   # compiler/oracle unit tests, interpret-mode parity of the bidi and

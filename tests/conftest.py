@@ -117,6 +117,8 @@ _SLOW = {
     ("test_loadgen_cluster.py",
      "test_cluster_resume_replays_strictly_less_than_scratch"),
     ("test_loadgen_cluster.py", "test_cluster_heartbeat_detects_hang"),
+    ("test_loadgen_cluster.py",
+     "test_cluster_worker_error_during_stop_flushes_obs"),
     ("test_handoff_faults.py",
      "test_handoff_kill_journal_only_recovery_token_exact"),
     ("test_handoff_faults.py",
@@ -134,6 +136,16 @@ _SLOW = {
     ("test_serving_handoff.py", "test_handoff_decodes_token_exact_single_host"),
     ("test_serving_handoff.py",
      "test_handoff_generate_sequence_parallel_token_exact"),
+    ("test_fleet_transport.py", "test_transport_fuzz_seed_sweep"),
+    ("test_fleet.py", "test_fleet_socket_token_exact_digest_bytematch"),
+    ("test_fleet.py", "test_fleet_decode_kill_mid_stream_sibling_resumes"),
+    ("test_fleet.py",
+     "test_fleet_kill_mid_transfer_zero_leak_both_directions"),
+    ("test_fleet.py", "test_fleet_decode_restart_restores_from_snapshot"),
+    ("test_fleet.py", "test_fleet_hog_stall_cross_boundary"),
+    ("test_fleet.py", "test_fleet_hang_heartbeat_both_pools"),
+    ("test_fleet.py", "test_fleet_prefill_kill_reruns_on_sibling"),
+    ("test_fleet.py", "test_fleet_autoscale_up_on_pressure_down_on_idle"),
     ("test_window.py", "test_burst_ring_contig_window"),
     ("test_window.py", "test_dist_decode_window_matches_single_chip"),
     ("test_window.py", "test_burst_ring_window_grad"),

@@ -150,3 +150,33 @@ def test_summary_json_written_and_matches_exit(tmp_path, capsys):
     capsys.readouterr()
     rep = json.loads(spath.read_text())
     assert rep["gate"] == "PASS" and rep["exit_code"] == 0
+
+
+def test_summary_json_verdicts_carry_direction(tmp_path, capsys):
+    """ISSUE 12 satellite: every verdict line in --summary-json names its
+    regression sense so CI annotators can say "rose above ceiling" vs
+    "fell below floor" without re-parsing the detail string — including
+    NO-HISTORY and STALE-CACHE entries."""
+    _write(tmp_path / "results" / "headline_t.json",
+           {"metric": "tps", "value": 95.0})
+    _write(tmp_path / "results" / "headline_l.json",
+           {"metric": "lat", "value": 0.25, "direction": "lower",
+            "cached": True, "cached_age_hours": 58.3})
+    _write(tmp_path / "results" / "headline_n.json",
+           {"metric": "fresh.metric", "value": 1.0, "direction": "lower"})
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"metric": "tps", "value": 100.0}})
+    _write(tmp_path / "BENCH_r02.json",
+           {"parsed": {"metric": "lat", "value": 0.10}})
+    spath = tmp_path / "out" / "summary.json"
+    argv = _argv(tmp_path, "--max-cached-age", "24",
+                 "--summary-json", str(spath))
+    assert cr.main(argv) == 1  # lat regressed above its ceiling
+    capsys.readouterr()
+    rep = json.loads(spath.read_text())
+    by = {(v["status"], v["direction"]) for v in rep["verdicts"]}
+    assert ("PASS", "higher") in by          # tps holds its floor
+    assert ("REGRESSION", "lower") in by     # lat blew its ceiling
+    assert ("STALE-CACHE", "lower") in by    # warning keeps metric's sense
+    assert ("NO-HISTORY", "lower") in by     # fresh.metric, no prior
+    assert all("direction" in v for v in rep["verdicts"])

@@ -1,0 +1,344 @@
+"""Disaggregated prefill/decode fleet (ISSUE 12 tentpole).
+
+Fast canaries exercise the KV transfer plane in-process: pages framed
+through the real wire codec must land byte-identical, and every
+rejected commit must leak zero pool pages.  The slow tests spawn real
+worker processes (queue and socket transports) and run the cross-
+boundary fault matrix — kill / restart / hog / stall / hang on BOTH
+pools plus kills mid-KV-transfer in both directions — asserting
+token-exactness against the single-process oracle every time."""
+
+import glob
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from burst_attn_tpu.fleet import (FleetCluster, FleetFault, KvReceiver,
+                                  export_slot_pages, fleet_oracle,
+                                  page_bytes, page_digest)
+from burst_attn_tpu.fleet import transport as tp
+from burst_attn_tpu.loadgen.trace import Trace, TraceRequest
+from burst_attn_tpu.models.paged_decode import PagePool, PagedState
+
+MODEL_SPEC = dict(vocab=97, d_model=32, n_layers=1, n_heads=2,
+                  n_kv_heads=1, d_head=16, d_ff=64, block_q=8, block_kv=8,
+                  seed=0)
+PSPEC = dict(sp=2, page=128, n_pages=4, max_pages_per_seq=8)
+DSPEC = dict(sp=2, slots=2, page=128, n_pages=8, max_pages_per_seq=4)
+
+
+def _trace(n, *, prompt_len=128, seed0=100, max_new=4, dt=0.05,
+           extra=()):
+    reqs = [TraceRequest(rid=i, t_arrival=dt * i, prompt_len=prompt_len,
+                         prompt_seed=seed0 + i, max_new_tokens=max_new)
+            for i in range(n)]
+    return Trace(meta={"vocab": 97}, requests=list(reqs) + list(extra))
+
+
+def _assert_token_exact(rep, oracle_toks):
+    for rid, o in rep.outcomes.items():
+        assert o.status == "done", (rid, o)
+        assert o.tokens == oracle_toks[rid], \
+            (rid, o.tokens, oracle_toks[rid])
+
+
+# -- fast canaries: KV plane in-process -------------------------------------
+
+
+def _raw_state(*, n_layers=2, n_kv=1, page=128, d_head=8, n_pool=4,
+               slots=2, max_pages=4, seed=0):
+    """A pool filled with random data, no model required — the KV plane
+    moves bytes, not activations."""
+    rng = np.random.default_rng(seed)
+    shape = (n_pool, n_kv, page, d_head)
+    k = tuple(jnp.asarray(rng.standard_normal(shape), jnp.float32)
+              for _ in range(n_layers))
+    v = tuple(jnp.asarray(rng.standard_normal(shape), jnp.float32)
+              for _ in range(n_layers))
+    table = jnp.zeros((slots, max_pages), jnp.int32)
+    lengths = jnp.zeros((slots,), jnp.int32)
+    return PagedState(k, v, table, lengths, None, None), PagePool(n_pool)
+
+
+def test_fleet_canary_kvplane_wire_roundtrip_byte_exact():
+    """export -> real wire frames -> stage -> commit: the receiving
+    pool's pages byte-match the sender's, whatever physical page ids
+    each side assigned."""
+    src, src_pool = _raw_state(seed=1)
+    ids = src_pool.acquire(2)
+    src = PagedState(src.k_pages, src.v_pages,
+                     src.page_table.at[0, :2].set(jnp.asarray(ids)),
+                     src.lengths.at[0].set(256), None, None)
+    meta, pages = export_slot_pages(src, 0)
+    assert meta["n_pages"] == 2 and meta["length"] == 256
+
+    recv = KvReceiver()
+    # every message crosses the real codec + framing, both codecs
+    for force_json in (False, True):
+        frame = tp.pack_frame(tp.encode_message(
+            {"op": "kv_begin", "rid": 7, "meta": meta},
+            force_json=force_json))
+        m = tp.decode_message(tp.unpack_frame(frame))
+        recv.begin(m["rid"], m["meta"])
+        for j, pg in enumerate(pages):
+            frame = tp.pack_frame(tp.encode_message(
+                {"op": "kv_page", "rid": 7, "j": j, "pg": pg},
+                force_json=force_json))
+            m = tp.decode_message(tp.unpack_frame(frame))
+            recv.add_page(m["rid"], m["j"], m["pg"])
+    assert recv.complete(7)
+
+    dst, dst_pool = _raw_state(n_pool=8, seed=2)
+    avail0 = dst_pool.available
+    dst = recv.commit(7, dst, dst_pool, 1)
+    assert dst_pool.available == avail0 - 2
+    assert int(dst.lengths[1]) == 256 and recv.staging_count() == 0
+    meta2, pages2 = export_slot_pages(dst, 1)
+    assert meta2["n_pages"] == meta["n_pages"]
+    for a, b in zip(pages, pages2):
+        assert page_bytes(a) == page_bytes(b)
+        assert page_digest(a) == page_digest(b)
+
+
+def test_fleet_canary_commit_rejections_leak_zero_pages():
+    """Every way a commit can be refused leaves the pool EXACTLY as it
+    was — the zero-leak property the kill-mid-transfer matrix relies
+    on."""
+    src, src_pool = _raw_state(seed=3)
+    ids = src_pool.acquire(2)
+    src = PagedState(src.k_pages, src.v_pages,
+                     src.page_table.at[0, :2].set(jnp.asarray(ids)),
+                     src.lengths.at[0].set(256), None, None)
+    meta, pages = export_slot_pages(src, 0)
+
+    dst, dst_pool = _raw_state(n_pool=8, seed=4)
+    avail0 = dst_pool.available
+
+    recv = KvReceiver()
+    recv.begin(1, meta)
+    recv.add_page(1, 0, pages[0])  # page 1 of 2 never arrives
+    with pytest.raises(ValueError, match="incomplete"):
+        recv.commit(1, dst, dst_pool, 0)
+    assert dst_pool.available == avail0
+
+    recv.begin(2, meta)
+    for j, pg in enumerate(pages):
+        recv.add_page(2, j, pg)
+    live = PagedState(dst.k_pages, dst.v_pages, dst.page_table,
+                      dst.lengths.at[0].set(8), None, None)
+    with pytest.raises(RuntimeError, match="live"):
+        recv.commit(2, live, dst_pool, 0)
+    assert dst_pool.available == avail0
+
+    tiny, tiny_pool = _raw_state(n_pool=2, seed=5)  # 1 usable page
+    with pytest.raises(RuntimeError, match="exhausted"):
+        recv.commit(2, tiny, tiny_pool, 0)
+    assert tiny_pool.available == 1
+
+    bad = dict(pages[0])
+    bad["k"] = [a[:, :64, :] for a in pages[0]["k"]]
+    with pytest.raises(ValueError, match="shape"):
+        recv.add_page(2, 0, bad)
+    assert recv.abort(2) and recv.abort(1)
+    assert recv.staging_count() == 0 and not recv.abort(2)
+    assert dst_pool.available == avail0
+    with pytest.raises(KeyError):
+        recv.commit(2, dst, dst_pool, 0)  # staging gone after abort
+
+
+def test_fleet_canary_fault_validation():
+    with pytest.raises(ValueError, match="pool"):
+        FleetFault(t=0.0, pool="gpu", worker=0, kind="kill")
+    with pytest.raises(ValueError, match="kind"):
+        FleetFault(t=0.0, pool="decode", worker=0, kind="explode")
+    with pytest.raises(ValueError):
+        FleetFault(t=0.0, pool="decode", worker=0, kind="die_mid_ship")
+    with pytest.raises(ValueError):
+        FleetFault(t=0.0, pool="prefill", worker=0, kind="die_mid_recv")
+    FleetFault(t=0.0, pool="prefill", worker=0, kind="die_mid_ship")
+
+
+# -- slow: real processes, both transports, the fault matrix ----------------
+
+
+def test_fleet_socket_token_exact_digest_bytematch(tmp_path):
+    """Socket transport (the cross-host shape): every request's tokens
+    match the single-process oracle, and every shipped page's digest —
+    recomputed from the replica's own pool post-commit — matches what
+    the prefill worker hashed before framing."""
+    trace = _trace(4, seed0=200, max_new=6)
+    dspec = dict(DSPEC, echo_digests=True)
+    oracle_toks, oracle_digs = fleet_oracle(
+        trace, MODEL_SPEC, prefill_spec=PSPEC, decode_spec=dspec)
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=dspec,
+                      n_prefill=1, n_decode=2, out_dir=str(tmp_path),
+                      transport="socket") as fc:
+        rep = fc.replay(trace, speed=25.0, max_wall_s=420.0)
+    _assert_token_exact(rep, oracle_toks)
+    assert rep.transfers["committed"] == 4
+    assert rep.transfers["digest_checked"] == 4
+    assert rep.transfers["digest_mismatch"] == 0
+    # the obs plane saw fleet traffic from every process
+    names = set()
+    for path in glob.glob(os.path.join(str(tmp_path), "obs_*.jsonl")):
+        with open(path) as f:
+            for line in f:
+                names.add(json.loads(line).get("name"))
+    assert any(n and n.startswith("fleet.") for n in names)
+
+
+def test_fleet_decode_kill_mid_stream_sibling_resumes(tmp_path):
+    """SIGKILL a decode replica mid-stream: its orphans resume on the
+    sibling from snapshot+journal, token-exact, with resumed prefixes
+    (not full replay) doing the recovery."""
+    trace = _trace(4, seed0=200, max_new=6)
+    oracle_toks, _ = fleet_oracle(trace, MODEL_SPEC, prefill_spec=PSPEC,
+                                  decode_spec=DSPEC)
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=DSPEC,
+                      n_prefill=1, n_decode=2, out_dir=str(tmp_path),
+                      transport="queue", checkpoint_every=1) as fc:
+        rep = fc.replay(trace, [FleetFault(t=0.2, pool="decode", worker=0,
+                                           kind="kill")],
+                        speed=25.0, max_wall_s=420.0)
+    _assert_token_exact(rep, oracle_toks)
+    assert any(k["pool"] == "decode" for k in rep.kills), rep.kills
+    assert rep.recovered_tokens_resumed > 0
+
+
+def test_fleet_kill_mid_transfer_zero_leak_both_directions(tmp_path):
+    """Kill EITHER end mid-KV-shipment: the prefill dying after page 1
+    of 2 leaves the replica's staging aborted with zero pages leaked;
+    the replica dying after receiving page 1 re-ships the buffered
+    transfer to a sibling.  Token-exact both ways."""
+    trace = _trace(3, prompt_len=256, seed0=300, max_new=5)
+    oracle_toks, _ = fleet_oracle(trace, MODEL_SPEC, prefill_spec=PSPEC,
+                                  decode_spec=DSPEC)
+
+    faults = [FleetFault(t=0.0, pool="prefill", worker=0,
+                         kind="die_mid_ship", arg=1)]
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=DSPEC,
+                      n_prefill=2, n_decode=1, out_dir=str(tmp_path / "a"),
+                      transport="queue") as fc:
+        rep = fc.replay(trace, faults, speed=25.0, max_wall_s=420.0)
+    _assert_token_exact(rep, oracle_toks)
+    aborts = [e for e in rep.transfers["aborts"] if e["kind"] == "abort"]
+    assert aborts, rep.transfers
+    # zero-leak: every abort dropped its staging without touching the
+    # pool (avail_after reflects only OTHER live requests' pages — with
+    # 3 pages/request on a 7-page pool, any leaked page would wedge a
+    # later admission and fail the token-exact gate above)
+    for e in aborts:
+        assert e["staged_after"] == 0, e
+        assert e["avail_after"] >= 1, e
+
+    faults = [FleetFault(t=0.0, pool="decode", worker=0,
+                         kind="die_mid_recv", arg=1)]
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=DSPEC,
+                      n_prefill=1, n_decode=2, out_dir=str(tmp_path / "b"),
+                      transport="queue") as fc:
+        rep = fc.replay(trace, faults, speed=25.0, max_wall_s=420.0)
+    _assert_token_exact(rep, oracle_toks)
+    assert rep.transfers["reshipped"] >= 1, rep.transfers
+    assert any(k["pool"] == "decode" for k in rep.kills), rep.kills
+
+
+def test_fleet_decode_restart_restores_from_snapshot(tmp_path):
+    """An armed restart on a decode replica: the replacement process
+    restores snapshot+journal and finishes its claimed requests itself,
+    token-exact, with journal-lag replay strictly bounded."""
+    trace = _trace(3, prompt_len=256, seed0=300, max_new=5)
+    oracle_toks, _ = fleet_oracle(trace, MODEL_SPEC, prefill_spec=PSPEC,
+                                  decode_spec=DSPEC)
+    faults = [FleetFault(t=0.15, pool="decode", worker=0, kind="restart")]
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=DSPEC,
+                      n_prefill=1, n_decode=1, out_dir=str(tmp_path),
+                      transport="queue", checkpoint_every=1) as fc:
+        rep = fc.replay(trace, faults, speed=25.0, max_wall_s=420.0)
+    _assert_token_exact(rep, oracle_toks)
+    restarted = [k for k in rep.kills if k.get("restarted")]
+    assert restarted, rep.kills
+    assert rep.recovery_s(), rep.kills
+
+
+def test_fleet_hog_stall_cross_boundary(tmp_path):
+    """Pool-hog on the prefill side (prefill fails retryable until the
+    unhog) and a stall on the decode side: the router's retry/backoff
+    path absorbs both, token-exact."""
+    trace = _trace(3, seed0=100, max_new=4, dt=0.1)
+    oracle_toks, _ = fleet_oracle(trace, MODEL_SPEC, prefill_spec=PSPEC,
+                                  decode_spec=DSPEC)
+    faults = [
+        FleetFault(t=0.0, pool="prefill", worker=0, kind="hog", arg=3),
+        FleetFault(t=50.0, pool="prefill", worker=0, kind="unhog"),
+        FleetFault(t=0.0, pool="decode", worker=0, kind="stall", arg=1.5),
+    ]
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=DSPEC,
+                      n_prefill=1, n_decode=1, out_dir=str(tmp_path),
+                      transport="queue") as fc:
+        rep = fc.replay(trace, faults, speed=25.0, max_wall_s=420.0)
+    _assert_token_exact(rep, oracle_toks)
+    assert any(o.retries > 0 for o in rep.outcomes.values()), \
+        {r: o.retries for r, o in rep.outcomes.items()}
+
+
+def test_fleet_hang_heartbeat_both_pools(tmp_path):
+    """A hung member in EACH pool: the heartbeat detector declares both
+    dead; the prefill sibling absorbs the queue and decode orphans
+    resume on the surviving replica.  Token-exact throughout."""
+    trace = _trace(3, seed0=100, max_new=4)
+    oracle_toks, _ = fleet_oracle(trace, MODEL_SPEC, prefill_spec=PSPEC,
+                                  decode_spec=DSPEC)
+    faults = [
+        FleetFault(t=0.0, pool="prefill", worker=0, kind="hang"),
+        FleetFault(t=0.3, pool="decode", worker=0, kind="hang"),
+    ]
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=DSPEC,
+                      n_prefill=2, n_decode=2, out_dir=str(tmp_path),
+                      transport="queue", checkpoint_every=1,
+                      hb_interval_s=0.5, hb_timeout_s=6.0) as fc:
+        rep = fc.replay(trace, faults, speed=25.0, max_wall_s=420.0)
+    _assert_token_exact(rep, oracle_toks)
+    hb = {(k["pool"], k["worker"]) for k in rep.kills
+          if k["detected_by"] == "heartbeat"}
+    assert ("prefill", 0) in hb and ("decode", 0) in hb, rep.kills
+
+
+def test_fleet_prefill_kill_reruns_on_sibling(tmp_path):
+    """SIGKILL a busy prefill worker: its in-flight request re-runs on
+    the sibling (prefill is stateless across requests), token-exact."""
+    trace = _trace(3, prompt_len=256, seed0=300, max_new=4, dt=0.02)
+    oracle_toks, _ = fleet_oracle(trace, MODEL_SPEC, prefill_spec=PSPEC,
+                                  decode_spec=DSPEC)
+    faults = [FleetFault(t=0.1, pool="prefill", worker=0, kind="kill")]
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=DSPEC,
+                      n_prefill=2, n_decode=1, out_dir=str(tmp_path),
+                      transport="queue") as fc:
+        rep = fc.replay(trace, faults, speed=25.0, max_wall_s=420.0)
+    _assert_token_exact(rep, oracle_toks)
+    assert any(k["pool"] == "prefill" for k in rep.kills), rep.kills
+
+
+def test_fleet_autoscale_up_on_pressure_down_on_idle(tmp_path):
+    """Sustained admission pressure (queue waiting, zero free slots)
+    spawns a replica — capped at max_decode even while the new one
+    boots — and a drained fleet scales back down to min_decode."""
+    late = TraceRequest(rid=5, t_arrival=1250.0, prompt_len=128,
+                        prompt_seed=405, max_new_tokens=3)
+    trace = _trace(5, seed0=400, max_new=6, dt=0.02, extra=[late])
+    oracle_toks, _ = fleet_oracle(trace, MODEL_SPEC, prefill_spec=PSPEC,
+                                  decode_spec=DSPEC)
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=DSPEC,
+                      n_prefill=1, n_decode=1, out_dir=str(tmp_path),
+                      transport="queue", autoscale=True, max_decode=2,
+                      scale_check_interval_s=0.2, scale_up_after=2,
+                      scale_down_after=10) as fc:
+        rep = fc.replay(trace, speed=25.0, max_wall_s=420.0)
+    _assert_token_exact(rep, oracle_toks)
+    ups = [e for e in rep.scale_events if e["action"] == "up"]
+    downs = [e for e in rep.scale_events if e["action"] == "down"]
+    assert ups and downs, rep.scale_events
+    # boot-time pressure must not overshoot the cap
+    assert len(ups) - len(downs) <= 1, rep.scale_events

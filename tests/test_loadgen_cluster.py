@@ -79,6 +79,36 @@ def test_cluster_canary_kill_and_hog_token_exact(tmp_path):
     assert ok, violations
 
 
+def test_cluster_worker_error_during_stop_flushes_obs(tmp_path):
+    """ISSUE 12 satellite: a worker that dies on an internal error while
+    the cluster is shutting down still (1) lands its final obs snapshot
+    on disk and (2) gets its error frame collected by stop() through the
+    transport ack path — the error/shutdown race loses neither."""
+    import json
+    import os
+
+    trace = _trace(3, seed=11)
+    with LoadGenCluster(MODEL_SPEC, ENGINE_SPEC, n_workers=2,
+                        out_dir=str(tmp_path)) as cluster:
+        report = cluster.replay(trace, speed=1.0, max_wall_s=150)
+        with pytest.raises(ValueError, match="fault"):
+            cluster.inject_fault(0, "explode")
+        cluster.inject_fault(0, "raise")  # dies during the stop window
+        cluster.stop()
+        errors = list(cluster.worker_errors)
+        obs_paths = cluster.obs_paths
+    assert report.n_done == len(trace.normal())
+    assert errors and errors[0][0] == 0, errors
+    assert "RuntimeError" in errors[0][1] and "injected" in errors[0][1]
+    # the dying worker's obs export was flushed BEFORE the error frame
+    # and parses cleanly, so the merged SLO view survives the crash
+    dead = [p for p in obs_paths if os.path.basename(p) == "obs_w0.jsonl"]
+    assert dead and os.path.exists(dead[0])
+    with open(dead[0]) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    assert records
+
+
 def test_cluster_stall_fault_and_graceful_stop(tmp_path):
     """A stalled worker (frozen engine loop — delayed-retire stand-in)
     delays but never corrupts; a graceful stop flushes one final export

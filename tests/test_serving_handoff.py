@@ -18,7 +18,7 @@ from burst_attn_tpu.models.paged_decode import (
 from burst_attn_tpu.models.train import make_mesh
 from burst_attn_tpu.parallel import layouts
 from burst_attn_tpu.serving.handoff import (
-    handoff_generate, ring_prefill_to_pages,
+    check_handoff_preconditions, handoff_generate, ring_prefill_to_pages,
 )
 
 PAGE, S, STEPS = 128, 256, 4
@@ -110,6 +110,66 @@ def test_handoff_rejects_window_and_ragged_lengths(setup):
     with pytest.raises(ValueError, match="multiple"):
         ring_prefill_to_pages(params, prompt[:100], state, pool, 0, cfg, mesh)
     assert pool.available == N_PAGES - 1  # failed calls leaked nothing
+
+
+def test_precondition_rejections_leak_zero_pages(setup):
+    """ISSUE 12 satellite: check_handoff_preconditions validates EVERY
+    admission condition — including the decode budget — up-front, and
+    every rejection leaves the pool at exactly its prior occupancy."""
+    cfg, params, mesh, prompt = setup
+    state, pool = _fresh(cfg)
+    avail0 = pool.available
+
+    wcfg = ModelConfig(**{**cfg.__dict__, "window": 64, "layout": "contig"})
+    cases = [
+        (ValueError, "window", dict(cfg=wcfg)),
+        (ValueError, "empty", dict(n_tokens=0)),
+        (ValueError, "multiple", dict(n_tokens=100)),
+        (ValueError, "negative", dict(steps=-1)),
+        (ValueError, "out of range", dict(slot=2)),
+        (ValueError, "table width", dict(steps=6 * PAGE)),  # > max_pages
+    ]
+    for exc, pat, over in cases:
+        kw = dict(slot=0, n_tokens=S, cfg=cfg, steps=0)
+        kw.update(over)
+        with pytest.raises(exc, match=pat):
+            check_handoff_preconditions(state, pool, kw["slot"],
+                                        kw["n_tokens"], kw["cfg"],
+                                        steps=kw["steps"])
+        assert pool.available == avail0, (pat, pool.available)
+        assert int(state.lengths[0]) == 0
+    live = state._replace(lengths=state.lengths.at[1].set(8))
+    with pytest.raises(RuntimeError, match="live"):
+        check_handoff_preconditions(live, pool, 1, S, cfg)
+    assert pool.available == avail0
+    tight = pool.acquire(3)  # 4 left; prompt 2 + 3 budget pages = 5
+    try:
+        with pytest.raises(RuntimeError, match="exhausted"):
+            check_handoff_preconditions(state, pool, 0, S, cfg,
+                                        steps=3 * PAGE)
+        assert pool.available == avail0 - 3
+    finally:
+        pool.release(tight)
+
+    # the accept path returns the prefill page count, still zero-mutation
+    assert check_handoff_preconditions(state, pool, 0, S, cfg,
+                                       steps=STEPS) == S // PAGE
+    assert pool.available == avail0
+
+    # handoff_generate rejects an unservable budget BEFORE the ring pass:
+    # nothing prefilled, nothing acquired (the provision-after-prefill
+    # leak this satellite closed)
+    with pytest.raises(ValueError, match="steps"):
+        handoff_generate(params, prompt, state, pool, cfg, mesh, steps=0)
+    held = pool.acquire(avail0 - 2)  # leave too little for prompt+budget
+    try:
+        with pytest.raises(RuntimeError, match="exhausted"):
+            handoff_generate(params, prompt, state, pool, cfg, mesh,
+                             steps=STEPS)
+        assert pool.available == 2 and int(state.lengths[0]) == 0
+    finally:
+        pool.release(held)
+    assert pool.available == avail0
 
 
 def test_dist_paged_decode_rejects_window_and_odd_pool(setup):
