@@ -85,12 +85,13 @@ def run_analysis(root=None, *, disable=(), ast_only=False,
     findings: List[Finding] = []
     findings += astlint.lint_paths(paths or astlint.default_paths(root))
     if not ast_only:
-        from . import ringcheck, numerics, obscheck, servecheck
+        from . import ringcheck, numerics, obscheck, poolcheck, servecheck
 
         findings += ringcheck.check_all()
         findings += numerics.check_all()
         findings += obscheck.check_all()
         findings += servecheck.check_all()
+        findings += poolcheck.check_all()
     return [f for f in findings if f.rule not in set(disable)]
 
 

@@ -1,0 +1,156 @@
+"""pagepool-cow-safe: the copy-on-write write barrier, proven live
+(burstlint).
+
+Prefix caching (ISSUE 13) makes pool pages SHARED: several slots' table
+rows — and the prefix-cache index — can reference one physical page.
+The single safety contract is that no jitted launch ever scatters K/V
+into a page the host-side allocator holds at refcount > 1: every launch
+must run behind the CoW barrier (serving/engine._cow_barrier ->
+serving/model.cow_pages), so the scatter indices the device sees always
+come from the POST-CoW page table.  A violation is silent cross-request
+corruption — the other sequence sharing the page reads poisoned K/V and
+decodes garbage with no error anywhere.
+
+A jaxpr walk cannot see this (the scatter is correct code; what matters
+is the HOST allocator state the indices were derived from), so this rule
+drives a real tiny prefix-cache engine through the sharing-heavy
+schedule — concurrent partial-prefix hits, plus the exact-template
+FULL-prompt hit whose last-token re-absorption targets the final shared
+page (the one organic refcount>1 write) — and checks, immediately before
+EVERY launch, that each table column the launch's token counts will
+scatter into (lengths//page .. (lengths+q_len-1)//page) is held at
+refcount <= 1.  Afterwards it proves the pool ALGEBRA drains: with every
+request retired and the cache fully evicted, the free list must hold
+every usable page and every refcount must be zero — a refcount leak in
+release (pages held forever) or a double-free (free-list duplicates)
+both surface here.
+
+Mutation coverage (tests/test_analysis.py): no-op'ing cow_pages fires
+the scatter check; a release that forgets to free fires the drain check.
+"""
+
+from typing import List
+
+import numpy as np
+
+from .core import Finding, rule
+
+rule("pagepool-cow-safe", "jaxpr",
+     "no jitted launch scatters K/V into a page held at refcount>1 "
+     "(post-CoW table only), and the shared pool drains to empty after "
+     "retire + full eviction")(None)
+
+_RULE = "pagepool-cow-safe"
+
+
+def _anchor():
+    import inspect
+
+    from ..serving import engine as eng_mod
+
+    try:
+        fn = eng_mod.RaggedServeEngine._cow_barrier
+        return inspect.getsourcefile(fn), inspect.getsourcelines(fn)[1]
+    except (OSError, TypeError):
+        return "<trace>", 0
+
+
+def check_all() -> List[Finding]:
+    """Drive the shared-prefix schedule on a tiny engine; every launch is
+    precondition-checked against the live allocator."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import ModelConfig, init_params
+    from ..serving import engine as eng_mod
+
+    path, line = _anchor()
+    findings: List[Finding] = []
+    cfg = ModelConfig(vocab=61, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_head=16, d_ff=64, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    page = 128
+    rng = np.random.default_rng(0x90001)
+    tmpl = rng.integers(1, 61, size=page)  # exactly one cacheable page
+    prompts = [np.concatenate([tmpl, rng.integers(1, 61, size=7)]),
+               np.concatenate([tmpl, rng.integers(1, 61, size=11)]),
+               tmpl.copy()]  # FULL-prompt hit: the CoW boundary write
+
+    violations: List[str] = []
+    holder = {}
+    real_step = eng_mod.ragged_model_step
+
+    def checked_step(params, toks, q_lens, state, cfg, **kw):
+        # the precondition: at launch time, every column this launch's
+        # token counts will scatter into must be private (refcount <= 1)
+        pool = holder["pool"]
+        ql = np.asarray(q_lens)
+        lens = np.asarray(state.lengths)
+        table = np.asarray(state.page_table)
+        pg = state.k_pages[0].shape[2]
+        for slot in range(len(ql)):
+            n = int(ql[slot])
+            if n <= 0:
+                continue
+            first = int(lens[slot]) // pg
+            last = (int(lens[slot]) + n - 1) // pg
+            for col in range(first, min(last, table.shape[1] - 1) + 1):
+                pid = int(table[slot, col])
+                if pid and pool.refcount(pid) > 1:
+                    violations.append(
+                        f"slot {slot} col {col}: launch scatters "
+                        f"{n} token(s) into page {pid} at refcount "
+                        f"{pool.refcount(pid)}")
+        return real_step(params, toks, q_lens, state, cfg, **kw)
+
+    eng_mod.ragged_model_step = checked_step
+    try:
+        engine = eng_mod.RaggedServeEngine(
+            params, cfg, slots=2, n_pages=12, page=page,
+            max_pages_per_seq=4, prefix_cache=True, chunk=page)
+        holder["pool"] = engine.pool
+        # wave 1 registers the template; wave 2 admits concurrent hits
+        # including the full-prompt hit whose re-absorbed last token is
+        # the one organic write into a shared page
+        for wave in range(2):
+            for p in prompts:
+                engine.submit(p, 3)
+            engine.run()
+    except Exception as e:  # noqa: BLE001 — the failure IS the finding
+        findings.append(Finding(
+            rule=_RULE, file=path, line=line,
+            message="shared-prefix engine schedule crashed before the "
+                    f"write-barrier check completed ({type(e).__name__}: "
+                    f"{e})"))
+        return findings
+    finally:
+        eng_mod.ragged_model_step = real_step
+
+    if violations:
+        findings.append(Finding(
+            rule=_RULE, file=path, line=line,
+            message=f"{len(violations)} jitted launch(es) scattered K/V "
+                    "into a shared page (refcount > 1) — the CoW barrier "
+                    "did not privatize the scatter targets: "
+                    + "; ".join(violations[:3])))
+
+    # pool-algebra drain: everything retired, cache fully evicted — the
+    # free list must be whole and every refcount zero
+    engine.cache.evict(engine.pool.n_pages)
+    pool = engine.pool
+    usable = pool.n_pages - 1
+    free = [int(p) for p in pool._free]
+    if len(free) != len(set(free)):
+        findings.append(Finding(
+            rule=_RULE, file=path, line=line,
+            message="double-free: the pool free list holds duplicate "
+                    f"page ids after drain ({free})"))
+    if pool.available != usable or any(r != 0 for r in pool._refs[1:]):
+        held = [i for i in range(1, pool.n_pages) if pool._refs[i] > 0]
+        findings.append(Finding(
+            rule=_RULE, file=path, line=line,
+            message="refcount leak: after retiring every request and "
+                    f"evicting the whole cache, {usable - pool.available} "
+                    f"page(s) never returned to the free list "
+                    f"(still-referenced pages: {held})"))
+    return findings

@@ -43,8 +43,8 @@ import numpy as np
 TRACE_VERSION = 1
 
 REQUEST_KINDS = ("normal", "poison-empty", "poison-budget",
-                 "poison-oversize")
-POISON_KINDS = REQUEST_KINDS[1:]
+                 "poison-oversize", "shared_prefix")
+POISON_KINDS = tuple(k for k in REQUEST_KINDS if k.startswith("poison"))
 
 
 @dataclass(frozen=True)
@@ -57,17 +57,31 @@ class TraceRequest:
     prompt_seed: int            # tokens regenerate from this (see prompt())
     max_new_tokens: int
     kind: str = "normal"        # REQUEST_KINDS
+    # shared_prefix requests: the first `overlap_len` tokens regenerate
+    # from `template_seed` (drawn from a small per-trace template pool),
+    # the remaining prompt_len - overlap_len from prompt_seed — every
+    # request on the same template shares a bit-identical prefix, which
+    # is what the serving prefix cache hits on
+    template_seed: int = -1
+    overlap_len: int = 0
 
     @property
     def poison(self) -> bool:
-        return self.kind != "normal"
+        return self.kind in POISON_KINDS
 
     def prompt(self, vocab: int) -> np.ndarray:
         """The request's tokens, regenerated deterministically — every
         replayer and the oracle derive the identical [prompt_len] int32
-        array from (prompt_seed, prompt_len, vocab)."""
+        array from (prompt_seed, prompt_len, vocab) — plus
+        (template_seed, overlap_len) for shared_prefix requests."""
         if self.prompt_len <= 0:
             return np.zeros((0,), np.int32)
+        if self.kind == "shared_prefix" and self.overlap_len > 0:
+            tmpl = np.random.default_rng(self.template_seed).integers(
+                1, vocab, size=self.overlap_len)
+            tail = np.random.default_rng(self.prompt_seed).integers(
+                1, vocab, size=self.prompt_len - self.overlap_len)
+            return np.concatenate([tmpl, tail]).astype(np.int32)
         rng = np.random.default_rng(self.prompt_seed)
         return rng.integers(1, vocab, size=self.prompt_len).astype(np.int32)
 
@@ -113,18 +127,39 @@ def synthesize_trace(
     max_new_max: int = 48,
     poison_rate: float = 0.0,
     oversize_len: int = 100_000,
+    shared_fraction: float = 0.0,
+    n_templates: int = 4,
+    template_len: int = 256,
     label: str = "synthetic",
 ) -> Trace:
     """Seeded workload synthesis (see the module docstring for the
     models).  Prompt lengths are clipped lognormal (ragged, heavy-ish
     tail), decode budgets clipped geometric, arrivals Markov-modulated
     exponential.  No wall-clock, no global RNG — the same call is the
-    same trace forever."""
+    same trace forever.
+
+    `shared_fraction` > 0 turns that fraction of normal requests into
+    `shared_prefix` requests: each picks one of `n_templates` seeded
+    templates and prepends its `template_len` tokens to the privately
+    drawn suffix (so its total prompt is template + lognormal tail).
+    Guarded draws keep shared_fraction=0 traces BIT-IDENTICAL to
+    pre-ISSUE-13 synthesis."""
     if n_requests < 1:
         raise ValueError(f"n_requests must be >= 1, got {n_requests}")
     if not 0.0 <= poison_rate < 1.0:
         raise ValueError(f"poison_rate must be in [0, 1), got {poison_rate}")
+    if not 0.0 <= shared_fraction <= 1.0:
+        raise ValueError(
+            f"shared_fraction must be in [0, 1], got {shared_fraction}")
     rng = np.random.default_rng(seed)
+    template_seeds: List[int] = []
+    if shared_fraction > 0:
+        if n_templates < 1:
+            raise ValueError(f"n_templates must be >= 1, got {n_templates}")
+        if template_len < 1:
+            raise ValueError(f"template_len must be >= 1, got {template_len}")
+        template_seeds = [int(s) for s in
+                          rng.integers(0, 2**31 - 1, size=n_templates)]
     requests: List[TraceRequest] = []
     t = 0.0
     in_burst = False
@@ -150,10 +185,19 @@ def synthesize_trace(
             max_new = 0
         elif kind == "poison-oversize":
             prompt_len = oversize_len
+        template_seed, overlap_len = -1, 0
+        if (kind == "normal" and shared_fraction > 0
+                and rng.random() < shared_fraction):
+            kind = "shared_prefix"
+            template_seed = template_seeds[
+                int(rng.integers(0, len(template_seeds)))]
+            overlap_len = template_len
+            prompt_len += template_len  # template + the drawn private tail
         requests.append(TraceRequest(
             rid=rid, t_arrival=round(t, 6), prompt_len=prompt_len,
             prompt_seed=int(rng.integers(0, 2**31 - 1)),
-            max_new_tokens=max_new, kind=kind))
+            max_new_tokens=max_new, kind=kind,
+            template_seed=template_seed, overlap_len=overlap_len))
     meta = {
         "version": TRACE_VERSION, "label": label, "seed": int(seed),
         "vocab": int(vocab), "n_requests": int(n_requests),
@@ -166,6 +210,8 @@ def synthesize_trace(
         "max_new_mean": max_new_mean, "max_new_min": max_new_min,
         "max_new_max": max_new_max, "poison_rate": poison_rate,
         "oversize_len": oversize_len,
+        "shared_fraction": shared_fraction, "n_templates": n_templates,
+        "template_len": template_len,
         "duration_s": round(t, 6),
     }
     return Trace(meta=meta, requests=requests)

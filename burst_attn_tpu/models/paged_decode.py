@@ -161,6 +161,24 @@ class PagePool:
     def available(self) -> int:
         return len(self._free)
 
+    @property
+    def in_use(self) -> int:
+        """PHYSICAL pages currently held (each shared page counts once)."""
+        return self.n_pages - 1 - len(self._free)
+
+    @property
+    def logical_refs(self) -> int:
+        """Sum of refcounts — the pages the pool would need WITHOUT
+        sharing.  logical_refs - in_use = pages saved by prefix sharing."""
+        return sum(self._refs)
+
+    @property
+    def has_shared(self) -> bool:
+        """True iff ANY page is held at refcount > 1 — the cheap gate the
+        serving engine uses to skip the CoW barrier scan entirely when
+        nothing is shared (the common cache-off / zero-overlap case)."""
+        return any(r > 1 for r in self._refs)
+
     def refcount(self, i: int) -> int:
         return self._refs[int(i)]
 
@@ -213,9 +231,14 @@ class PrefixCache:
     per registered page, so cached pages survive their sequences retiring;
     `evict(n)` drops the n least-recently-used entries and their refs.
 
-    Shared pages are never written: decode appends always target the
-    column at lengths//page, which lies beyond every full (cacheable)
-    page — so no copy-on-write is ever needed.
+    Write discipline: the LEGACY full-prefill path (paged_prefill) never
+    writes a shared page — decode appends target the column at
+    lengths//page, beyond every full (cacheable) page.  The ragged engine
+    additionally admits FULL-prompt hits by re-absorbing the prompt's last
+    token through chunked prefill, whose K/V scatter targets the last
+    shared page — that write goes through the copy-on-write barrier
+    (serving/model.cow_pages) which privatizes the page first.  Eviction
+    only frees a physical page when its refcount reaches 0.
     """
 
     def __init__(self, pool: PagePool):
@@ -283,6 +306,47 @@ class PrefixCache:
                 if prev is not None:
                     self._nkids[prev] += 1
             prev = h
+
+    def evictable(self) -> int:
+        """Upper bound on pages evict() could free right now: entries whose
+        page only the cache references.  A refcount-1 parent blocked by a
+        pinned child is counted but not currently droppable, so callers
+        treat this as a shed heuristic, never a guarantee — hard admission
+        calls evict() for real and rechecks."""
+        return sum(1 for pid in self._pages.values()
+                   if self._pool.refcount(pid) == 1)
+
+    def to_meta(self) -> List[List[str]]:
+        """JSON-able snapshot of the index: [hash_hex, page_id, parent_hex]
+        per entry in LRU order (least recent first).  Pool refcounts are
+        NOT included — the pool serializes its own `_refs` wholesale
+        (serving/checkpoint._pool_meta), and this index's references are
+        part of that total."""
+        return [[h.hex(), str(self._pages[h]),
+                 (self._parent[h] or b"").hex()]
+                for h in self._lru]
+
+    @classmethod
+    def from_meta(cls, pool: PagePool, meta) -> "PrefixCache":
+        """Rebuild an index captured by to_meta against an already-restored
+        pool.  Does NOT call pool.share — the serialized refcounts already
+        include this index's references (double-bumping them here would be
+        exactly the leak the checkpoint fuzz hunts)."""
+        cache = cls(pool)
+        for h_hex, pid, parent_hex in meta:
+            h = bytes.fromhex(h_hex)
+            parent = bytes.fromhex(parent_hex) or None
+            pid = int(pid)
+            if pool.refcount(pid) < 1:
+                raise ValueError(
+                    f"prefix-cache meta references free page {pid}")
+            cache._pages[h] = pid
+            cache._lru[h] = None
+            cache._parent[h] = parent
+            cache._nkids.setdefault(h, 0)
+            if parent is not None:
+                cache._nkids[parent] = cache._nkids.get(parent, 0) + 1
+        return cache
 
     def evict(self, n: int) -> int:
         """Free up to n pages by dropping entries, LRU-first among LEAVES

@@ -57,13 +57,20 @@ _MAX_BLOCK_ROWS = 1024
 def _ragged_kernel(
     table_ref, n_live_ref, kvlen_ref, qlen_ref, lo_ref,  # scalar prefetch
     *refs,
-    scale, page, n_slots, bq, g, quant, window,
+    scale, page, n_slots, bq, g, quant, window, emit_partials=False,
 ):
     if quant:
-        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
-         m_scr, l_scr, acc_scr) = refs
+        q_ref, k_ref, v_ref, ks_ref, vs_ref = refs[:5]
+        rest = refs[5:]
     else:
-        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        q_ref, k_ref, v_ref = refs[:3]
+        rest = refs[3:]
+    if emit_partials:
+        o_ref, m_ref, l_ref = rest[:3]
+        m_scr, l_scr, acc_scr = rest[3:]
+    else:
+        o_ref = rest[0]
+        m_scr, l_scr, acc_scr = rest[1:]
     s_ = pl.program_id(0)
     qi = pl.program_id(2)
     j = pl.program_id(3)
@@ -131,9 +138,19 @@ def _ragged_kernel(
 
     @pl.when(j == n_slots - 1)
     def _finish():
-        # fully-masked blocks (idle slot / past-q_len block) emit zeros
-        l = jnp.where(l_scr[:] > 0, l_scr[:], 1.0)
-        o_ref[0, 0, :, :] = (acc_scr[:] / l).astype(o_ref.dtype)
+        if emit_partials:
+            # split-k contract (models/dist_decode._merge, base-2 domain):
+            # hand back the UNNORMALIZED accumulator plus the (m, l)
+            # running-softmax state so the caller can LSE-merge this
+            # partial with another band's.  m/l broadcast across the lane
+            # tile; the host reads lane 0.
+            o_ref[0, 0, :, :] = acc_scr[:]
+            m_ref[0, 0, :, :] = jnp.broadcast_to(m_scr[:], m_ref.shape[2:])
+            l_ref[0, 0, :, :] = jnp.broadcast_to(l_scr[:], l_ref.shape[2:])
+        else:
+            # fully-masked blocks (idle slot / past-q_len block) emit zeros
+            l = jnp.where(l_scr[:] > 0, l_scr[:], 1.0)
+            o_ref[0, 0, :, :] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
 def _block_rows(block_q: int, group: int) -> int:
@@ -205,7 +222,8 @@ def _unfold_groups(o, n_q, group, n_qblk, bq, rows, qt):
 
 def ragged_paged_attention(q, k_pages, v_pages, page_table, q_lens, kv_lens,
                            *, k_scales=None, v_scales=None, window=None,
-                           scale=None, block_q=8, interpret=None):
+                           scale=None, block_q=8, interpret=None,
+                           ctx_lo=None, emit_partials=False):
     """Mixed prefill+decode ragged attention against a paged KV pool.
 
     q          [S, Nq, QT, D]   query tokens per slot; slot s's token t is
@@ -223,6 +241,19 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, q_lens, kv_lens,
     window     static int       sliding-window band per query position
     k_scales / v_scales         per-token dequant scales for int8 pools
     block_q    static int       query tokens per grid block
+
+    ctx_lo / emit_partials are the split-k hooks the grouped shared-prefix
+    front-end (ragged_paged_attention_grouped) drives; plain callers leave
+    them at their defaults and the traced program is unchanged:
+
+    ctx_lo     [S] int32        PAGE-ALIGNED per-slot lower context bound —
+                                pool positions below it are excluded (their
+                                page-slots predicated off, exactly the `lo`
+                                page-skip the window path uses)
+    emit_partials  static bool  return the unnormalized split-k partial
+                                (acc [S,Nq,QT,D], m [S,Nq,QT,1],
+                                l [S,Nq,QT,1], all fp32, base-2 softmax
+                                domain) instead of the normalized output
 
     Returns [S, Nq, QT, D] in q's dtype.  A pure-decode batch (QT == 1)
     is bit-identical to paged_decode_attention on the same pool.
@@ -257,6 +288,10 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, q_lens, kv_lens,
         # per-row edges re-tighten inside the kernel.  q_len == 1 reduces
         # to the decode kernel's max(len - window, 0).
         lo = jnp.maximum(kv_lens - q_lens - window + 1, 0)
+    if ctx_lo is not None:
+        # page-aligned exclusion of a shared-prefix band: whole pages drop
+        # out of the `live` predicate, so no sub-page masking is needed
+        lo = jnp.maximum(lo, ctx_lo.astype(jnp.int32))
 
     def q_map(s_, h, qi, j, table, n_live_, kvlen_, qlen_, lo_):
         return (s_, h, qi, 0)
@@ -270,6 +305,7 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, q_lens, kv_lens,
     kernel = functools.partial(
         _ragged_kernel, scale=scale, page=page, n_slots=n_slots,
         bq=bq, g=group, quant=quant, window=window,
+        emit_partials=emit_partials,
     )
     in_specs = [
         pl.BlockSpec((1, 1, rows, d), q_map),
@@ -286,11 +322,19 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, q_lens, kv_lens,
         in_specs.append(pl.BlockSpec((None, 1, 1, page), sc_map))
         in_specs.append(pl.BlockSpec((None, 1, 1, page), sc_map))
         inputs += [k_scales[:, :, None, :], v_scales[:, :, None, :]]
+    out_spec = pl.BlockSpec((1, 1, rows, d), q_map)
+    out_shape = jax.ShapeDtypeStruct((s, n_kv, n_qblk * rows, d), q.dtype)
+    if emit_partials:
+        # acc stays fp32 (unnormalized); m/l ride in d-wide lane tiles
+        f32 = functools.partial(jax.ShapeDtypeStruct,
+                                (s, n_kv, n_qblk * rows, d))
+        out_shape = (f32(jnp.float32), f32(jnp.float32), f32(jnp.float32))
+        out_spec = (out_spec, out_spec, out_spec)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(s, n_kv, n_qblk, n_slots),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, rows, d), q_map),
+        out_specs=out_spec,
         scratch_shapes=[
             pltpu.VMEM((rows, 1), jnp.float32),
             pltpu.VMEM((rows, 1), jnp.float32),
@@ -300,7 +344,7 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, q_lens, kv_lens,
     o = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((s, n_kv, n_qblk * rows, d), q.dtype),
+        out_shape=out_shape,
         compiler_params=tpu_compiler_params(
             vmem_limit_bytes=VMEM_LIMIT,
             dimension_semantics=("parallel", "parallel", "arbitrary",
@@ -308,7 +352,125 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, q_lens, kv_lens,
         ),
         interpret=interpret,
     )(*inputs)
-    return _unfold_groups(o, n_q, group, n_qblk, bq, rows, qt)
+    unfold = functools.partial(_unfold_groups, n_q=n_q, group=group,
+                               n_qblk=n_qblk, bq=bq, rows=rows, qt=qt)
+    if emit_partials:
+        acc, m, l = o
+        return unfold(acc), unfold(m)[..., :1], unfold(l)[..., :1]
+    return unfold(o)
+
+
+def ragged_paged_attention_grouped(
+        q, k_pages, v_pages, page_table, q_lens, kv_lens, *,
+        group_id, shared_table, shared_lens,
+        k_scales=None, v_scales=None, window=None, scale=None,
+        block_q=8, interpret=None):
+    """Shared-prefix grouped variant: score each group's shared pages ONCE,
+    LSE-merge with every member's private-suffix partial.
+
+    Co-batched requests admitted through the prefix cache pin the SAME
+    physical pages for their common prompt prefix.  The plain launch walks
+    every slot's full page table, re-fetching (and re-scoring) those pages
+    per member.  Here the pool gather for the shared band happens once per
+    GROUP (`k_pages[shared_table]` — G x n_sh pages instead of S x n_slots),
+    members score against the group buffer, and the result is merged with
+    the private band exactly the way models/dist_decode._merge folds split-k
+    partials — in the kernel's base-2 softmax domain, so the merge algebra
+    matches the one-launch online softmax op for op.
+
+    group_id     [S] int32      group index per slot; slots whose group has
+                                shared_lens == 0 degenerate to the plain
+                                launch result (merge with an empty partial)
+    shared_table [G, n_sh] int32  pool pages of each group's shared prefix
+                                (page-0 padded past its length)
+    shared_lens  [G] int32      shared tokens per group — MUST be a page
+                                multiple (the private band's page-skip is
+                                whole-page)
+
+    Every member's shared pages must be a prefix of its own page table
+    (the admission path guarantees this: hit pages are assigned before
+    private pages), and causal masking is applied per query row, so a
+    query INSIDE the shared band (mid-prefill after a partial hit) still
+    sees exactly positions <= its own.
+
+    Returns [S, Nq, QT, D] in q's dtype — numerically equal to the plain
+    launch up to split-k merge reassociation (parity-tested, not bitwise).
+    """
+    s, n_q, qt, d = q.shape
+    n_kv = k_pages.shape[1]
+    page = k_pages.shape[2]
+    group = n_q // n_kv
+    n_sh = shared_table.shape[1]
+    if scale is None:
+        scale = d**-0.5
+    group_id = group_id.astype(jnp.int32)
+    shared_lens = shared_lens.astype(jnp.int32)
+    ctx_lo = shared_lens[group_id]
+
+    # private band: the one-launch kernel, pages below the shared boundary
+    # predicated off, partials handed back unnormalized
+    acc_p, m_p, l_p = ragged_paged_attention(
+        q, k_pages, v_pages, page_table, q_lens, kv_lens,
+        k_scales=k_scales, v_scales=v_scales, window=window, scale=scale,
+        block_q=block_q, interpret=interpret,
+        ctx_lo=ctx_lo, emit_partials=True)
+
+    # shared band: ONE pool gather per group, then a broadcast view per
+    # member.  The quant path mirrors the kernel's precision op for op
+    # (k scored as bf16 with a post-dot column rescale, p*v folded through
+    # bf16) so grouped-vs-plain parity holds at merge-reassociation level
+    # rather than dequant level.
+    quant = k_scales is not None
+    g_n = shared_table.shape[0]
+
+    def _flat(pages, width=d):
+        t = jnp.moveaxis(pages, 2, 1)          # [G, Nkv, n_sh, page, ...]
+        return t.reshape(g_n, n_kv, n_sh * page, *t.shape[4:])[group_id]
+
+    k_s = _flat(k_pages[shared_table])         # [S, Nkv, Tsh, D]
+    v_s = _flat(v_pages[shared_table])
+    qg = q.reshape(s, n_kv, group, qt, d).astype(jnp.float32)
+    qg = qg * (scale * LOG2E)                  # base-2 domain, as the kernel
+    if quant:
+        k_cols = _flat(k_scales[shared_table][..., None])[..., 0]
+        v_cols = _flat(v_scales[shared_table][..., None])[..., 0]
+        sc = jnp.einsum("bngtd,bnjd->bngtj", qg, k_s.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+        sc = sc * k_cols[:, :, None, None, :]
+    else:
+        sc = jnp.einsum("bngtd,bnjd->bngtj", qg, k_s.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+    qp = (kv_lens - q_lens)[:, None] + jnp.arange(qt)[None, :]   # [S, QT]
+    col = jnp.arange(n_sh * page)
+    valid = (col[None, None, :] <= qp[:, :, None])
+    valid &= col[None, None, :] < shared_lens[group_id][:, None, None]
+    if window is not None:
+        valid &= col[None, None, :] >= qp[:, :, None] - window + 1
+    sc = jnp.where(valid[:, None, None, :, :], sc, NEG_INF)
+    m_s = jnp.max(sc, axis=-1, keepdims=True)        # [S,Nkv,G,QT,1]
+    p = jnp.where(valid[:, None, None, :, :], jnp.exp2(sc - m_s), 0.0)
+    l_s = jnp.sum(p, axis=-1, keepdims=True)
+    if quant:
+        acc_s = jnp.einsum(
+            "bngtj,bnjd->bngtd",
+            (p * v_cols[:, :, None, None, :]).astype(jnp.bfloat16),
+            v_s.astype(jnp.bfloat16), preferred_element_type=jnp.float32)
+    else:
+        acc_s = jnp.einsum("bngtj,bnjd->bngtd", p, v_s.astype(jnp.float32),
+                           preferred_element_type=jnp.float32)
+    m_s = m_s.reshape(s, n_q, qt, 1)
+    l_s = l_s.reshape(s, n_q, qt, 1)
+    acc_s = acc_s.reshape(s, n_q, qt, d)
+
+    # split-k merge (dist_decode._merge in base 2, -inf guarded the way
+    # the kernel guards its alpha rebase)
+    m_g = jnp.maximum(m_p, m_s)
+    a_p = jnp.where(m_p >= m_g, 1.0, jnp.exp2(m_p - m_g))
+    a_s = jnp.where(m_s >= m_g, 1.0, jnp.exp2(m_s - m_g))
+    l_g = l_p * a_p + l_s * a_s
+    acc_g = acc_p * a_p + acc_s * a_s
+    o = acc_g / jnp.where(l_g > 0, l_g, 1.0)
+    return o.astype(q.dtype)
 
 
 def ragged_paged_reference(q, k_pages, v_pages, page_table, q_lens, kv_lens,

@@ -47,9 +47,18 @@ prefix is only a valid continuation seed when the engine would have
 deterministically produced it.  Snapshot restore has no such limit —
 the RNG key is part of the snapshot, so sampled streams restore exactly.
 
+Prefix caching (ISSUE 13) snapshots completely: the pool's refcounts
+were always serialized wholesale (`_pool_meta`), and the meta now also
+carries the PrefixCache hash-chain index (`PrefixCache.to_meta`, LRU
+order preserved) plus the ragged engine's slot -> pinned-shared-pages
+map, so a restored engine keeps every sharing relationship — restore
+NEVER re-bumps refcounts (the serialized totals already include the
+cache's references; double-bumping is exactly the leak the checkpoint
+fuzz hunts).
+
 Unsupported for snapshot: engines with a draft model attached
-(speculative mirror state) or a PrefixCache / tp mesh on the legacy
-engine — `save_snapshot` raises rather than silently dropping state.
+(speculative mirror state) or a tp mesh on the legacy engine —
+`save_snapshot` raises rather than silently dropping state.
 """
 
 import json
@@ -332,9 +341,6 @@ def _check_snapshotable(engine, kind: str) -> None:
         raise ValueError("snapshot does not support engines with a draft "
                          "model attached (speculative mirror state)")
     if kind == "legacy":
-        if getattr(engine, "cache", None) is not None:
-            raise ValueError("snapshot does not support a PrefixCache "
-                             "(shared-page refcounts are not serialized)")
         if getattr(engine, "mesh", None) is not None:
             raise ValueError("snapshot does not support a tp-sharded "
                              "legacy engine")
@@ -414,6 +420,14 @@ def snapshot(engine, extra: Optional[dict] = None) -> Tuple[dict, dict]:
                  int(engine.spec_rounds)],
         "extra": extra or {},
     }
+    cache = getattr(engine, "cache", None)
+    if cache is not None:
+        # pool refcounts (including the cache's own references) already
+        # ride in meta["pool"]; this is the index itself, LRU-ordered
+        meta["prefix_cache"] = cache.to_meta()
+        if kind == "ragged":
+            meta["shared"] = [[int(s), [int(p) for p in pages]]
+                              for s, pages in sorted(engine._shared.items())]
     return meta, _paged_arrays(engine.state)
 
 
@@ -462,6 +476,25 @@ def restore_into(engine, snap: dict) -> dict:
     engine._rng = _rng_restore(meta["rng"])
     engine.spec_proposed, engine.spec_accepted, engine.spec_rounds = \
         meta["spec"]
+    cache_meta = meta.get("prefix_cache")
+    if cache_meta is not None:
+        from ..models.paged_decode import PrefixCache
+
+        if getattr(engine, "cache", None) is None:
+            raise ValueError(
+                "snapshot carries a prefix cache; build the restore "
+                "target with prefix_cache=True")
+        # from_meta does NOT re-bump refcounts — _pool_restore already
+        # installed the totals that include the cache's references
+        engine.cache = PrefixCache.from_meta(engine.pool, cache_meta)
+    elif getattr(engine, "cache", None) is not None:
+        # cache-less snapshot into a cache-enabled engine: start empty
+        from ..models.paged_decode import PrefixCache
+
+        engine.cache = PrefixCache(engine.pool)
+    if kind == "ragged":
+        engine._shared = {int(s): tuple(int(p) for p in pages)
+                          for s, pages in meta.get("shared", [])}
     return meta.get("extra", {})
 
 

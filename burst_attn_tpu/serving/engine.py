@@ -84,15 +84,35 @@ _M_RB_FILL = obs.gauge("serve.ragged_batch_fill",
                        "real-token fraction of the last launch's [slots, "
                        "chunk] token grid")
 _M_FALLBACK = obs.counter("burst.fused_fallback")
+# prefix-cache family: admission-time sharing and the write barrier
+_M_PREFIX_HITS = obs.counter("serve.prefix_hits",
+                             "admissions that pinned >= 1 cached prefix page")
+_M_PREFIX_MISSES = obs.counter(
+    "serve.prefix_misses", "cache-enabled admissions finding no cached prefix")
+_M_PAGES_SHARED = obs.counter(
+    "serve.pages_shared", "prefix pages pinned (refcount bumped) at admission")
+_M_COW = obs.counter("serve.cow_copies",
+                     "shared pages privatized by the copy-on-write barrier")
+_M_SKIPPED = obs.counter(
+    "serve.prefill_tokens_skipped",
+    "prompt tokens whose prefill was skipped via cached pages")
+_M_POOL_PHYS = obs.gauge(
+    "serve.page_pool_occupancy_physical",
+    "fraction of usable pool pages physically held (shared pages count "
+    "ONCE — identical to serve.page_pool_occupancy)")
+_M_POOL_LOG = obs.gauge(
+    "serve.page_pool_occupancy_logical",
+    "sum of page refcounts over usable pages — may exceed 1.0; the gap to "
+    "the physical gauge is the pages saved by prefix sharing")
 
 from ..models.decode import sample_logits
 from ..models.paged_decode import (
-    PagePool, PagedState, init_paged_state, paged_decode_step, paged_prefill,
-    provision_capacity, retire_slot,
+    PagePool, PagedState, PrefixCache, init_paged_state, paged_decode_step,
+    paged_prefill, provision_capacity, retire_slot,
 )
 from ..models.transformer import ModelConfig
 from ..ops.ragged_paged import ragged_supported
-from .model import assign_pages, free_slot, ragged_model_step
+from .model import assign_pages, cow_pages, free_slot, ragged_model_step
 
 # reason-string prefix -> bounded counter label, mirroring
 # parallel/burst.py's _FALLBACK_LABELS contract (probe reasons embed
@@ -136,6 +156,7 @@ class RaggedServeEngine:
                  admission: Optional[AdmissionPolicy] = None,
                  draft_params=None, draft_cfg: Optional[ModelConfig] = None,
                  spec_k: int = 4, use_ragged: Optional[bool] = None,
+                 prefix_cache: bool = False, group_attn: bool = True,
                  journal=None):
         self.params = params
         self.cfg = cfg
@@ -160,6 +181,18 @@ class RaggedServeEngine:
         # None: probe per launch width; True/False force a path
         self.use_ragged = use_ragged
         self._attn_cache: Dict[int, str] = {}
+        # content-hashed prefix cache (models/paged_decode.PrefixCache):
+        # admission pins cached pages by refcount and skips their prefill;
+        # every write to a shared page goes through the CoW barrier
+        self.cache = PrefixCache(self.pool) if prefix_cache else None
+        # group_attn: score each prefix group's shared pages once per tick
+        # (attn="grouped") when >= 2 live members share pinned pages;
+        # False keeps the plain per-slot launch (still prefill-skipping)
+        self.group_attn = group_attn
+        # slot -> the tuple of shared page ids pinned at admission; the
+        # grouping key for attn="grouped".  Trimmed when the CoW barrier
+        # privatizes a boundary page, dropped at retire/drain.
+        self._shared: Dict[int, Tuple[int, ...]] = {}
         self.draft = None
         self.spec_k = 0
         if draft_params is not None:
@@ -193,10 +226,22 @@ class RaggedServeEngine:
         raise exc_cls(reason, message)
 
     def _occupancy(self) -> float:
-        """Live pool occupancy, the same value `serve.page_pool_occupancy`
-        exports (fraction of usable pages held; page 0 is the sink)."""
+        """Live PHYSICAL pool occupancy, the same value
+        `serve.page_pool_occupancy` exports (fraction of usable pages
+        held; a shared page counts once; page 0 is the sink)."""
         usable = self.pool.n_pages - 1
         return (usable - self.pool.available) / usable if usable else 0.0
+
+    def _set_pool_gauges(self) -> None:
+        """Physical occupancy (each shared page ONCE — what actually
+        bounds admission) on both the legacy gauge and its explicit
+        `_physical` alias, plus the logical view (sum of refcounts; the
+        gap is pages saved by sharing)."""
+        occ = self._occupancy()
+        _M_POOL.set(occ)
+        _M_POOL_PHYS.set(occ)
+        usable = self.pool.n_pages - 1
+        _M_POOL_LOG.set(self.pool.logical_refs / usable if usable else 0.0)
 
     def submit(self, tokens, max_new_tokens: int) -> int:
         """Queue a prompt; returns a request id.  Raises InvalidRequest
@@ -224,11 +269,15 @@ class RaggedServeEngine:
                          f"{self.pool.n_pages - 1} usable pages total")
         if self.max_queue is not None:
             # pool pressure first: a request that would queue behind others
-            # for pages that are not free only deepens the backlog
-            if self._queue and need > self.pool.available:
+            # for pages that are not free only deepens the backlog; pages
+            # the prefix cache could evict on demand count as free here
+            avail = self.pool.available
+            if self.cache is not None:
+                avail += self.cache.evictable()
+            if self._queue and need > avail:
                 self._reject(LoadShed, RejectReason.POOL_EXHAUSTED,
                              f"load shed (pool-exhausted): request needs "
-                             f"{need} pages, {self.pool.available} free, "
+                             f"{need} pages, {avail} free or evictable, "
                              f"{len(self._queue)} already waiting")
             if len(self._queue) >= self.max_queue:
                 self._reject(LoadShed, RejectReason.QUEUE_FULL,
@@ -300,6 +349,7 @@ class RaggedServeEngine:
             if self.draft is not None:
                 self.dstate = retire_slot(self.dstate, self.dpool, slot)
             self.slots[slot] = None
+        self._shared.clear()
         inflight.sort(key=lambda r: r.rid)
         for req in reversed(inflight):
             req.tokens = []
@@ -311,7 +361,7 @@ class RaggedServeEngine:
             self.journal.sync()
         _M_QUEUE.set(len(self._queue))
         _M_LIVE.set(0)
-        _M_POOL.set(self._occupancy())
+        self._set_pool_gauges()
         return [r.rid for r in inflight]
 
     # -- engine ------------------------------------------------------------
@@ -340,22 +390,82 @@ class RaggedServeEngine:
             self._attn_cache[qt] = "dense" if reason is not None else "ragged"
         return self._attn_cache[qt]
 
+    def _hashes(self, req: _Request) -> List[bytes]:
+        """Full-page rolling hash chain of `req.prompt`, memoized on the
+        request (an attribute, not a dataclass field — checkpoint
+        serialization must not see it)."""
+        h = getattr(req, "_prefix_hashes", None)
+        if h is None:
+            h = PrefixCache.chain(req.prompt, self.page)
+            req._prefix_hashes = h
+        return h
+
+    def _register_prefix(self, slot: int, req: _Request) -> None:
+        """Register a just-prefilled prompt's full pages in the prefix
+        cache.  Runs AFTER the prompt-completing chunk, so any CoW the
+        re-absorbed last token forced has already rewritten the table —
+        the registered page ids are the post-CoW (content-correct) ones;
+        insert() is touch-only for hashes already cached."""
+        if self.cache is None:
+            return
+        hashes = self._hashes(req)
+        if not hashes:
+            return
+        row = np.asarray(self.state.page_table[slot])[:len(hashes)]
+        self.cache.insert(hashes, [int(p) for p in row])
+
     def _admit(self) -> None:
         """Reserve queued requests' full page lifetime into free slots
         (FIFO; the head is never starved by admitting behind it).  No
-        tokens move here — prefill is chunked through subsequent ticks."""
+        tokens move here — prefill is chunked through subsequent ticks.
+
+        With a prefix cache, the head's prompt is first looked up in the
+        hash chain: hit pages are pinned (refcount bumped) and wired into
+        the slot's table directly, chunked prefill resumes at the
+        divergence point, and only the remainder is acquired fresh.  A
+        FULL-prompt hit resumes at T-1 so the last prompt token is
+        re-absorbed through one ragged chunk — that re-scatter into the
+        last shared page is what the CoW barrier privatizes."""
         for slot, occupant in enumerate(self.slots):
             if occupant is not None or not self._queue:
                 continue
             req = self._queue[0]
             need = self._pages_for(len(req.prompt), req.max_new_tokens)
+            hits: List[int] = []
+            if self.cache is not None:
+                hits = self.cache.lookup(self._hashes(req))
+                short = (need - len(hits)) - self.pool.available
+                if short > 0:
+                    self.cache.evict(short)
+                need -= len(hits)
             if need > self.pool.available:
+                if hits:
+                    self.pool.release(hits)
                 break
-            if self.draft is not None and need > self.dpool.available:
+            if self.draft is not None and \
+                    need + len(hits) > self.dpool.available:
+                if hits:
+                    self.pool.release(hits)
                 break
             ids = self.pool.acquire(need)
             try:
-                self.state = assign_pages(self.state, slot, ids)
+                self.state = assign_pages(self.state, slot, hits + ids)
+                if hits:
+                    t_pre = len(hits) * self.page
+                    # full-prompt hit: resume at T-1, not T — the engine
+                    # needs the last token's logits to sample token 0, so
+                    # one token is re-absorbed through a 1-token chunk
+                    t_resume = (t_pre if t_pre < len(req.prompt)
+                                else len(req.prompt) - 1)
+                    self.state = self.state._replace(
+                        lengths=self.state.lengths.at[slot].set(t_resume))
+                    req.n_prefilled = t_resume
+                    self._shared[slot] = tuple(hits)
+                    _M_PREFIX_HITS.inc()
+                    _M_PAGES_SHARED.inc(len(hits))
+                    _M_SKIPPED.inc(t_resume)
+                elif self.cache is not None:
+                    _M_PREFIX_MISSES.inc()
                 if self.draft is not None:
                     # draft prefills its WHOLE prompt now (one program, the
                     # draft is cheap); its cache then tracks the target's
@@ -368,6 +478,10 @@ class RaggedServeEngine:
                         self.dstate, self.dpool, slot,
                         req.max_new_tokens + self.spec_k + 1)
             except Exception:
+                # free_slot releases hits and ids together (one ref each —
+                # the lookup's pin and the acquire both belong to the row)
+                req.n_prefilled = 0
+                self._shared.pop(slot, None)
                 self.state = free_slot(self.state, self.pool, slot)
                 if self.draft is not None:
                     try:
@@ -383,6 +497,66 @@ class RaggedServeEngine:
             self.slots[slot] = req
             _M_ADMITTED.inc()
             _M_QUEUE.set(len(self._queue))
+
+    def _cow_barrier(self, q_lens) -> None:
+        """Privatize every page the imminent launch will scatter into
+        while the allocator holds it at refcount > 1 (serving/model.
+        cow_pages), and trim the slot's pinned-prefix key past the first
+        privatized column.  Gated on pool.has_shared so cache-off and
+        zero-overlap runs never pay the scan."""
+        if not self.pool.has_shared:
+            return
+        for slot, req in enumerate(self.slots):
+            if req is None or not q_lens[slot]:
+                continue
+            self.state, copies = cow_pages(
+                self.state, self.pool, slot, int(q_lens[slot]),
+                cache=self.cache)
+            if not copies:
+                continue
+            _M_COW.inc(len(copies))
+            shared = self._shared.get(slot)
+            if shared:
+                first = min(col for col, _, _ in copies)
+                if first < len(shared):
+                    if first:
+                        self._shared[slot] = shared[:first]
+                    else:
+                        del self._shared[slot]
+
+    def _build_groups(self):
+        """Group live slots whose pinned shared-prefix tuples are EXACTLY
+        equal; returns (group_id[slots], shared_table[n_groups+1, n_sh],
+        shared_lens[n_groups+1]) device arrays, or None unless some group
+        has >= 2 live members (a 1-member "group" saves nothing and would
+        only move its math off the bit-identical plain path).  Group 0 is
+        the null group (shared_lens 0) every ungrouped slot rides in;
+        n_sh is padded to a power of two to bound retraces."""
+        groups: Dict[Tuple[int, ...], List[int]] = {}
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            key = self._shared.get(slot)
+            if key:
+                groups.setdefault(key, []).append(slot)
+        real = sorted((k, v) for k, v in groups.items() if len(v) >= 2)
+        if not real:
+            return None
+        n_sh = max(len(k) for k, _ in real)
+        n_sh = 1 << (n_sh - 1).bit_length()
+        gid = np.zeros((len(self.slots),), np.int32)
+        # group axis padded to slots+1 rows (compile-stable: the traced
+        # shape never varies with how many groups this tick happens to
+        # have; at most slots//2 rows are real, the rest stay null)
+        n_rows = len(self.slots) + 1
+        table = np.zeros((n_rows, n_sh), np.int32)
+        lens = np.zeros((n_rows,), np.int32)
+        for g, (key, members) in enumerate(real, start=1):
+            table[g, :len(key)] = key
+            lens[g] = len(key) * self.page
+            for s in members:
+                gid[s] = g
+        return jnp.asarray(gid), jnp.asarray(table), jnp.asarray(lens)
 
     def _sample(self, logits):
         self._rng, key = jax.random.split(self._rng)
@@ -402,6 +576,7 @@ class RaggedServeEngine:
                 if self.draft is not None:
                     self.dstate = retire_slot(self.dstate, self.dpool, slot)
                 self.slots[slot] = None
+                self._shared.pop(slot, None)
                 self._finished[req.rid] = req.tokens
                 done.append((req.rid, req.tokens))
                 if self.journal is not None:
@@ -411,9 +586,7 @@ class RaggedServeEngine:
             # retirement frees pages AFTER the tick's _note_tick ran; keep
             # the gauges honest so a drained engine reads occupancy 0
             _M_LIVE.set(self.live)
-            usable = self.pool.n_pages - 1
-            _M_POOL.set((usable - self.pool.available) / usable
-                        if usable else 0.0)
+            self._set_pool_gauges()
         return done
 
     def _note_tick(self, dt: float, added: int) -> None:
@@ -421,8 +594,7 @@ class RaggedServeEngine:
         _M_QUEUE.set(len(self._queue))
         live = self.live
         _M_LIVE.set(live)
-        usable = self.pool.n_pages - 1
-        _M_POOL.set((usable - self.pool.available) / usable if usable else 0.0)
+        self._set_pool_gauges()
         if added:
             _M_TOKENS.inc(added)
             _M_TOK_LAT.observe(dt * live / added)
@@ -476,9 +648,21 @@ class RaggedServeEngine:
             else:
                 toks[slot, 0] = self._next_tok[slot]
                 q_lens[slot] = 1
-        logits, self.state = ragged_model_step(
-            self.params, jnp.asarray(toks), jnp.asarray(q_lens), self.state,
-            self.cfg, attn=self._attn_for(qt))
+        self._cow_barrier(q_lens)
+        attn = self._attn_for(qt)
+        groups = (self._build_groups()
+                  if self.group_attn and self._shared and attn == "ragged"
+                  else None)
+        if groups is not None:
+            gid, gtable, glens = groups
+            logits, self.state = ragged_model_step(
+                self.params, jnp.asarray(toks), jnp.asarray(q_lens),
+                self.state, self.cfg, attn="grouped", group_id=gid,
+                shared_table=gtable, shared_lens=glens)
+        else:
+            logits, self.state = ragged_model_step(
+                self.params, jnp.asarray(toks), jnp.asarray(q_lens),
+                self.state, self.cfg, attn=attn)
         choice = self._sample(logits)
 
         kind = ("mixed" if prefilling and len(prefilling) < self.live
@@ -502,6 +686,7 @@ class RaggedServeEngine:
                 was = req.n_prefilled
                 req.n_prefilled = was + int(q_lens[slot])
                 if req.n_prefilled == len(req.prompt):
+                    self._register_prefix(slot, req)
                     # chunk completed the prompt: its last-token logits ARE
                     # the first-token distribution (TTFT lands here)
                     tok = int(choice[slot])
@@ -542,6 +727,10 @@ class RaggedServeEngine:
         dp, dc = self.draft
         slots = len(self.slots)
         live_mask = np.asarray([r is not None for r in self.slots])
+        # verify writes k+1 tokens per live slot into the TARGET state;
+        # privatize any still-shared boundary page first (the draft pool
+        # is never shared — draft prefill always acquires private pages)
+        self._cow_barrier(np.where(live_mask, k + 1, 0))
         toks_dev = []
         cur = jnp.asarray(self._next_tok)
         bad_d = jnp.zeros(slots, bool)

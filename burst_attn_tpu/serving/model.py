@@ -32,7 +32,9 @@ from ..models.transformer import (
     ModelConfig, _attn_out, _mlp, _qkv_proj, _rms_norm,
 )
 from ..ops.paged_attention import quantize_tokens
-from ..ops.ragged_paged import ragged_paged_attention
+from ..ops.ragged_paged import (
+    ragged_paged_attention, ragged_paged_attention_grouped,
+)
 
 
 def _dense_ragged_attention(q, kp, vp, ks, vs, table, pos, real,
@@ -62,7 +64,8 @@ def _dense_ragged_attention(q, kp, vp, ks, vs, table, pos, real,
          donate_argnums=(3,))
 def ragged_model_step(params, tokens, q_lens, state: PagedState,
                       cfg: ModelConfig, attn: str = "ragged",
-                      all_logits: bool = False):
+                      all_logits: bool = False, group_id=None,
+                      shared_table=None, shared_lens=None):
     """Advance every active slot by its own token count in ONE pass.
 
     tokens  [slots, QT] int32 — slot s consumes tokens[s, :q_lens[s]]
@@ -72,13 +75,25 @@ def ragged_model_step(params, tokens, q_lens, state: PagedState,
             lengths .. lengths+q_lens-1 must be pre-assigned
             (admission/provisioning — the engine's job)
 
+    attn == "grouped" routes the shared-prefix grouped launch: the traced
+    triple (group_id [slots], shared_table [G, n_sh], shared_lens [G])
+    assigns each slot to a prefix group whose pinned pages are scored once
+    and LSE-merged with the slot's private band (ops/ragged_paged.py).
+    The engine only selects this path on ticks where some group has >= 2
+    live members, so "ragged"/"dense" ticks stay bit-identical to today.
+
     Returns (logits, new state with lengths += q_lens):
       all_logits=False: [slots, vocab] fp32 at each slot's LAST consumed
         token — the next-token distribution a scheduler samples from.
       all_logits=True:  [slots, QT, vocab] fp32 (speculative verify).
     """
-    if attn not in ("ragged", "dense"):
-        raise ValueError(f"attn must be 'ragged' or 'dense', got {attn!r}")
+    if attn not in ("ragged", "dense", "grouped"):
+        raise ValueError(
+            f"attn must be 'ragged', 'dense' or 'grouped', got {attn!r}")
+    if attn == "grouped" and (group_id is None or shared_table is None
+                              or shared_lens is None):
+        raise ValueError("attn='grouped' needs group_id, shared_table "
+                         "and shared_lens")
     slots, qt = tokens.shape
     page = state.k_pages[0].shape[2]
     quant = state.k_scales is not None
@@ -121,6 +136,12 @@ def ragged_model_step(params, tokens, q_lens, state: PagedState,
             o = ragged_paged_attention(
                 q, kp, vp, state.page_table, q_lens, kv_lens,
                 k_scales=ks, v_scales=vs, window=cfg.window)
+        elif attn == "grouped":
+            o = ragged_paged_attention_grouped(
+                q, kp, vp, state.page_table, q_lens, kv_lens,
+                group_id=group_id, shared_table=shared_table,
+                shared_lens=shared_lens,
+                k_scales=ks, v_scales=vs, window=cfg.window)
         else:
             o = _dense_ragged_attention(q, kp, vp, ks, vs,
                                         state.page_table, pos, real, cfg)
@@ -161,6 +182,68 @@ def assign_pages(state: PagedState, slot: int, ids) -> PagedState:
     table = state.page_table.at[slot, :len(ids)].set(
         np.asarray(ids, np.int32))
     return state._replace(page_table=table)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _copy_pages_jit(state: PagedState, src, dst):
+    """Device-side page duplication for copy-on-write: every layer's K/V
+    (and int8 scales) at pages src[i] is copied to pages dst[i].  src/dst
+    are traced int32 [n] — one program per copy width, and CoW events copy
+    one page at a time, so exactly one program in practice."""
+    k_pages = tuple(kp.at[dst].set(kp[src]) for kp in state.k_pages)
+    v_pages = tuple(vp.at[dst].set(vp[src]) for vp in state.v_pages)
+    k_scales = v_scales = None
+    if state.k_scales is not None:
+        k_scales = tuple(s.at[dst].set(s[src]) for s in state.k_scales)
+        v_scales = tuple(s.at[dst].set(s[src]) for s in state.v_scales)
+    return state._replace(k_pages=k_pages, v_pages=v_pages,
+                          k_scales=k_scales, v_scales=v_scales)
+
+
+def cow_pages(state: PagedState, pool: PagePool, slot: int,
+              n_tokens: int, cache=None):
+    """Copy-on-write barrier: make every page that will receive K/V writes
+    for `slot`'s next `n_tokens` tokens PRIVATE (refcount 1) before the
+    jitted step scatters into it.
+
+    The scatter in ragged_model_step targets table columns
+    lengths//page .. (lengths+n_tokens-1)//page; any of those pages the
+    allocator holds at refcount > 1 (pinned by the prefix cache and/or
+    other slots) is copied to a fresh page, the table column is rewritten
+    to the copy, and one reference on the shared page is dropped.  Every
+    launch MUST run behind this barrier — burstlint's `pagepool-cow-safe`
+    rule proves the post-barrier invariant (no scatter target at
+    refcount > 1) on a live shared workload.
+
+    Returns (state, copies) where copies is [(col, shared_pid, new_pid)].
+    Raises RuntimeError if the pool cannot supply a replacement page even
+    after evicting unpinned cache pages (`cache` optional).
+    """
+    if n_tokens <= 0:
+        return state, []
+    page = state.k_pages[0].shape[2]
+    length = int(state.lengths[slot])
+    first, last = length // page, (length + int(n_tokens) - 1) // page
+    row = np.asarray(state.page_table[slot])
+    copies = []
+    for col in range(first, min(last, len(row) - 1) + 1):
+        pid = int(row[col])
+        if pid == 0 or pool.refcount(pid) <= 1:
+            continue
+        if pool.available < 1 and cache is not None:
+            cache.evict(1)
+        if pool.available < 1:
+            raise RuntimeError(
+                f"copy-on-write for slot {slot} col {col}: pool exhausted "
+                f"(page {pid} shared at refcount {pool.refcount(pid)})")
+        (new,) = pool.acquire(1)
+        state = _copy_pages_jit(state, jnp.asarray([pid], jnp.int32),
+                                jnp.asarray([new], jnp.int32))
+        state = state._replace(
+            page_table=state.page_table.at[slot, col].set(new))
+        pool.release([pid])
+        copies.append((col, pid, new))
+    return state, copies
 
 
 def free_slot(state: PagedState, pool: PagePool, slot: int) -> PagedState:

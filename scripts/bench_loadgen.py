@@ -14,6 +14,16 @@ headline records in results/:
   headline_loadgen_goodput.json   serve.load_goodput tokens/s (direction:
                                   higher) — COMPLETED requests' tokens per
                                   wall second; partial/shed work excluded
+  headline_loadgen_shared_ttft.json serve.shared_ttft_p99 seconds
+                                  (direction: lower) — p99 TTFT replaying a
+                                  `shared_prefix` trace (>=70% prompt
+                                  overlap) with the prefix cache ON; the
+                                  bench asserts it beats the cache-off
+                                  replay of the same trace, that the
+                                  prefill-skip accounting identity holds
+                                  (skipped + absorbed-on == absorbed-off),
+                                  and token-exactness vs the UNCACHED
+                                  oracle
   headline_loadgen_recovery.json  serve.load_recovery_p99 seconds
                                   (direction: lower) — p99 fault-to-last-
                                   recovered-completion span from a 2-worker
@@ -153,6 +163,58 @@ def main(argv=None) -> int:
     slo["recovered_tokens_replayed"] = crep.recovered_tokens_replayed
     slo["recovered_tokens_resumed"] = crep.recovered_tokens_resumed
     recovery_p99 = float(rec["recovery_p99_s"])
+
+    # ---- shared-prefix phase (ISSUE 13): one shared_prefix trace (>=70%
+    # prompt overlap by construction: 128-token template, <=24-token
+    # private tails) replayed cache-OFF then cache-ON.  Both runs must be
+    # token-exact vs the UNCACHED oracle, the prefill-skip accounting
+    # identity must hold exactly (skipped + absorbed-on == absorbed-off),
+    # and the cache-on p99 TTFT — the `serve.shared_ttft_p99` headline —
+    # must beat the cache-off run of the same trace.
+    strace = synthesize_trace(
+        max(12, args.requests), seed=args.seed + 2, vocab=97,
+        poison_rate=0.0, mean_interarrival_s=0.02, prompt_len_max=24,
+        max_new_max=8, shared_fraction=0.75, n_templates=2,
+        template_len=128, label="loadgen-bench-shared")
+    save_trace(strace, os.path.join(args.out, "traces",
+                                    "loadgen_bench_shared.jsonl"))
+    sspec = dict(engine_spec, max_queue=None, admission=None)
+    s_oracle = oracle_replay(
+        strace, lambda: build_engine(model_spec, sspec))  # UNCACHED oracle
+
+    def _shared_replay(cache_on: bool):
+        eng = build_engine(model_spec, dict(sspec, prefix_cache=cache_on))
+        t0 = obs.histogram("serve.ttft_s").get()
+        pre0 = obs.counter("serve.ragged_batch_prefill_tokens").total()
+        skip0 = obs.counter("serve.prefill_tokens_skipped").total()
+        hit0 = obs.counter("serve.prefix_hits").total()
+        srep = replay_trace(eng, strace, speed=args.speed)
+        assert_token_exact(srep.completed(), s_oracle)
+        return dict(
+            p99=quantile_from_window(
+                t0, obs.histogram("serve.ttft_s").get(), 0.99),
+            prefill=obs.counter(
+                "serve.ragged_batch_prefill_tokens").total() - pre0,
+            skipped=obs.counter(
+                "serve.prefill_tokens_skipped").total() - skip0,
+            hits=obs.counter("serve.prefix_hits").total() - hit0,
+            n_done=srep.n_done)
+
+    _shared_replay(True)             # warm the grouped-launch compiles
+    s_off = _shared_replay(False)    # measured, cache off
+    s_on = _shared_replay(True)      # measured, cache on
+    assert s_on["skipped"] + s_on["prefill"] == s_off["prefill"], (
+        "prefill-skip accounting broken: skipped "
+        f"{s_on['skipped']} + absorbed {s_on['prefill']} != uncached "
+        f"absorbed {s_off['prefill']}")
+    assert s_on["hits"] > 0 and s_on["skipped"] > 0, s_on
+    assert s_on["p99"] <= s_off["p99"], (
+        f"shared-prefix cache did not beat cache-off TTFT: "
+        f"on={s_on['p99']:.6f}s off={s_off['p99']:.6f}s")
+    shared_ttft_p99 = float(s_on["p99"])
+    slo["shared_ttft_p99_on_s"] = shared_ttft_p99
+    slo["shared_ttft_p99_off_s"] = float(s_off["p99"])
+    slo["shared_prefill_tokens_skipped"] = int(s_on["skipped"])
     platform = jax.devices()[0].platform
 
     os.makedirs(args.out, exist_ok=True)
@@ -175,6 +237,17 @@ def main(argv=None) -> int:
             "direction": "higher", "timestamp": time.time(),
             "note": "bench_loadgen.py trace replay — completed requests' "
                     "tokens per wall second"}),
+        ("headline_loadgen_shared_ttft.json", {
+            "metric": "serve.shared_ttft_p99 s @ shared trace "
+                      f"seed={args.seed + 2} overlap>=70% cache-on "
+                      f"{platform}",
+            "value": round(shared_ttft_p99, 6), "unit": "s",
+            "direction": "lower", "timestamp": time.time(),
+            "note": "bench_loadgen.py shared_prefix replay — p99 TTFT with "
+                    "the prefix cache on (beat cache-off "
+                    f"{s_off['p99']:.6f}s in-run; skipped "
+                    f"{int(s_on['skipped'])} prefill tokens; token-exact "
+                    "vs uncached oracle)"}),
         ("headline_loadgen_recovery.json", {
             "metric": "serve.load_recovery_p99 s @ trace "
                       f"seed={args.seed + 1} kill w0 2 workers {platform}",

@@ -16,6 +16,20 @@ replay-from-scratch baseline (strictly fewer on at least one seed —
 the resume-not-replay acceptance property).  A torn final journal line
 is injected on every seed and must be tolerated.
 
+`--cache-seeds N` (default 2, so the gate runs it) fuzzes the PREFIX
+CACHE serialization (ISSUE 13): a cache-enabled engine runs a shared-
+prefix workload (common template + private suffixes, including an
+exact-template prompt whose full-prompt hit forces a copy-on-write) and
+is killed at the two most state-entangled moments — MID-CoW-COPY
+(inside cow_pages: replacement page acquired, shared ref not yet
+dropped) and MID-SHARED-ADMISSION (prefix pages pinned by lookup, not
+yet assigned to the slot).  Recovery restores the snapshot (pool
+refcounts + hash-chain index + slot->shared-pages map) and must deliver
+token-exact streams vs an UNCACHED uninterrupted oracle, after which
+`verify_pool_integrity` recounts every page's expected refcount from
+the live tables + cache index and proves ZERO leaked and ZERO
+double-freed physical pages (and that a full evict drains the pool).
+
 `--transport-seeds N` additionally fuzzes the fleet wire protocol
 (burst_attn_tpu.fleet.transport): per seed a random message stream is
 framed, then truncated / bit-flipped / duplicated; the FrameBuffer must
@@ -118,6 +132,181 @@ def run_seed(seed: int, n_requests: int, out_dir: str) -> dict:
               f"resumed={info.total_resumed} "
               f"baseline={info.baseline_replay} "
               f"(snap@{snap_step} kill@{kill_step}/{n_total_steps})")
+        if not exact:
+            print(f"    oracle: {oracle}\n    got:    {out}")
+    return results
+
+
+class SimKill(BaseException):
+    """Simulated SIGKILL: derives from BaseException so no engine-level
+    `except Exception` rollback runs — a real kill runs nothing."""
+
+
+def verify_pool_integrity(eng) -> None:
+    """Recount every page's EXPECTED refcount from first principles (one
+    ref per live slot table row holding it + one per prefix-cache index
+    entry) and require the pool's actual `_refs` to match exactly.
+
+    A leaked page shows up as actual > expected (held but unreachable), a
+    double-free as actual < expected or as a duplicate free-list entry.
+    Also proves the free list is exactly the complement of the held set."""
+    import numpy as np
+
+    pool = eng.pool
+    expect = [0] * pool.n_pages
+    table = np.asarray(eng.state.page_table)
+    for slot, req in enumerate(eng.slots):
+        if req is None:
+            continue
+        for pid in table[slot]:
+            if int(pid):
+                expect[int(pid)] += 1
+    if getattr(eng, "cache", None) is not None:
+        for pid in eng.cache._pages.values():
+            expect[int(pid)] += 1
+    actual = [int(r) for r in pool._refs]
+    assert actual[1:] == expect[1:], (
+        f"pool refcount mismatch (leak if actual>expected, double-free if "
+        f"<): actual={actual} expected={expect}")
+    free = [int(p) for p in pool._free]
+    assert len(free) == len(set(free)), f"duplicate free-list entry: {free}"
+    held = {i for i in range(1, pool.n_pages) if actual[i] > 0}
+    assert set(free).isdisjoint(held), "freed page still referenced"
+    assert set(free) | held == set(range(1, pool.n_pages)), \
+        "page neither free nor referenced (leak)"
+
+
+CACHE_MODEL_SPEC = dict(vocab=97, d_model=32, n_layers=1, n_heads=2,
+                        n_kv_heads=1, d_head=16, d_ff=64, seed=0)
+CACHE_ENGINE_SPEC = dict(slots=2, n_pages=10, page=128, max_pages_per_seq=2,
+                         chunk=64)
+
+
+def run_cache_seed(seed: int, n_requests: int, out_dir: str) -> dict:
+    """One prefix-cache fuzz round: shared-prefix workload, kill at a
+    cache-entangled point, snapshot+journal recovery, token-exact vs an
+    UNCACHED oracle, zero leaked / double-freed pages."""
+    import numpy as np
+
+    from burst_attn_tpu.loadgen.worker import build_engine
+    from burst_attn_tpu.models import paged_decode as pd
+    from burst_attn_tpu.serving import checkpoint as ckpt
+    from burst_attn_tpu.serving import model as serve_model
+
+    rng = np.random.default_rng([0xCACE, int(seed)])
+    tmpl = [int(t) for t in rng.integers(1, 97, 128)]  # exactly one page
+    prompts = [tmpl + [int(t) for t in rng.integers(1, 97,
+                                                    int(rng.integers(1, 13)))]
+               for _ in range(max(1, n_requests - 1))]
+    prompts.append(list(tmpl))  # exact-template prompt: full-prompt hit
+    budgets = [int(rng.integers(4, 11)) for _ in range(len(prompts))]
+    cached_spec = dict(CACHE_ENGINE_SPEC, prefix_cache=True)
+    snap = os.path.join(out_dir, f"cfuzz_{seed}.npz")
+    jour = os.path.join(out_dir, f"cfuzz_{seed}.jsonl")
+    jour2 = os.path.join(out_dir, f"cfuzz_{seed}_rewrite.jsonl")
+
+    def submit_all(eng, journal=None):
+        for i, (p, mx) in enumerate(zip(prompts, budgets)):
+            res = eng.try_submit(p, mx)
+            assert res.ok, res
+            if journal is not None:
+                journal.submit(res.rid, i + 100, p, mx)
+        if journal is not None:
+            journal.sync()
+
+    def drive(eng, out):
+        n = 0
+        while len(out) < len(prompts):
+            for rid, toks in eng.step():
+                out[rid + 100] = toks
+            n += 1
+            assert n < 10_000
+        return n
+
+    # oracle: UNCACHED uninterrupted run — the exactness bar
+    eng = build_engine(CACHE_MODEL_SPEC, CACHE_ENGINE_SPEC)
+    submit_all(eng)
+    oracle = {}
+    n_total_steps = drive(eng, oracle)
+
+    results = {}
+    for mode in ("mid-cow", "mid-admission"):
+        snap_step = 1
+        journal = ckpt.TokenJournal(jour, truncate=True)
+        eng = build_engine(CACHE_MODEL_SPEC, cached_spec, journal=journal)
+        submit_all(eng, journal=journal)
+        rid_map = {i: i + 100 for i in range(len(prompts))}
+        delivered = {}
+
+        armed = {"live": False, "fired": False}
+        if mode == "mid-cow":
+            # kill INSIDE cow_pages: after pool.acquire(1) of the
+            # replacement page, before the table rewrite / shared-ref drop
+            real_copy = serve_model._copy_pages_jit
+
+            def killing_copy(*a, **k):
+                if armed["live"] and not armed["fired"]:
+                    armed["fired"] = True
+                    raise SimKill("mid-CoW-copy")
+                return real_copy(*a, **k)
+
+            serve_model._copy_pages_jit = killing_copy
+            undo = lambda: setattr(serve_model, "_copy_pages_jit", real_copy)
+        else:
+            # kill right after PrefixCache.lookup pinned pages (refcounts
+            # bumped) but before assign_pages wires them into the slot
+            real_lookup = pd.PrefixCache.lookup
+
+            def killing_lookup(self, hashes):
+                ids = real_lookup(self, hashes)
+                if ids and armed["live"] and not armed["fired"]:
+                    armed["fired"] = True
+                    raise SimKill("mid-shared-admission")
+                return ids
+
+            pd.PrefixCache.lookup = killing_lookup
+            undo = lambda: setattr(pd.PrefixCache, "lookup", real_lookup)
+
+        step = 0
+        killed = False
+        try:
+            while len(delivered) < len(prompts) and step < 10_000:
+                for rid, toks in eng.step():
+                    delivered[rid_map[rid]] = toks
+                step += 1
+                if step == snap_step:
+                    ckpt.save_snapshot(eng, snap,
+                                       extra={"rid_map": rid_map,
+                                              "resume_prefix": {}})
+                    armed["live"] = True  # kill at the next entangled event
+        except SimKill:
+            killed = True
+        finally:
+            undo()
+        del eng, journal  # the "SIGKILL": no drain, no close, no sync
+        with open(jour, "ab") as f:
+            f.write(b'{"kind": "tokens", "rid": 0')  # torn tail
+
+        eng = build_engine(CACHE_MODEL_SPEC, cached_spec)
+        info = ckpt.recover_engine(eng, snap, jour)
+        assert info.n_skipped == 1, info.n_skipped
+        verify_pool_integrity(eng)  # restored refcounts internally exact
+        eng.journal = ckpt.rewrite_journal(eng, jour2, info.rid_map,
+                                           info.resume_prefix)
+        out = dict(delivered)
+        out.update(ckpt.run_recovered(eng, info))
+        exact = out == oracle
+        # drain-down: after every request retires, only the cache holds
+        # pages; a full evict must empty the pool with no stragglers
+        verify_pool_integrity(eng)
+        eng.cache.evict(eng.pool.n_pages)
+        leak_free = (eng.pool.in_use == 0
+                     and all(r == 0 for r in eng.pool._refs[1:]))
+        results[mode] = dict(exact=exact, killed=killed,
+                             leak_free=leak_free)
+        status = "OK" if exact and killed and leak_free else "FAIL"
+        print(f"  cache seed={seed} {mode:>14}: {status} killed={killed} "
+              f"exact={exact} leak_free={leak_free}")
         if not exact:
             print(f"    oracle: {oracle}\n    got:    {out}")
     return results
@@ -236,6 +425,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python scripts/fuzz_checkpoint.py")
     ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--requests", type=int, default=4)
+    ap.add_argument("--cache-seeds", type=int, default=2,
+                    help="prefix-cache kill-point seeds (mid-CoW-copy + "
+                         "mid-shared-admission per seed); 0 disables")
     ap.add_argument("--transport-seeds", type=int, default=0,
                     help="also fuzz the fleet frame transport for N seeds "
                          "(truncate / bit-flip / duplicate mutations)")
@@ -250,6 +442,10 @@ def main(argv=None) -> int:
                 if not r["exact"] or r["replayed"] > r["baseline"]:
                     failures += 1
                 any_strict = any_strict or r["strict"]
+        for seed in range(args.cache_seeds):
+            for mode, r in run_cache_seed(seed, args.requests, td).items():
+                if not (r["exact"] and r["killed"] and r["leak_free"]):
+                    failures += 1
     for seed in range(args.transport_seeds):
         try:
             st = run_transport_seed(seed)
@@ -272,6 +468,10 @@ def main(argv=None) -> int:
     if args.seeds:
         parts.append(f"{args.seeds} seeds x 2 recovery paths token-exact, "
                      "recomputation bounded by journal lag")
+    if args.cache_seeds:
+        parts.append(f"{args.cache_seeds} cache seeds x 2 kill points "
+                     "(mid-CoW, mid-admission) token-exact, zero "
+                     "leaked/double-freed pages")
     if args.transport_seeds:
         parts.append(f"{args.transport_seeds} transport seeds clean "
                      "(CRC rejects, dedup holds, retry completes)")

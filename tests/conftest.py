@@ -153,6 +153,13 @@ _SLOW = {
     ("test_window.py", "test_model_trains_with_window"),
     ("test_window.py", "test_ring_truncation_matches_dense"),
     ("test_window.py", "test_window_double_ring_matches_dense"),
+    # pagepool-cow-safe mutants each re-serve the full sharing schedule;
+    # tier-1 keeps the rule's clean run (test_clean_run_on_real_package)
+    # and registration canary
+    ("test_analysis.py", "test_poolcheck_skipped_cow_fires"),
+    ("test_analysis.py", "test_poolcheck_refcount_leak_fires"),
+    # grouped-kernel parity: tier-1 keeps the fp32 canary
+    ("test_prefix_cache.py", "test_grouped_matches_plain_variants"),
 }
 
 

@@ -817,3 +817,56 @@ def test_elision_mutation_fires_fused_ring_schedule():
     f3 = ringcheck.verify_elided_program(
         sched.compile_bwd("uni", world).export(), r_live, where="mutation")
     assert any("DEAD" in f.message for f in f3)
+
+
+# ---------------------------------------------------------------------------
+# pagepool-cow-safe mutations (ISSUE 13): the prefix-cache write barrier.
+# poolcheck drives a real tiny prefix-cache engine and checks every launch's
+# scatter columns against the live allocator, then proves the pool drains;
+# the mutations below seed exactly the two silent-corruption defects the
+# rule exists to catch.  The clean run rides tier-1 via
+# test_clean_run_on_real_package; the mutants are slow-marked (each spins
+# up and serves the full sharing schedule).
+
+
+def test_poolcheck_rule_registered():
+    from burst_attn_tpu.analysis import poolcheck  # noqa: F401
+
+    assert "pagepool-cow-safe" in RULES
+    assert RULES["pagepool-cow-safe"].kind == "jaxpr"
+    # the anchor must resolve into the live engine source, not <trace>
+    path, line = poolcheck._anchor()
+    assert path.endswith("engine.py") and line > 0
+
+
+def test_poolcheck_skipped_cow_fires(monkeypatch):
+    """A launch that scatters into a refcount>1 page (CoW barrier no-op'd)
+    is silent cross-request corruption — the rule must see it."""
+    from burst_attn_tpu.analysis import poolcheck
+    from burst_attn_tpu.serving import engine as eng_mod
+
+    monkeypatch.setattr(
+        eng_mod, "cow_pages",
+        lambda state, pool, slot, n, cache=None: (state, []))
+    findings = poolcheck.check_all()
+    assert "pagepool-cow-safe" in _rules_of(findings)
+    assert any("shared page" in f.message and "refcount" in f.message
+               for f in findings), [f.format() for f in findings]
+
+
+def test_poolcheck_refcount_leak_fires(monkeypatch):
+    """A release that decrements but never returns pages to the free list
+    leaks the whole pool over time — the drain check must see it."""
+    from burst_attn_tpu.analysis import poolcheck
+    from burst_attn_tpu.models import paged_decode as pd
+
+    def leaky(self, ids):
+        for i in [int(j) for j in ids]:
+            if 0 < i < self.n_pages and self._refs[i] > 0:
+                self._refs[i] -= 1  # decremented but NEVER freed
+
+    monkeypatch.setattr(pd.PagePool, "release", leaky)
+    findings = poolcheck.check_all()
+    assert "pagepool-cow-safe" in _rules_of(findings)
+    assert any("leak" in f.message for f in findings), [
+        f.format() for f in findings]

@@ -1,0 +1,421 @@
+"""Multi-tenant prefix caching (ISSUE 13): pool algebra, hash-chain
+index, CoW write barrier, grouped shared-prefix attention, and the
+engine/checkpoint integration on top of them.
+
+Correctness bar (ISSUE 13): every cached serve is TOKEN-EXACT against
+the uncached oracle — sharing may only change how K/V is stored and
+scored, never which token is argmaxed.  The pure-host pool/index/trace
+tests and one kernel-parity canary plus one engine self-oracle canary
+ride tier-1 alongside the cheap engine-integration checks (they reuse
+the canary's jit cache); the parity variant sweep is slow-registered in
+conftest (full / --serve lanes)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from burst_attn_tpu import obs
+from burst_attn_tpu.loadgen.worker import build_engine
+from burst_attn_tpu.models.paged_decode import PagePool, PrefixCache
+from burst_attn_tpu.ops.paged_attention import quantize_tokens
+from burst_attn_tpu.ops.ragged_paged import (
+    ragged_paged_attention, ragged_paged_attention_grouped,
+)
+
+MODEL_SPEC = dict(vocab=97, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                  d_head=16, d_ff=64, seed=0)
+ENGINE_SPEC = dict(slots=2, n_pages=10, page=128, max_pages_per_seq=2,
+                   chunk=64, prefix_cache=True)
+PAGE = 128
+
+
+# ---------------------------------------------------------------------------
+# pool algebra (pure host, no jax)
+
+
+def test_pool_refcount_lifecycle():
+    """acquire -> share -> release algebra, and the derived occupancy
+    views (in_use physical, logical_refs, has_shared, available)."""
+    pool = PagePool(6)  # page 0 reserved: 5 usable
+    assert pool.available == 5 and pool.in_use == 0
+    assert pool.logical_refs == 0 and not pool.has_shared
+
+    a, b = pool.acquire(2)
+    assert pool.refcount(a) == 1 and pool.refcount(b) == 1
+    assert pool.in_use == 2 and pool.logical_refs == 2
+    assert not pool.has_shared  # refcount 1 everywhere is NOT sharing
+
+    pool.share([a])  # a second sequence pins page a
+    assert pool.refcount(a) == 2
+    assert pool.in_use == 2          # physical: a counts once
+    assert pool.logical_refs == 3    # logical: a counts twice
+    assert pool.has_shared
+
+    pool.release([a])  # one of the two references drops
+    assert pool.refcount(a) == 1 and pool.in_use == 2
+    assert not pool.has_shared
+    pool.release([a, b])  # last references: both pages return
+    assert pool.available == 5 and pool.in_use == 0
+    assert pool.logical_refs == 0
+    # freed pages are recyclable at refcount 1 again
+    c = pool.acquire(1)[0]
+    assert pool.refcount(c) == 1
+
+
+def test_pool_share_and_release_guardrails():
+    pool = PagePool(4)
+    (a,) = pool.acquire(1)
+    with pytest.raises(ValueError):
+        pool.share([0])          # the reserved sink is never shareable
+    free = pool._free[-1]
+    with pytest.raises(ValueError):
+        pool.share([free])       # sharing a FREE page would resurrect it
+    pool.share([a])
+    with pytest.raises(ValueError):
+        pool.release([a, a, a])  # 3 releases against 2 references
+    assert pool.refcount(a) == 2  # failed release must not half-apply
+
+
+# ---------------------------------------------------------------------------
+# hash-chain index: full pages only, no false hits, LRU/leaf discipline
+
+
+def test_chain_hashes_full_pages_only_and_diverge():
+    rng = np.random.default_rng(0)
+    toks = rng.integers(1, 97, size=2 * PAGE + 57)
+    chain = PrefixCache.chain(toks, PAGE)
+    assert len(chain) == 2  # the 57-token tail page is NOT hashable
+    assert PrefixCache.chain(toks[:PAGE - 1], PAGE) == []
+    # same prefix -> same chain; the chain is positional (hash-chained),
+    # so a one-token flip in page 0 changes EVERY downstream hash
+    assert PrefixCache.chain(toks[:2 * PAGE], PAGE) == chain
+    flipped = toks.copy()
+    flipped[3] = (flipped[3] % 96) + 1
+    other = PrefixCache.chain(flipped, PAGE)
+    assert other[0] != chain[0] and other[1] != chain[1]
+    # a flip in page 1 leaves page 0's hash intact
+    flipped2 = toks.copy()
+    flipped2[PAGE + 3] = (flipped2[PAGE + 3] % 96) + 1
+    other2 = PrefixCache.chain(flipped2, PAGE)
+    assert other2[0] == chain[0] and other2[1] != chain[1]
+
+
+def test_lookup_longest_prefix_no_false_hits():
+    rng = np.random.default_rng(1)
+    pool = PagePool(8)
+    cache = PrefixCache(pool)
+    toks = rng.integers(1, 97, size=3 * PAGE)
+    chain = PrefixCache.chain(toks, PAGE)
+    pages = pool.acquire(3)
+    cache.insert(chain, pages)
+    assert [pool.refcount(p) for p in pages] == [2, 2, 2]
+
+    # full hit: all three pages, each refcount bumped for the caller
+    hits = cache.lookup(chain)
+    assert hits == pages
+    assert [pool.refcount(p) for p in pages] == [3, 3, 3]
+    pool.release(hits)
+
+    # divergence after page 1: lookup stops at the first miss — page 2
+    # must NOT hit even though its hash IS cached further down the chain
+    div = toks.copy()
+    div[PAGE + 1] = (div[PAGE + 1] % 96) + 1
+    hits = cache.lookup(PrefixCache.chain(div, PAGE))
+    assert hits == pages[:1]
+    pool.release(hits)
+
+    # unrelated prompt: zero hits, zero refcount churn
+    other = rng.integers(1, 97, size=2 * PAGE)
+    assert cache.lookup(PrefixCache.chain(other, PAGE)) == []
+    assert [pool.refcount(p) for p in pages] == [2, 2, 2]
+
+
+def test_evict_leaf_first_skips_shared_and_evictable_bound():
+    rng = np.random.default_rng(2)
+    pool = PagePool(8)
+    cache = PrefixCache(pool)
+    toks = rng.integers(1, 97, size=3 * PAGE)
+    chain = PrefixCache.chain(toks, PAGE)
+    pages = pool.acquire(3)
+    cache.insert(chain, pages)
+    pool.release(pages)  # the sequence retires; only the cache holds them
+    assert cache.evictable() == 3
+
+    # a live sequence re-pins the first two pages: they must survive
+    # eviction, and only the leaf (page 2) is actually freeable
+    pinned = cache.lookup(chain[:2])
+    assert cache.evictable() == 1
+    assert cache.evict(3) == 1
+    assert pool.refcount(pages[2]) == 0
+    assert [pool.refcount(p) for p in pinned] == [2, 2]
+    pool.release(pinned)
+    # unpinned now: the remaining chain drains leaf-first to empty
+    assert cache.evict(3) == 2
+    assert pool.in_use == 0 and len(cache) == 0
+
+
+def test_cache_meta_roundtrip_preserves_index_without_rebump():
+    rng = np.random.default_rng(3)
+    pool = PagePool(8)
+    cache = PrefixCache(pool)
+    toks = rng.integers(1, 97, size=2 * PAGE)
+    chain = PrefixCache.chain(toks, PAGE)
+    pages = pool.acquire(2)
+    cache.insert(chain, pages)
+    refs_before = list(pool._refs)
+
+    clone = PrefixCache.from_meta(pool, cache.to_meta())
+    # from_meta must NOT re-bump: the pool's serialized refcounts already
+    # include the index's references (double-bump == fuzz-visible leak)
+    assert pool._refs == refs_before
+    assert clone.lookup(chain) == pages
+    pool.release(pages)  # the lookup pins
+    pool.release(pages)  # the original acquire: only the cache holds them
+    # chain structure survives: leaf-first eviction still works
+    assert clone.evict(2) == 2
+
+    with pytest.raises(ValueError):
+        PrefixCache.from_meta(pool, [[chain[0].hex(), "5", ""]])
+
+
+# ---------------------------------------------------------------------------
+# grouped shared-prefix kernel vs the plain one-launch kernel
+
+
+def _grouped_case(rng, *, quant=False):
+    """Slots 0,1 share page 7 (one full page) as group 1; slot 2 rides
+    along in the null group.  Mixed decode + prefill-chunk q_lens."""
+    n_pages, n_kv, d, group, qt = 10, 2, 16, 2, 6
+    kf = rng.standard_normal((n_pages, n_kv, PAGE, d)).astype(np.float32)
+    vf = rng.standard_normal((n_pages, n_kv, PAGE, d)).astype(np.float32)
+    kp, vp = jnp.asarray(kf), jnp.asarray(vf)
+    ks = vs = None
+    if quant:
+        kp, ks = quantize_tokens(kp)
+        vp, vs = quantize_tokens(vp)
+    table = jnp.asarray([[7, 2, 0], [7, 3, 0], [4, 5, 0]], jnp.int32)
+    q_lens = jnp.asarray([1, qt, 3], jnp.int32)
+    kv_lens = jnp.asarray([170, PAGE + qt, 130], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((3, n_kv * group, qt, d)),
+                    jnp.float32)
+    gid = jnp.asarray([1, 1, 0], jnp.int32)
+    st = jnp.asarray([[0], [7]], jnp.int32)
+    sl = jnp.asarray([0, PAGE], jnp.int32)
+    return q, kp, vp, table, q_lens, kv_lens, ks, vs, gid, st, sl
+
+
+def _grouped_vs_plain(rng, *, quant=False, window=None, atol=1e-5):
+    q, kp, vp, table, ql, kl, ks, vs, gid, st, sl = _grouped_case(
+        rng, quant=quant)
+    plain = ragged_paged_attention(q, kp, vp, table, ql, kl,
+                                   k_scales=ks, v_scales=vs, window=window,
+                                   interpret=True)
+    grp = ragged_paged_attention_grouped(
+        q, kp, vp, table, ql, kl, group_id=gid, shared_table=st,
+        shared_lens=sl, k_scales=ks, v_scales=vs, window=window,
+        interpret=True)
+    qt = q.shape[2]
+    real = (np.arange(qt)[None, :] < np.asarray(ql)[:, None])
+    pg = np.moveaxis(np.asarray(plain), 2, 1)
+    gg = np.moveaxis(np.asarray(grp), 2, 1)
+    np.testing.assert_allclose(gg[real], pg[real], atol=atol, rtol=0)
+    return pg, gg, real
+
+
+def test_grouped_matches_plain_fp32():
+    """Fast canary: the split-k LSE merge reassociates the online softmax
+    but must agree with the one-launch kernel to fp32 merge precision —
+    and a null-group rider must come out BITWISE equal (the empty merge
+    contributes exactly +0 / *1)."""
+    pg, gg, real = _grouped_vs_plain(np.random.default_rng(42), atol=1e-5)
+    assert np.array_equal(pg[2][real[2]], gg[2][real[2]])
+
+
+def test_grouped_matches_plain_variants():
+    """Sweep: int8 pools (dequant folded through the same bf16 ops as the
+    plain kernel: merge-level tolerance, not dequant-level), sliding
+    window, and a query row INSIDE the shared band (the full-prompt-hit
+    re-absorption geometry — causal masking must hold row-wise)."""
+    _grouped_vs_plain(np.random.default_rng(43), quant=True, atol=2e-3)
+    _grouped_vs_plain(np.random.default_rng(44), window=100, atol=1e-5)
+
+    rng = np.random.default_rng(45)
+    n_kv, d, group, qt = 2, 16, 2, 4
+    kp = jnp.asarray(rng.standard_normal((8, n_kv, PAGE, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((8, n_kv, PAGE, d)), jnp.float32)
+    table = jnp.asarray([[7, 2], [7, 3]], jnp.int32)
+    # slot 0's single query sits at position 127 — inside the shared band
+    ql = jnp.asarray([1, qt], jnp.int32)
+    kl = jnp.asarray([PAGE, PAGE + qt], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((2, n_kv * group, qt, d)),
+                    jnp.float32)
+    plain = ragged_paged_attention(q, kp, vp, table, ql, kl, interpret=True)
+    grp = ragged_paged_attention_grouped(
+        q, kp, vp, table, ql, kl, group_id=jnp.asarray([1, 1], jnp.int32),
+        shared_table=jnp.asarray([[0], [7]], jnp.int32),
+        shared_lens=jnp.asarray([0, PAGE], jnp.int32), interpret=True)
+    real = (np.arange(qt)[None, :] < np.asarray(ql)[:, None])
+    np.testing.assert_allclose(
+        np.moveaxis(np.asarray(grp), 2, 1)[real],
+        np.moveaxis(np.asarray(plain), 2, 1)[real], atol=1e-5, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# engine integration: the cache may only change WHERE K/V comes from
+
+
+def _shared_prompts(rng):
+    """One 128-token template (exactly one cacheable page), two suffixed
+    prompts, and the exact template (the full-prompt hit whose re-absorbed
+    last token is the organic CoW write)."""
+    tmpl = rng.integers(1, 97, size=PAGE)
+    return [np.concatenate([tmpl, rng.integers(1, 97, size=5)]),
+            np.concatenate([tmpl, rng.integers(1, 97, size=9)]),
+            tmpl.copy()]
+
+
+def _serve(eng, prompts, max_new=4):
+    rids = [eng.submit(p, max_new) for p in prompts]
+    res = eng.run()
+    return [res[r] for r in rids]
+
+
+def test_engine_cached_wave_token_exact_self_oracle():
+    """Fast canary: wave 1 serves three shared-prefix prompts UNCACHED
+    (cold cache — it IS the oracle), wave 2 re-serves the identical
+    prompts through prefix hits + CoW; greedy decode must reproduce wave
+    1's tokens bit-for-bit, and the pool must drain to empty."""
+    eng = build_engine(MODEL_SPEC, ENGINE_SPEC)
+    prompts = _shared_prompts(np.random.default_rng(0xC0FFEE))
+    hits0 = obs.counter("serve.prefix_hits").total()
+    cow0 = obs.counter("serve.cow_copies").total()
+    skip0 = obs.counter("serve.prefill_tokens_skipped").total()
+
+    wave1 = _serve(eng, prompts)
+    wave2 = _serve(eng, prompts)
+    assert wave2 == wave1
+
+    assert obs.counter("serve.prefix_hits").total() - hits0 >= 3
+    # the full-prompt hit re-absorbs its last token into the shared page:
+    # at least that write must have gone through the CoW barrier
+    assert obs.counter("serve.cow_copies").total() - cow0 >= 1
+    assert obs.counter("serve.prefill_tokens_skipped").total() - skip0 >= \
+        3 * (PAGE - 1)
+    # retire everything: the pool drains, so nothing leaked
+    eng.drain()
+    eng.cache.evict(eng.pool.n_pages)
+    assert eng.pool.in_use == 0 and eng.pool.logical_refs == 0
+
+
+def test_engine_cache_on_vs_off_token_exact_and_accounting():
+    """Dual-engine run: cache-on output == cache-off output for every
+    request, prefill accounting balances exactly
+    (skipped_on + prefill_on == prefill_off), and the logical-occupancy
+    gauge exceeds physical while pages are shared."""
+    prompts = _shared_prompts(np.random.default_rng(0xBEEF))
+    off = build_engine(MODEL_SPEC, dict(ENGINE_SPEC, prefix_cache=False))
+    want = [_serve(off, prompts), _serve(off, prompts)]
+
+    on = build_engine(MODEL_SPEC, ENGINE_SPEC)
+    pre0 = obs.counter("serve.ragged_batch_prefill_tokens").total()
+    skip0 = obs.counter("serve.prefill_tokens_skipped").total()
+    got = [_serve(on, prompts), _serve(on, prompts)]
+    assert got == want
+    prefill_on = obs.counter("serve.ragged_batch_prefill_tokens").total() \
+        - pre0
+    skipped = obs.counter("serve.prefill_tokens_skipped").total() - skip0
+    # the off engine absorbed every prompt token through prefill, twice
+    prefill_off = 2 * sum(len(p) for p in prompts)
+    assert skipped + prefill_on == prefill_off
+    assert skipped > 0
+    # sharing is visible in the occupancy algebra while requests are live
+    assert on.pool.logical_refs >= on.pool.in_use
+    on.drain()
+    on.cache.evict(on.pool.n_pages)
+    assert on.pool.in_use == 0 and on.pool.logical_refs == 0
+
+
+def test_engine_grouped_vs_ungrouped_token_exact():
+    """group_attn only changes how shared pages are SCORED (once per
+    group + LSE merge vs per-slot walks) — greedy tokens must match the
+    ungrouped cache-on engine exactly."""
+    prompts = _shared_prompts(np.random.default_rng(0xD00D))
+    a = build_engine(MODEL_SPEC, dict(ENGINE_SPEC, group_attn=False))
+    want = [_serve(a, prompts), _serve(a, prompts)]
+    b = build_engine(MODEL_SPEC, ENGINE_SPEC)  # group_attn defaults True
+    assert [_serve(b, prompts), _serve(b, prompts)] == want
+
+
+def test_checkpoint_roundtrip_mid_shared_flight(tmp_path):
+    """Snapshot an engine while slots share pinned prefix pages; restore
+    into a fresh prefix_cache=True engine: remaining streams bit-match,
+    the cache index still hits, and refcounts drain to zero.  A
+    cache-carrying snapshot must REFUSE a cache-less restore target."""
+    from burst_attn_tpu.serving import checkpoint as ckpt
+
+    prompts = _shared_prompts(np.random.default_rng(0xFACE))
+    eng = build_engine(MODEL_SPEC, ENGINE_SPEC)
+    wave1 = _serve(eng, prompts)
+    # wave 2 mid-flight: admissions have pinned shared pages
+    rids = [eng.submit(p, 4) for p in prompts]
+    eng.step()
+    path = str(tmp_path / "shared.npz")
+    ckpt.save_snapshot(eng, path)
+    expect = eng.run()
+
+    bad = build_engine(MODEL_SPEC, dict(ENGINE_SPEC, prefix_cache=False))
+    with pytest.raises(ValueError, match="prefix_cache=True"):
+        ckpt.restore_into(bad, ckpt.load_snapshot(path))
+
+    eng2 = build_engine(MODEL_SPEC, ENGINE_SPEC)
+    ckpt.restore_into(eng2, ckpt.load_snapshot(path))
+    res = eng2.run()
+    assert [res[r] for r in rids] == [expect[r] for r in rids]
+    assert [res[r] for r in rids] == wave1  # still the uncached oracle
+    # a THIRD wave against the restored engine's index still hits
+    hits0 = obs.counter("serve.prefix_hits").total()
+    assert _serve(eng2, prompts) == wave1
+    assert obs.counter("serve.prefix_hits").total() - hits0 >= 3
+    eng2.drain()
+    eng2.cache.evict(eng2.pool.n_pages)
+    assert eng2.pool.in_use == 0 and eng2.pool.logical_refs == 0
+
+
+# ---------------------------------------------------------------------------
+# shared_prefix traces (loadgen)
+
+
+def test_shared_prefix_trace_deterministic_and_overlapping():
+    from burst_attn_tpu.loadgen.trace import synthesize_trace
+
+    kw = dict(seed=11, vocab=97, shared_fraction=0.6, n_templates=2,
+              template_len=64, prompt_len_max=24)
+    t1 = synthesize_trace(40, **kw)
+    t2 = synthesize_trace(40, **kw)
+    assert t1.requests == t2.requests  # bit-deterministic
+    shared = [r for r in t1.requests if r.kind == "shared_prefix"]
+    assert shared and all(r.overlap_len == 64 for r in shared)
+    assert all(r.prompt_len > r.overlap_len for r in shared)
+    # same template -> bit-identical prefix, private tails diverge
+    by_tmpl = {}
+    for r in shared:
+        by_tmpl.setdefault(r.template_seed, []).append(r)
+    grp = next(g for g in by_tmpl.values() if len(g) >= 2)
+    p0, p1 = grp[0].prompt(97), grp[1].prompt(97)
+    assert np.array_equal(p0[:64], p1[:64])
+    assert not np.array_equal(p0[64:64 + 8], p1[64:64 + 8])
+
+
+def test_zero_shared_fraction_trace_bit_identical_to_legacy():
+    """shared_fraction=0 must not perturb the RNG draw order: traces
+    synthesized by pre-ISSUE-13 code and by this code are the same."""
+    from burst_attn_tpu.loadgen.trace import synthesize_trace
+
+    a = synthesize_trace(30, seed=5, vocab=97, poison_rate=0.1)
+    b = synthesize_trace(30, seed=5, vocab=97, poison_rate=0.1,
+                         shared_fraction=0.0, n_templates=9,
+                         template_len=512)
+    assert a.requests == b.requests
+    assert all(r.kind != "shared_prefix" for r in a.requests)
