@@ -36,7 +36,8 @@ TPU ring.
 Usage:  python -m benchmarks.ring_overlap [--seqs 16384,65536]
         [--mesh 8] [--layout zigzag] [--heads 32] [--dim 128]
         [--pass fwd|bwd|fwd+bwd|all] [--topology uni|bidi|double|all]
-        [--window W] [--out results/ring_overlap.jsonl]
+        [--window W] [--wire-dtype fp32|int8|fp8]
+        [--out results/ring_overlap.jsonl]
 
 --window W dispatches the occupancy-elided contig schedule
 (docs/schedule_ir.md "Occupancy compilation"): both ring legs run the
@@ -50,6 +51,16 @@ dead-round elision removed.
 comm floors (t_comm_uni_s vs the split t_comm_only_s — the reclaimable
 hop latency), "double" factors the flat mesh inter-major and times the
 prefetched inter hop in its floor.
+
+--wire-dtype int8|fp8 runs both ring legs with the wire-precision layer
+(cfg.wire_dtype: rotating payloads quantized to 1 byte/element with fp32
+per-block scales riding the same slots; docs/fused_ring.md) and times an
+additional QUANTIZED comm-only floor per fwd/bwd row (`t_comm_q_s`:
+1-byte carriers + the scale sub-payloads, same hop structure).  Every
+fwd/bwd row also records `wire_bytes_per_round` — the per-round
+per-device ring bytes from schedule.wire_round_bytes, the single
+derivation the obs counters and the schedule-replay test share — so the
+fp32 vs int8 byte ratio is read straight off the jsonl.
 """
 
 import argparse
@@ -117,19 +128,28 @@ def _shard_fwd(mesh, cfg, no_rotate=False, n_rounds=None):
     return jax.jit(lambda q, k, v: fn(q, k, v))
 
 
-def _comm_only(mesh, world, topology="uni", factor=None, n_rounds=None):
+def _comm_only(mesh, world, topology="uni", factor=None, n_rounds=None,
+               wire=None):
     """Comm-only floor of one forward topology, no compute.
 
     n_rounds truncates the uni rotation count to an occupancy-elided
     schedule's r_live (r_live - 1 hops: the elided program never sends the
     dead rounds' chunks at all).
 
+    wire ("int8" | "fp8") is the QUANTIZED floor (t_comm_q_s): the k/v
+    payload rotates as 1-byte carriers (int8 and fp8 both ship 1 B/elem)
+    plus the two per-(batch, kv head) fp32 scale sub-payloads the fused
+    kernels send down the same slots — schedule.wire_round_bytes' fwd
+    accounting.  The quantize cast happens once inside the program, like
+    the real entry's quantize-once-at-entry.
+
     uni     W-1 full-payload rotations of the (k, v) pair.
     bidi    the counter-rotating split: each round moves HALF the payload
             clockwise and half counter-clockwise concurrently, for
             max(ceil, floor)((W-1)/2) rounds — both ICI directions carry
             traffic at once, so on a comm-bound ring this floor is the
-            headroom the bidirectional schedule can claim.
+            headroom the bidirectional schedule can claim.  The (tiny)
+            scale stream rides clockwise.
     double  factored (n_inter, n_intra): per cycle, n_intra-1 intra unit
             hops plus (except the last cycle) one inter hop of n_intra
             positions along the flat axis.
@@ -146,35 +166,43 @@ def _comm_only(mesh, world, topology="uni", factor=None, n_rounds=None):
             lambda x: lax.ppermute(x, "sp", perm), t)
 
     def f(k, v):
+        kv = (k, v)
+        scales = ()
+        if wire is not None:
+            kv = tuple(t.astype(jnp.int8) for t in kv)
+            scales = (jnp.zeros((k.shape[0], k.shape[1], 1, 1),
+                                jnp.float32),) * 2
         if topology == "bidi":
             h_cw = (world - 1 + 1) // 2
             h_ccw = (world - 1) // 2
             half = k.shape[2] // 2
-            cw = (k[:, :, :half], v[:, :, :half])
-            ccw = (k[:, :, half:], v[:, :, half:])
+            cw = tuple(t[:, :, :half] for t in kv)
+            ccw = tuple(t[:, :, half:] for t in kv)
             for j in range(max(h_cw, h_ccw)):
                 if j < h_cw:
                     cw = rot(cw, 1)
+                    scales = rot(scales, 1)
                 if j < h_ccw:
                     ccw = rot(ccw, -1)
             return sum(jnp.sum(t.astype(jnp.float32))
-                       for pair in (cw, ccw) for t in pair)
+                       for t in cw + ccw + scales)
         if topology == "double":
             n_i, n_s = factor
-            kv = (k, v)
             acc = jnp.float32(0.0)
             for c in range(n_i):
                 for _ in range(n_s - 1):
                     kv = rot(kv, 1)
+                    scales = rot(scales, 1)
                 if c < n_i - 1:
                     kv = rot(kv, n_s)  # the prefetched inter hop
+                    scales = rot(scales, n_s)
                 acc = acc + jnp.sum(kv[0].astype(jnp.float32))
-            return acc + jnp.sum(kv[1].astype(jnp.float32))
-        kv = (k, v)
+            return acc + sum(jnp.sum(t.astype(jnp.float32))
+                             for t in kv[1:] + scales)
         for _ in range((n_rounds or world) - 1):
             kv = ppermute_next(kv, "sp")
-        return jnp.sum(kv[0].astype(jnp.float32)) + jnp.sum(
-            kv[1].astype(jnp.float32))
+            scales = ppermute_next(scales, "sp")
+        return sum(jnp.sum(t.astype(jnp.float32)) for t in kv + scales)
 
     fn = shard_map(f, mesh=mesh, in_specs=(spec4,) * 2, out_specs=P(),
                    check_vma=False)
@@ -227,24 +255,37 @@ def _shard_bwd(mesh, cfg, no_rotate=False, n_rounds=None):
     return jax.jit(lambda *a: fn(*a))
 
 
-def _comm_only_bwd(mesh, world, opt_comm, n_rounds=None):
+def _comm_only_bwd(mesh, world, opt_comm, n_rounds=None, wire=None):
     """Comm-only backward floor: W-1 rotations of the 4-operand q-side
     bundle (delta|o, do, q, lse) plus the dq ring's W add-and-forward hops
     (W-1 in-ring + the return-home hop), no compute.  n_rounds truncates
     both streams to an elided schedule's r_live (the dq return-home hop
-    always remains)."""
+    always remains).
+
+    wire ("int8" | "fp8") is the QUANTIZED floor (t_comm_q_s): the
+    bundle's (delta|o, do, q) rotate as 1-byte carriers with three
+    per-(batch, head) fp32 scale scalars riding along (lse stays fp32,
+    exempt from quantization), and the dq stream moves 1 byte/element
+    plus its per-hop refreshed scale — schedule.wire_round_bytes' bwd
+    accounting."""
     spec4 = P(None, None, "sp", None)
     spec3 = P(None, None, "sp")
     first_spec = spec3 if opt_comm else spec4
 
     def f(first, do, q, lse):
         pay = (first, do, q, lse)
-        dq = jnp.zeros(q.shape, jnp.float32)
+        if wire is not None:
+            sc = jnp.zeros((q.shape[0], q.shape[1], 1, 1), jnp.float32)
+            pay = tuple(t.astype(jnp.int8) for t in (first, do, q)) \
+                + (lse, sc, sc, sc)
+            dqs = (jnp.zeros(q.shape, jnp.int8), sc)
+        else:
+            dqs = (jnp.zeros(q.shape, jnp.float32),)
         for _ in range((n_rounds or world) - 1):
             pay = ppermute_next(pay, "sp")
-            dq = ppermute_next(dq, "sp")
-        dq = ppermute_next(dq, "sp")  # return-home hop
-        return sum(jnp.sum(t.astype(jnp.float32)) for t in pay) + jnp.sum(dq)
+            dqs = ppermute_next(dqs, "sp")
+        dqs = ppermute_next(dqs, "sp")  # return-home hop
+        return sum(jnp.sum(t.astype(jnp.float32)) for t in pay + dqs)
 
     fn = shard_map(f, mesh=mesh,
                    in_specs=(first_spec, spec4, spec4, spec3),
@@ -271,8 +312,9 @@ def _shard_fwdbwd(mesh, cfg):
 
 
 def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
-               topology="uni", window=None):
+               topology="uni", window=None, wire_dtype="fp32"):
     on_tpu = jax.default_backend() == "tpu"
+    wire = None if wire_dtype in (None, "fp32") else wire_dtype
     mesh = _mesh(world)
     # --window W: occupancy-elided schedule (contig causal band).  Both ring
     # legs dispatch the elided program; the floors are measured twice —
@@ -315,10 +357,10 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
     win_kw = {} if window is None else {"window": window}
     scan_cfg = burst.BurstConfig(causal=causal, layout=layout,
                                  intra_axis="sp", backend=tile_backend,
-                                 **win_kw)
+                                 wire_dtype=wire, **win_kw)
     fused_cfg = burst.BurstConfig(causal=causal, layout=layout,
                                   intra_axis="sp", backend="fused_ring",
-                                  **topo_kw, **win_kw)
+                                  wire_dtype=wire, **topo_kw, **win_kw)
 
     bench_kw = dict(warmup=2, iters=3, reps=2) if not on_tpu else {}
     os.environ["BURST_FUSED_INTERPRET"] = "1"  # fused legs off-TPU
@@ -339,6 +381,11 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
                 q, k, v, **bench_kw), 6)
             dir_floors["t_comm_dense_s"] = round(bench_fn(
                 _comm_only(mesh, world, topology, factor),
+                k, v, **bench_kw), 6)
+        if wire is not None:
+            dir_floors["t_comm_q_s"] = round(bench_fn(
+                _comm_only(mesh, world, topology, factor, n_rounds=r_live,
+                           wire=wire),
                 k, v, **bench_kw), 6)
         if topology == "bidi":
             # per-direction floors: what each ICI direction costs alone —
@@ -370,6 +417,11 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
             _comm_only_bwd(mesh, world, scan_cfg.optimize_bwd_comm,
                            n_rounds=r_live),
             delta_or_o, do, q, lse.astype(jnp.float32), **bench_kw)
+        if wire is not None:
+            dir_floors["t_comm_q_s"] = round(bench_fn(
+                _comm_only_bwd(mesh, world, scan_cfg.optimize_bwd_comm,
+                               n_rounds=r_live, wire=wire),
+                delta_or_o, do, q, lse.astype(jnp.float32), **bench_kw), 6)
         if r_live is not None:
             dir_floors["t_compute_dense_s"] = round(bench_fn(
                 _shard_bwd(mesh, scan_cfg, no_rotate=True),
@@ -403,6 +455,7 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
         "topology": topology,
         "seq": seq, "world": world, "layout": layout, "heads": n, "dim": d,
         "causal": causal,
+        "wire_dtype": wire_dtype,
         **({} if window is None else {"window": window, "r_live": r_live}),
         **dir_floors,
         "t_scan_s": round(t_scan, 6),
@@ -412,6 +465,18 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
         "tflops_fused": round(pass_f / t_fused / 1e12 / world, 2),
         "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if pass_ in ("fwd", "bwd"):
+        # per-round per-device ring bytes from the shared derivation
+        # (schedule.wire_round_bytes) — what the fp32-vs-int8 acceptance
+        # ratio is read from; streams broken out beside the total
+        from burst_attn_tpu.parallel import schedule as sched
+
+        wb = sched.wire_round_bytes(
+            pass_, wire, b=1, n=n, n_kv=n, s=seq // world, d=d,
+            opt_comm=scan_cfg.optimize_bwd_comm,
+            itemsize=jnp.dtype(dtype).itemsize)
+        rec["wire_bytes_per_round"] = int(sum(wb.values()))
+        rec["wire_round_bytes"] = {kk_: int(vv_) for kk_, vv_ in wb.items()}
     if t_compute is not None:
         rec.update({
             "t_compute_only_s": round(t_compute, 6),
@@ -433,7 +498,7 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
     from burst_attn_tpu import obs
 
     labels = {"seq": seq, "world": world, "layout": layout, "pass": pass_,
-              "topology": topology}
+              "topology": topology, "wire": wire_dtype}
     for key in ("overlap_scan", "overlap_fused", "fused_speedup",
                 "tflops_scan", "tflops_fused"):
         if key in rec:
@@ -466,6 +531,13 @@ def main():
                          "py); bidi records per-direction comm floors, "
                          "double factors the flat mesh inter-major; 'all' "
                          "sweeps the three")
+    ap.add_argument("--wire-dtype", default="fp32",
+                    choices=["fp32", "int8", "fp8"],
+                    help="wire precision for the rotating payloads "
+                         "(cfg.wire_dtype): int8/fp8 run both ring legs "
+                         "quantized and add the t_comm_q_s quantized comm "
+                         "floor; every fwd/bwd row records "
+                         "wire_bytes_per_round either way")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "results", "ring_overlap.jsonl"))
@@ -483,7 +555,8 @@ def main():
             for p in passes:
                 run_config(seq, args.mesh, args.layout, args.heads,
                            args.dim, not args.noncausal, args.out,
-                           pass_=p, topology=topo, window=args.window)
+                           pass_=p, topology=topo, window=args.window,
+                           wire_dtype=args.wire_dtype)
     # one obs export per invocation, beside the jsonl results
     from burst_attn_tpu import obs
 

@@ -67,6 +67,133 @@ def check_trace(closed_jaxpr, *, where: str, anchor,
     return findings
 
 
+# ---------------------------------------------------------------------------
+# wire-precision scale handling (reported under fused-ring-fused)
+
+_QUANT = ("int8", "float8_e4m3fn", "float8_e5m2")
+# prims a still-unscaled dequantized value may flow through: linear in the
+# value, so the deferred per-block scale can still be applied after them
+# (the fused fwd multiplies AFTER the QK/PV dot — distributivity)
+_WIRE_PASS = {
+    "convert_element_type", "reshape", "transpose", "broadcast_in_dim",
+    "squeeze", "expand_dims", "slice", "dynamic_slice", "rev", "copy",
+    "neg", "dot_general", "concatenate", "gather",
+}
+
+
+def _tainted_in(eqn, tainted):
+    return any((v in tainted) for v in eqn.invars if not hasattr(v, "val"))
+
+
+def _map_sub_taint(eqn, tainted):
+    """(subjaxprs, per-sub tainted-invar sets) for control-flow prims whose
+    operand->body mapping is positional; everything else recurses fresh."""
+    name = eqn.primitive.name
+    outs = []
+    if name == "cond":
+        ops = eqn.invars[1:]
+        for br in eqn.params["branches"]:
+            jx = br.jaxpr if hasattr(br, "jaxpr") else br
+            sub_t = {sv for v, sv in zip(ops, jx.invars)
+                     if not hasattr(v, "val") and v in tainted}
+            outs.append((jx, sub_t))
+        return outs
+    for key in ("jaxpr", "call_jaxpr"):
+        sub = eqn.params.get(key)
+        if sub is None:
+            continue
+        jx = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+        if not hasattr(jx, "eqns"):
+            continue
+        if len(jx.invars) == len(eqn.invars):
+            sub_t = {sv for v, sv in zip(eqn.invars, jx.invars)
+                     if not hasattr(v, "val") and v in tainted}
+        else:
+            sub_t = set()
+        outs.append((jx, sub_t))
+    return outs
+
+
+def _walk_wire(jaxpr, tainted, findings, where, path, line, seen):
+    """Taint pass for the wire-rescale proof: a convert FROM a quantized
+    dtype seeds taint; a `mul` clears it (the in-tile rescale); linear
+    pass-through prims propagate it; anything else consuming a tainted
+    value — an add into an accumulator, an exp2, a reduction — means a
+    quantized payload reached accumulation without its scale."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            qin = {str(v.aval.dtype) for v in eqn.invars
+                   if hasattr(v.aval, "dtype")} & set(_QUANT)
+            if qin:
+                findings.append(Finding(
+                    rule="fused-ring-fused", file=path, line=line,
+                    message=f"{where}: dot_general consumes a raw "
+                            f"{'/'.join(sorted(qin))} operand — quantized "
+                            "payloads must convert to f32 (and rescale) "
+                            "around the MXU, never feed it directly"))
+        tin = _tainted_in(eqn, tainted)
+        if name == "convert_element_type":
+            src = str(getattr(eqn.invars[0].aval, "dtype", ""))
+            dst = str(eqn.outvars[0].aval.dtype)
+            if src in _QUANT and dst.startswith(("float", "bfloat")):
+                tainted.add(eqn.outvars[0])
+                continue
+            if tin:
+                tainted.add(eqn.outvars[0])
+            continue
+        if name == "mul":
+            continue  # the rescale: taint (if any) is discharged here
+        subs = _map_sub_taint(eqn, tainted)
+        if subs:
+            for jx, sub_t in subs:
+                key = id(jx)
+                if key in seen and not sub_t:
+                    continue
+                seen.add(key)
+                sub_out = _walk_wire(jx, sub_t, findings, where, path, line,
+                                     seen)
+                if hasattr(jx, "outvars") and len(jx.outvars) == \
+                        len(eqn.outvars):
+                    for ov, sov in zip(eqn.outvars, jx.outvars):
+                        if not hasattr(sov, "val") and sov in sub_out:
+                            tainted.add(ov)
+            continue
+        if not tin:
+            continue
+        if name in _WIRE_PASS:
+            for ov in eqn.outvars:
+                tainted.add(ov)
+            continue
+        findings.append(Finding(
+            rule="fused-ring-fused", file=path, line=line,
+            message=f"{where}: dequantized wire payload reaches `{name}` "
+                    "without an in-tile rescale — every quantized send "
+                    "needs a matching scale multiply before accumulation"))
+    return tainted
+
+
+def check_wire_trace(closed_jaxpr, *, where: str, anchor) -> List[Finding]:
+    """Scale-handling proof over one traced fused program (fused-ring-fused
+    family): every int8/fp8 -> float conversion must meet a `mul` (its
+    per-block scale) before the value is accumulated or leaves the trace,
+    and no dot_general may consume a quantized dtype directly.  Vacuous on
+    dense traces (no quantized converts), so it runs unconditionally."""
+    findings: List[Finding] = []
+    path, line = anchor
+    jaxpr = closed_jaxpr.jaxpr if hasattr(closed_jaxpr, "jaxpr") \
+        else closed_jaxpr
+    out_taint = _walk_wire(jaxpr, set(), findings, where, path, line, set())
+    escaped = [v for v in jaxpr.outvars
+               if not hasattr(v, "val") and v in out_taint]
+    if escaped:
+        findings.append(Finding(
+            rule="fused-ring-fused", file=path, line=line,
+            message=f"{where}: {len(escaped)} output(s) carry a dequantized "
+                    "payload that never met its scale multiply"))
+    return findings
+
+
 def check_all() -> List[Finding]:
     import jax
     import jax.numpy as jnp

@@ -842,6 +842,8 @@ def verify_ring_program(prog: dict, live_deltas=None) -> None:
     RDMA/sweep cost elision exists to remove.  Both directions fire the
     mutation tests in tests/test_analysis.py."""
     assert prog["n_inter"] >= 1 and prog["n_intra"] >= 1
+    wire = prog.get("wire")
+    assert wire in (None, "int8", "fp8"), f"unknown wire dtype {wire!r}"
     world = prog["n_inter"] * prog["n_intra"]
     rows = prog["rows"]
     n_rounds = len(prog["rot_intra"])

@@ -338,8 +338,11 @@ def verify_fused_fwd_trace(closed_jaxpr, *, where: str, anchor,
     sites — schedule.expected_remote_dma of the compiled program (the
     classic uni ring's k+v pair is 2; a bidi ring doubles it, the double
     ring adds the inter-prefetch channel); more would double-send, fewer
-    would starve a stream — and the kernel's dots must pass the
-    fp32-accum/lse-fp32 contract."""
+    would starve a stream — the kernel's dots must pass the
+    fp32-accum/lse-fp32 contract, and any quantized wire payloads must
+    pass the scale-handling proof (numerics.check_wire_trace: every
+    int8/fp8 dequant meets its per-block scale multiply before
+    accumulation; vacuous on dense traces)."""
     from . import numerics
 
     findings: List[Finding] = []
@@ -360,6 +363,8 @@ def verify_fused_fwd_trace(closed_jaxpr, *, where: str, anchor,
                     f"dma_starts (the compiled program's census), traced "
                     f"{len(remote)}"))
     findings += numerics.check_trace(closed_jaxpr, where=where, anchor=anchor)
+    findings += numerics.check_wire_trace(closed_jaxpr, where=where,
+                                          anchor=anchor)
     return findings
 
 
@@ -375,8 +380,9 @@ def verify_fused_bwd_trace(closed_jaxpr, *, where: str, anchor,
     1 for the streamed dq ring hop, 1 for the dq return-home hop; other
     topologies derive theirs from schedule.expected_remote_dma of the
     compiled program.  More would double-send, fewer would starve a
-    stream — and the kernel's dots must pass the fp32-accum/lse-fp32
-    contract."""
+    stream — the kernel's dots must pass the fp32-accum/lse-fp32
+    contract, and quantized wire payloads the scale-handling proof
+    (numerics.check_wire_trace; vacuous on dense traces)."""
     from . import numerics
 
     findings: List[Finding] = []
@@ -397,6 +403,8 @@ def verify_fused_bwd_trace(closed_jaxpr, *, where: str, anchor,
                     f"dma_starts (bundle operands + dq ring/boundary + "
                     f"return-home), traced {len(remote)}"))
     findings += numerics.check_trace(closed_jaxpr, where=where, anchor=anchor)
+    findings += numerics.check_wire_trace(closed_jaxpr, where=where,
+                                          anchor=anchor)
     return findings
 
 
@@ -472,7 +480,16 @@ def verify_ring_programs() -> List[Finding]:
     with all `world` contributions.  r_live configs additionally prove the
     served-offset set equals the live prefix (dead rounds elided, live
     rounds kept) and that elision strictly shrinks the remote-DMA census
-    vs the dense compile of the same topology."""
+    vs the dense compile of the same topology.
+
+    Every row is ALSO recompiled with wire="int8" and proven again, plus
+    the credit-neutrality obligation of the wire-precision layer: scale
+    sub-payloads ride the SAME slot credits as their payloads (second DMA
+    on the same semaphore pair), so the op table, slot banks, and copy-in
+    list must be bit-identical to the dense-wire compile while the
+    remote-DMA census strictly grows (the extra scale call sites)."""
+    import numpy as np
+
     from ..parallel import schedule as sched
 
     findings: List[Finding] = []
@@ -526,6 +543,41 @@ def verify_ring_programs() -> List[Finding]:
                         rule="fused-ring-schedule", file=anchor_ir[0],
                         line=anchor_ir[1],
                         message=f"{tag}: simulation proof failed: {e}"))
+
+            # ---- wire-precision recompile: credit neutrality ----
+            prog_w = compiler(topology, n_intra, n_inter, wire="int8", **kw)
+            try:
+                oracle.verify_ring_program(
+                    prog_w.export(),
+                    live_deltas=tuple(range(r_live)) if elide else None)
+            except AssertionError as e:
+                findings.append(Finding(
+                    rule="fused-ring-schedule", file=anchor_ir[0],
+                    line=anchor_ir[1],
+                    message=f"{tag} wire=int8: simulation proof "
+                            f"failed: {e}"))
+            if not (np.array_equal(np.asarray(prog_w.to_table()),
+                                   np.asarray(prog.to_table()))
+                    and tuple(prog_w.slots) == tuple(prog.slots)
+                    and list(prog_w.copy_in) == list(prog.copy_in)):
+                findings.append(Finding(
+                    rule="fused-ring-schedule", file=anchor_ir[0],
+                    line=anchor_ir[1],
+                    message=f"{tag} wire=int8: op table / slot banks / "
+                            "copy-in differ from the dense compile — "
+                            "scale sub-payloads must ride the SAME slot "
+                            "credits, never new schedule columns"))
+            payload = 2 if kind == "fwd" else 4
+            got_w = sched.expected_remote_dma(prog_w, payload)
+            ref_d = sched.expected_remote_dma(prog, payload)
+            if got_w <= ref_d:
+                findings.append(Finding(
+                    rule="fused-ring-schedule", file=anchor_ir[0],
+                    line=anchor_ir[1],
+                    message=f"{tag} wire=int8: remote-DMA census {got_w} "
+                            f"does not exceed the dense census {ref_d} — "
+                            "the scale streams' extra call sites are "
+                            "missing from the expectation"))
     return findings
 
 
@@ -748,6 +800,18 @@ def verify_fused_topologies() -> List[Finding]:
                         "fused_topology": "bidi"}),
         ("segments-uni-8", "BURST_FUSED_INTERPRET", (("sp", 8),),
          ("sp", None), {"layout": "contig", "max_segment_len": 16}),
+        # wire-precision rows: the quantized traces must keep ZERO XLA
+        # collectives, hit the wire-aware census (expected_remote_dma
+        # counts the scale sub-payload call sites: fwd 2 -> 4 per channel,
+        # bwd bundle 4 -> 7 and dq sites x2), and discharge the
+        # scale-handling proof inside verify_fused_*_trace
+        ("wire-int8-uni-4", "BURST_FUSED_INTERPRET", (("sp", 4),),
+         ("sp", None), {"wire_dtype": "int8"}),
+        ("wire-fp8-bidi-4", "BURST_FUSED_INTERPRET", (("sp", 4),),
+         ("sp", None), {"wire_dtype": "fp8", "fused_topology": "bidi"}),
+        ("wire-int8-double-2ax", "BURST_FUSED_ASSUME_TPU",
+         (("inter", 2), ("intra", 4)), ("intra", "inter"),
+         {"wire_dtype": "int8"}),
     )
     for name, env, axes, (intra_axis, inter_axis), extras in CASES:
         names = tuple(a for a, _ in axes)

@@ -108,6 +108,12 @@ class DevStats(NamedTuple):
     # device (docs/observability.md).
     slot_use_ccw: jnp.ndarray      # i32[MAX_SLOTS]
     slot_use_bwd_ccw: jnp.ndarray  # i32[MAX_SLOTS]
+    # finite-range gauge of the wire quantizer (cfg.wire_dtype): the
+    # largest |value| the symmetric per-block quantization mapped to its
+    # top code this dispatch.  0.0 on the dense wire.  A growing gauge
+    # next to a fixed-range wire dtype means blocks are saturating —
+    # observable here rather than silently clipped on the link.
+    quant_absmax: jnp.ndarray      # f32
 
     def publish(self, registry=None, *, labels: Optional[dict] = None):
         """Fold concrete (post-step) stats into a host metrics registry.
@@ -183,6 +189,10 @@ class DevStats(NamedTuple):
                         "by pass and ring direction").inc(
                         float(slot_tot[j]), slot=j, dir=dir_, **base,
                         **{"pass": pass_})
+        reg.gauge("devstats.quant_absmax",
+                  "largest |value| the wire quantizer mapped to its top "
+                  "code (0 = dense wire; watch for saturation)").set(
+            float(leaves["quant_absmax"].max()), **base)
         reg.counter("devstats.publishes",
                     "DevStats pytrees folded into the registry").inc()
         return reg
@@ -200,7 +210,7 @@ def _slot_vec(slot_use):
 def ring_stats(rounds, rounds_live, attn_pairs, total_pairs, head_dim,
                m, lse, acc, fused_rounds=0, rounds_elided=0, slot_use=None,
                slot_use_bwd=None, slot_use_ccw=None,
-               slot_use_bwd_ccw=None) -> DevStats:
+               slot_use_bwd_ccw=None, quant_absmax=0.0) -> DevStats:
     """Assemble a per-shard DevStats from ring results (traced context).
 
     `m` may be None (fused kernel: the row max never leaves the kernel);
@@ -234,6 +244,7 @@ def ring_stats(rounds, rounds_live, attn_pairs, total_pairs, head_dim,
         slot_use_bwd=_slot_vec(slot_use_bwd),
         slot_use_ccw=_slot_vec(slot_use_ccw),
         slot_use_bwd_ccw=_slot_vec(slot_use_bwd_ccw),
+        quant_absmax=jnp.asarray(quant_absmax, f32),
     )
     # telemetry is non-differentiable by definition: zero the tangents here
     # so downstream cross_reduce/merge arithmetic never asks autodiff for
@@ -244,7 +255,7 @@ def ring_stats(rounds, rounds_live, attn_pairs, total_pairs, head_dim,
 # per-field cross-device reduction when extra (batch/head) mesh axes ride
 # alongside the ring: counts sum, extrema max/min — so the published
 # per-ring-position stats cover the whole shard group at that position
-_REDUCE_MAX = ("m_max", "lse_max")
+_REDUCE_MAX = ("m_max", "lse_max", "quant_absmax")
 _REDUCE_MIN = ("lse_min",)
 
 

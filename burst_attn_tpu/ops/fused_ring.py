@@ -93,7 +93,7 @@ from .pallas_flash import (
 )
 from .tuning import resolve_fused
 from ..parallel import schedule as sched_ir
-from ..parallel.ring import device_roles, ring_coords
+from ..parallel.ring import device_roles, ring_coords, wire_quantize
 from ..utils.compat import axis_size, tpu_compiler_params
 
 # barrier-semaphore namespace for the startup neighbor barrier; any stable
@@ -195,15 +195,20 @@ def _compile_for(cfg, topology: str, n_inter: int, n_intra: int,
                        bwd_slots=getattr(cfg, "fused_bwd_slots", None),
                        ccw_slots=getattr(cfg, "fused_ccw_slots", None),
                        bwd_ccw_slots=getattr(cfg, "fused_bwd_ccw_slots",
-                                             None))
+                                             None),
+                       wire_dtype=getattr(cfg, "wire_dtype", None))
     r_live = occupancy_r_live(cfg, n_inter * n_intra, s)
+    # the program carries the wire dtype so expected_remote_dma and the
+    # byte accounting describe the SAME transfers the kernels emit (each
+    # quantized operand send fires a second remote copy for its scale)
     if pass_ == "fwd":
         return sched_ir.compile_fwd(topology, n_intra, n_inter,
                                     slots=rf.kv_slots, slots1=rf.ccw_slots,
-                                    r_live=r_live)
+                                    r_live=r_live, wire=rf.wire_dtype)
     return sched_ir.compile_bwd(topology, n_intra, n_inter,
                                 slots=rf.bwd_slots, slots1=rf.bwd_ccw_slots,
-                                dq_slots=rf.bwd_slots, r_live=r_live)
+                                dq_slots=rf.bwd_slots, r_live=r_live,
+                                wire=rf.wire_dtype)
 
 
 def supported(cfg, q_shape, k_shape, has_segments: bool, *,
@@ -290,25 +295,29 @@ def supported(cfg, q_shape, k_shape, has_segments: bool, *,
                        bwd_slots=getattr(cfg, "fused_bwd_slots", None),
                        ccw_slots=getattr(cfg, "fused_ccw_slots", None),
                        bwd_ccw_slots=getattr(cfg, "fused_bwd_ccw_slots",
-                                             None))
+                                             None),
+                       wire_dtype=getattr(cfg, "wire_dtype", None))
     del prog
+    wi = rf.wire_itemsize  # rotating-payload tiles: 1 B/elem when quantized
     if pass_ == "bwd":
         # VMEM plan, bwd roles: resident k+v chunk, fp32 dk/dv accumulators,
         # the per-step bundle tiles (q, do, delta|o, lse, arriving dq, local
-        # dq, inter-held dq) — 4-byte worst case, so an oversized shard
-        # falls back instead of failing Mosaic allocation mid-ring
+        # dq, inter-held dq) — 4-byte worst case (rotating tiles priced at
+        # the wire itemsize), so an oversized shard falls back instead of
+        # failing Mosaic allocation mid-ring
         bqb = _pick_block(s, rf.block_q_bwd)
-        vmem = 2 * s * d * 4 + 2 * s * d * 4 + 7 * bqb * d * 4
+        vmem = 2 * s * d * 4 + 2 * s * d * 4 + 3 * bqb * d * wi \
+            + 4 * bqb * d * 4
         if vmem > rf.vmem_budget:
             return (f"VMEM plan {vmem} bytes exceeds fused budget "
                     f"{rf.vmem_budget} (bwd)")
         return None
-    # VMEM plan: resident k+v chunk, packed m/l stats, acc staging — counted
-    # against the per-generation budget (4-byte worst case per element) so
-    # an oversized shard falls back instead of failing Mosaic allocation
-    # mid-ring
+    # VMEM plan: resident k+v chunk (wire itemsize — they arrive over the
+    # ring), packed m/l stats, acc staging — counted against the
+    # per-generation budget so an oversized shard falls back instead of
+    # failing Mosaic allocation mid-ring
     bq = _pick_block(s, rf.block_q)
-    vmem = 2 * s * d * 4 + 2 * b * n * s * 4 + 3 * bq * d * 4
+    vmem = 2 * s * d * wi + 2 * b * n * s * 4 + 3 * bq * d * 4
     if vmem > rf.vmem_budget:
         return (f"VMEM plan {vmem} bytes exceeds fused budget "
                 f"{rf.vmem_budget}")
@@ -394,7 +403,7 @@ def _fused_fwd_kernel(
     q_ref, k_hbm, v_hbm,
     *rest,
     prog, statics, scale, bq, bkv, lp, nqb, nkb, group, n_b, n_h, hw_sync,
-    collect, wnd, has_seg,
+    collect, wnd, has_seg, wire,
 ):
     """One grid step = q-block i of head h, batch b_, ring round r.
 
@@ -428,8 +437,12 @@ def _fused_fwd_kernel(
     R = prog.n_rounds
     n_banks = prog.n_banks
     rest = list(rest)
-    # remaining positional refs: [segq, sega] inputs when has_seg, then the
-    # two outputs, the optional stats output, then the scratch refs
+    # remaining positional refs: [kscale, vscale] inputs when wire, [segq,
+    # sega] inputs when has_seg, then the two outputs, the optional stats
+    # output, then the scratch refs
+    if wire is not None:
+        ksc_hbm = rest.pop(0)    # [B, Nk, 1, 1] f32 per-chunk scales
+        vsc_hbm = rest.pop(0)
     if has_seg:
         segq_ref = rest.pop(0)   # [1, s, 1] VMEM block: LOCAL segment ids
         sega_hbm = rest.pop(0)   # [B, world, 1, s] ANY: every shard's ids
@@ -441,9 +454,22 @@ def _fused_fwd_kernel(
     for _ in range(n_banks):
         kbufs.append(rest.pop(0))
         vbufs.append(rest.pop(0))
-    (kchunk, vchunk, mstat, lstat, accbuf, acc_in, acc_scr, m_sw, l_sw,
-     cp_sem, chunk_sem, acc_sem) = rest[:12]
-    rest = rest[12:]
+    kscbufs, vscbufs = [], []
+    if wire is not None:
+        # fp32 scale sub-banks: same slot indices, same send/recv
+        # semaphores and capacity credits as the payload banks they scale —
+        # the schedule grows no new columns for them
+        for _ in range(n_banks):
+            kscbufs.append(rest.pop(0))
+            vscbufs.append(rest.pop(0))
+    kchunk = rest.pop(0)
+    vchunk = rest.pop(0)
+    if wire is not None:
+        ksc_t = rest.pop(0)      # VMEM (1, 1) f32 per-chunk scale tiles
+        vsc_t = rest.pop(0)
+    (mstat, lstat, accbuf, acc_in, acc_scr, m_sw, l_sw,
+     cp_sem, chunk_sem, acc_sem) = rest[:10]
+    rest = rest[10:]
     ksend, krecv, vsend, vrecv, free = [], [], [], [], []
     for _ in range(n_banks):
         ksend.append(rest.pop(0))
@@ -484,11 +510,17 @@ def _fused_fwd_kernel(
         # per bank the schedule launches from, so every later round
         # (compute reads, RDMA sends) addresses the banks uniformly
         cps = []
+        per = 2 if wire is None else 4
         for idx, (cb, cslot) in enumerate(prog.copy_in):
             cps.append(pltpu.make_async_copy(k_hbm, kbufs[cb].at[cslot],
-                                             cp_sem.at[2 * idx]))
+                                             cp_sem.at[per * idx]))
             cps.append(pltpu.make_async_copy(v_hbm, vbufs[cb].at[cslot],
-                                             cp_sem.at[2 * idx + 1]))
+                                             cp_sem.at[per * idx + 1]))
+            if wire is not None:
+                cps.append(pltpu.make_async_copy(
+                    ksc_hbm, kscbufs[cb].at[cslot], cp_sem.at[per * idx + 2]))
+                cps.append(pltpu.make_async_copy(
+                    vsc_hbm, vscbufs[cb].at[cslot], cp_sem.at[per * idx + 3]))
         for c in cps:
             c.start()
         for c in cps:
@@ -521,6 +553,12 @@ def _fused_fwd_kernel(
             def _wait_bank(b=b):
                 dma_sem_wait(krecv[b].at[slot], kbufs[b].at[slot])
                 dma_sem_wait(vrecv[b].at[slot], vbufs[b].at[slot])
+                if wire is not None:
+                    # the scale sub-payloads ride the SAME recv semaphores:
+                    # retire the payload-sized transfer first, then the
+                    # scale-sized one — the sem drains to zero either way
+                    dma_sem_wait(krecv[b].at[slot], kscbufs[b].at[slot])
+                    dma_sem_wait(vrecv[b].at[slot], vscbufs[b].at[slot])
 
     for ch in statics["ch_active"]:
         send_c, src_c, dst_c, take_c, meta_dst = _SENDC[ch]
@@ -555,6 +593,26 @@ def _fused_fwd_kernel(
                     device_id_type=pltpu.DeviceIdType.LOGICAL)
                 sk.start()
                 sv.start()
+                if wire is not None:
+                    # quantize-on-send is free here — the payload banks hold
+                    # wire-dtype data end to end; each operand's scale rides
+                    # as a second remote copy on the SAME sem pair
+                    ssk = pltpu.make_async_remote_copy(
+                        src_ref=kscbufs[sb].at[src_slot],
+                        dst_ref=kscbufs[ch].at[dst_slot],
+                        send_sem=ksend[ch].at[dst_slot],
+                        recv_sem=krecv[ch].at[dst_slot],
+                        device_id=dst_dev,
+                        device_id_type=pltpu.DeviceIdType.LOGICAL)
+                    ssv = pltpu.make_async_remote_copy(
+                        src_ref=vscbufs[sb].at[src_slot],
+                        dst_ref=vscbufs[ch].at[dst_slot],
+                        send_sem=vsend[ch].at[dst_slot],
+                        recv_sem=vrecv[ch].at[dst_slot],
+                        device_id=dst_dev,
+                        device_id_type=pltpu.DeviceIdType.LOGICAL)
+                    ssk.start()
+                    ssv.start()
                 # no wait here: the transfer overlaps this whole round's
                 # sweep; the drain wait sits at the round's LAST grid step
 
@@ -573,14 +631,21 @@ def _fused_fwd_kernel(
         for b in statics["consume_banks"]:
             @pl.when(bank == b)
             def _load_bank(b=b):
-                lk = pltpu.make_async_copy(kbufs[b].at[slot, b_, kvh],
-                                           kchunk, chunk_sem.at[0])
-                lv = pltpu.make_async_copy(vbufs[b].at[slot, b_, kvh],
-                                           vchunk, chunk_sem.at[1])
-                lk.start()
-                lv.start()
-                lk.wait()
-                lv.wait()
+                cps = [pltpu.make_async_copy(kbufs[b].at[slot, b_, kvh],
+                                             kchunk, chunk_sem.at[0]),
+                       pltpu.make_async_copy(vbufs[b].at[slot, b_, kvh],
+                                             vchunk, chunk_sem.at[1])]
+                if wire is not None:
+                    cps.append(pltpu.make_async_copy(
+                        kscbufs[b].at[slot, b_, kvh], ksc_t,
+                        chunk_sem.at[2]))
+                    cps.append(pltpu.make_async_copy(
+                        vscbufs[b].at[slot, b_, kvh], vsc_t,
+                        chunk_sem.at[3]))
+                for c in cps:
+                    c.start()
+                for c in cps:
+                    c.wait()
 
     # ---- per-(round, batch) segment-id row: gathered table -> VMEM ----
     if has_seg:
@@ -610,9 +675,18 @@ def _fused_fwd_kernel(
 
     def _fold(c0, mask):
         ks = kchunk[pl.ds(c0, bkv), :]
+        if wire is not None:
+            # in-tile rescale on consume (ops/ragged_paged.py's int8-pool
+            # idiom): the wire-dtype tile is cast up and the per-chunk
+            # scalar scale folds into the score AFTER the dot — never a
+            # raw int8/fp8 operand into the MXU, never an unscaled value
+            # into the fp32 accumulators
+            ks = ks.astype(jnp.float32)
         s_t = jax.lax.dot_general(
             q_t, ks, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if wire is not None:
+            s_t = s_t * ksc_t[0, 0]
         if mask is not None:
             s_t = jnp.where(mask, s_t, NEG_INF)
         m_prev = m_sw[:]
@@ -623,9 +697,16 @@ def _fused_fwd_kernel(
             p = jnp.where(mask, p, 0.0)  # all-masked-row nan guard
         l_sw[:] = l_sw[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_sw[:] = m_new
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(vchunk.dtype), vchunk[pl.ds(c0, bkv), :],
-            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        if wire is None:
+            pv = jax.lax.dot_general(
+                p.astype(vchunk.dtype), vchunk[pl.ds(c0, bkv), :],
+                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        else:
+            pv = jax.lax.dot_general(
+                p, vchunk[pl.ds(c0, bkv), :].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * vsc_t[0, 0]
+        acc_scr[:] = acc_scr[:] * alpha + pv
 
     segq = segq_ref[0, pl.ds(r0, bq), :] if has_seg else None   # (bq, 1)
     for j in range(nkb):
@@ -704,6 +785,11 @@ def _fused_fwd_kernel(
             dst_slot = sched_ref[r, dst_c]
             dma_sem_wait(ksend[ch].at[dst_slot], kbufs[ch].at[dst_slot])
             dma_sem_wait(vsend[ch].at[dst_slot], vbufs[ch].at[dst_slot])
+            if wire is not None:
+                dma_sem_wait(ksend[ch].at[dst_slot],
+                             kscbufs[ch].at[dst_slot])
+                dma_sem_wait(vsend[ch].at[dst_slot],
+                             vscbufs[ch].at[dst_slot])
 
     if hw_sync:
         for b in statics["grant_banks"]:
@@ -830,7 +916,9 @@ def fused_ring_fwd(q, k, v, cfg, *, seg=None, interpret=None,
     R = prog.n_rounds
     rf = resolve_fused(cfg.fused_block_q, cfg.fused_block_kv,
                        cfg.fused_kv_slots,
-                       ccw_slots=getattr(cfg, "fused_ccw_slots", None))
+                       ccw_slots=getattr(cfg, "fused_ccw_slots", None),
+                       wire_dtype=getattr(cfg, "wire_dtype", None))
+    wire = rf.wire_dtype
     bq = _pick_block(s, rf.block_q)
     bkv = _pick_block(s, rf.block_kv)
     lp = _pick_block(bq, 128)
@@ -840,11 +928,21 @@ def fused_ring_fwd(q, k, v, cfg, *, seg=None, interpret=None,
     sched, specs = build_sched_table(cfg, prog, s, s,
                                      with_part=seg is not None)
 
+    if wire is not None:
+        # quantize ONCE on the host graph before the kernel: the payload
+        # rotates unchanged, so pre-quantizing the local chunk == quantize-
+        # on-send at every hop.  Per-(batch, kv-head) scalar scales travel
+        # as fp32 sub-banks next to the wire-dtype slot banks.
+        k_in, kscale = wire_quantize(k, wire, (2, 3))
+        v_in, vscale = wire_quantize(v, wire, (2, 3))
+    else:
+        k_in, v_in = k, v
+
     kernel = functools.partial(
         _fused_fwd_kernel, prog=prog, statics=statics, scale=scale, bq=bq,
         bkv=bkv, lp=lp, nqb=nqb, nkb=nkb, group=group, n_b=b, n_h=n,
         hw_sync=not interpret, collect=collect_stats,
-        wnd=cfg.window, has_seg=seg is not None,
+        wnd=cfg.window, has_seg=seg is not None, wire=wire,
     )
 
     def q_map(r, b_, h, i, sp):
@@ -873,11 +971,27 @@ def fused_ring_fwd(q, k, v, cfg, *, seg=None, interpret=None,
 
     scratch = []
     for bank in range(prog.n_banks):
-        scratch.append(pltpu.ANY((prog.slots[bank], b, n_kv, s, d), k.dtype))
-        scratch.append(pltpu.ANY((prog.slots[bank], b, n_kv, s, d), v.dtype))
+        scratch.append(pltpu.ANY((prog.slots[bank], b, n_kv, s, d),
+                                 k_in.dtype))
+        scratch.append(pltpu.ANY((prog.slots[bank], b, n_kv, s, d),
+                                 v_in.dtype))
+    if wire is not None:
+        for bank in range(prog.n_banks):
+            # scale sub-banks: same slot layout, fp32, O(1) per chunk
+            scratch.append(pltpu.ANY((prog.slots[bank], b, n_kv, 1, 1),
+                                     jnp.float32))
+            scratch.append(pltpu.ANY((prog.slots[bank], b, n_kv, 1, 1),
+                                     jnp.float32))
     scratch += [
-        pltpu.VMEM((s, d), k.dtype),                  # kchunk
-        pltpu.VMEM((s, d), v.dtype),                  # vchunk
+        pltpu.VMEM((s, d), k_in.dtype),               # kchunk
+        pltpu.VMEM((s, d), v_in.dtype),               # vchunk
+    ]
+    if wire is not None:
+        scratch += [
+            pltpu.VMEM((1, 1), jnp.float32),          # ksc_t
+            pltpu.VMEM((1, 1), jnp.float32),          # vsc_t
+        ]
+    scratch += [
         pltpu.VMEM((b, n, s // lp, lp), jnp.float32),  # mstat (base-2)
         pltpu.VMEM((b, n, s // lp, lp), jnp.float32),  # lstat (linear)
         pltpu.ANY((b, n, nqb, bq, d), jnp.float32),   # accbuf (carry)
@@ -885,8 +999,9 @@ def fused_ring_fwd(q, k, v, cfg, *, seg=None, interpret=None,
         pltpu.VMEM((bq, d), jnp.float32),             # acc_scr
         pltpu.VMEM((bq, 1), jnp.float32),             # m_sw
         pltpu.VMEM((bq, 1), jnp.float32),             # l_sw
-        pltpu.SemaphoreType.DMA((2 * len(prog.copy_in),)),  # cp_sem
-        pltpu.SemaphoreType.DMA((2,)),                # chunk_sem
+        pltpu.SemaphoreType.DMA(
+            ((2 if wire is None else 4) * len(prog.copy_in),)),  # cp_sem
+        pltpu.SemaphoreType.DMA((2 if wire is None else 4,)),  # chunk_sem
         pltpu.SemaphoreType.DMA((2,)),                # acc_sem
     ]
     for bank in range(prog.n_banks):
@@ -903,7 +1018,14 @@ def fused_ring_fwd(q, k, v, cfg, *, seg=None, interpret=None,
         pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
         pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
     ]
-    inputs = [sched, q, k, v]
+    inputs = [sched, q, k_in, v_in]
+    if wire is not None:
+        # per-block fp32 scales ride along as ANY inputs; the kernel pops
+        # them right after k/v and copies them into the scale slot banks
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY))
+        inputs.append(kscale)
+        inputs.append(vscale)
     if seg is not None:
         # local ids resident per batch; the gathered ring-wide table stays
         # in ANY space and the kernel pulls one partition's row per round
@@ -953,11 +1075,17 @@ def fused_ring_fwd(q, k, v, cfg, *, seg=None, interpret=None,
     pairs = sum(spec_pair_count(sp, s, s, window=cfg.window) for sp in specs)
     live = sum(spec_live(sp, cfg.window).astype(jnp.int32) for sp in specs)
     slot_use = outs[2]
+    qam = 0.0
+    if wire is not None:
+        f32 = jnp.float32
+        qam = jnp.maximum(jnp.max(jnp.abs(k.astype(f32))),
+                          jnp.max(jnp.abs(v.astype(f32))))
     stats = devstats.ring_stats(
         rounds=R, rounds_live=live, attn_pairs=pairs,
         total_pairs=float(R) * s * s, head_dim=d,
         m=None,  # the running row max never leaves the kernel
         lse=lse, acc=o, fused_rounds=R, rounds_elided=prog.world - R,
         slot_use=slot_use[0],
-        slot_use_ccw=slot_use[1] if prog.n_banks > 1 else None)
+        slot_use_ccw=slot_use[1] if prog.n_banks > 1 else None,
+        quant_absmax=qam)
     return o, lse, stats

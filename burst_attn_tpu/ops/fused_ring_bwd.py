@@ -55,10 +55,11 @@ paths mask identically by construction.
 Semaphore ledger (everything drains to zero; N = B*Nq*nqb grid steps per
 round):
 
-  precv[bank][slot]   +4 per arriving bundle, -4 at the consuming round's
-                      first grid step
-  psend[bank][slot]   +4 per outgoing bundle send, -4 at the same round's
-                      last grid step (drain)
+  precv[bank][slot]   +4 per arriving bundle (+7 under wire_dtype: three
+                      fp32 scale sub-payloads ride the same slot), -same
+                      at the consuming round's first grid step
+  psend[bank][slot]   +4 (+7 wire) per outgoing bundle send, -same at the
+                      same round's last grid step (drain)
   dqrecv[bank][slot]  +N from the writer's streamed previous-serving
                       blocks, -N at the serving round's first grid step
   dqsend[bank][slot]  +N per round's streamed ring sends, -N at that
@@ -96,6 +97,7 @@ from .tuning import resolve_fused
 from .fused_ring import (build_sched_table, dma_sem_wait, gather_seg_table,
                          kernel_statics, _SENDC, _GRANTC)
 from ..parallel import schedule as sched_ir
+from ..parallel.ring import WIRE_QMAX, wire_quantize
 from ..utils.compat import axis_size, tpu_compiler_params
 
 # barrier-semaphore namespace, distinct from the fused forward's (13) so a
@@ -103,6 +105,19 @@ from ..utils.compat import axis_size, tpu_compiler_params
 _COLLECTIVE_ID = 14
 
 _LOGICAL = None  # filled lazily to keep module import light
+
+
+def _wire_quant_tile(x, wire):
+    """In-kernel symmetric quantization of one fp32 tile: per-block scalar
+    scale (the dq ring's refreshed per-hop scale).  Mirrors
+    parallel/ring.wire_quantize with keepdims collapsed to a 0-d scalar."""
+    amax = jnp.max(jnp.abs(x))
+    sc = jnp.maximum(amax, 1e-30) / WIRE_QMAX[wire]
+    if wire == "int8":
+        q = jnp.clip(jnp.round(x / sc), -127.0, 127.0).astype(jnp.int8)
+    else:
+        q = (x / sc).astype(jnp.float8_e4m3fn)
+    return q, sc
 
 
 def _col_from_pack(pack, bq, lp):
@@ -155,7 +170,7 @@ def _fused_bwd_kernel(
     first_hbm, do_hbm, q_hbm, lse_hbm, k_hbm, v_hbm,
     *refs,
     prog, statics, dq_statics, scale, bq, bkv, lp, nqb, nkb, group,
-    n_b, n_h, hw_sync, collect, opt_comm, wnd, has_seg,
+    n_b, n_h, hw_sync, collect, opt_comm, wnd, has_seg, wire,
 ):
     """One grid step = bundle q-block i of head h, batch b_, bwd ring round r.
 
@@ -177,14 +192,23 @@ def _fused_bwd_kernel(
     home_banks = sorted(dq_statics["home_rounds"])
     has_dqi = dq_statics["has_dqi"]
     refs = list(refs)
+    # wire-quantized bundles carry three per-(batch, head) fp32 scale
+    # inputs right after the six bundle/kv operands (lse never quantizes)
+    if wire is not None:
+        fsc_hbm = refs.pop(0)    # [B, N, 1, 1] f32 first/delta scales
+        dosc_hbm = refs.pop(0)   # [B, N, 1, 1] f32 do scales
+        qsc_hbm = refs.pop(0)    # [B, N, 1, 1] f32 q scales
     # optional segment-id inputs ride after the six bundle/kv operands:
     # local KV-side ids resident in VMEM, the gathered ring-wide table in
     # ANY (roles swapped vs the forward — the ROTATING side is q here)
     if has_seg:
         segkv_ref = refs.pop(0)  # [1, 1, s] VMEM block: LOCAL kv ids
         sega_hbm = refs.pop(0)   # [B, world, s, 1] ANY: every shard's ids
-    # outputs first: dq per home bank, dk, dv, (slot_use)
+    # outputs first: dq per home bank (+ their scale outputs under wire),
+    # dk, dv, (slot_use)
     dq_refs = [refs.pop(0) for _ in home_banks]
+    dqsc_refs = [refs.pop(0) for _ in home_banks] if wire is not None \
+        else []
     dk_ref = refs.pop(0)
     dv_ref = refs.pop(0)
     if collect:
@@ -195,12 +219,27 @@ def _fused_bwd_kernel(
         dobuf.append(refs.pop(0))
         qbuf.append(refs.pop(0))
         lsebuf.append(refs.pop(0))
+    fscbuf, doscbuf, qscbuf = [], [], []
+    if wire is not None:
+        # fp32 scale sub-banks: same slot indices, same send/recv
+        # semaphores and capacity credits as the bundle banks they scale
+        for _ in range(n_banks):
+            fscbuf.append(refs.pop(0))
+            doscbuf.append(refs.pop(0))
+            qscbuf.append(refs.pop(0))
     dqbuf = [refs.pop(0) for _ in range(dq_banks)]
+    dqscbuf = [refs.pop(0) for _ in range(dq_banks)] if wire is not None \
+        else []
     dqibuf = refs.pop(0) if has_dqi else None
+    dqiscbuf = refs.pop(0) if has_dqi and wire is not None else None
     (kchunk, vchunk, dk_acc, dv_acc,
      q_t, do_t, first_t, lse_t, dq_arr, dqi_arr, dq_scr,
      cp_sem, chunk_sem, kvio_sem, tile_sem, dqio_sem) = refs[:16]
     refs = refs[16:]
+    if wire is not None:
+        # (1, 1) f32 scale tiles + the dq re-quantization staging pair
+        (fsc_t, dosc_t, qsc_t, dqsc_arr, dqisc_arr, dq_q, dqsc_w) = refs[:7]
+        refs = refs[7:]
     psend, precv, free_pay = [], [], []
     for _ in range(n_banks):
         psend.append(refs.pop(0))
@@ -255,22 +294,26 @@ def _fused_bwd_kernel(
             slot_use_ref[bank, slot] = slot_use_ref[bank, slot] + 1
 
     # ---- round choreography (first grid step of the round only) ----
+    # every site that moves "the bundle" walks this list: the four dense
+    # operands plus, under wire, the three scale sub-banks riding the same
+    # slots/semaphores (sends, recv waits, drains and copy_in stay in sync
+    # by construction)
+    bundle_hbm = [first_hbm, do_hbm, q_hbm, lse_hbm]
+    bundle_bufs = [firstbuf, dobuf, qbuf, lsebuf]
+    if wire is not None:
+        bundle_hbm += [fsc_hbm, dosc_hbm, qsc_hbm]
+        bundle_bufs += [fscbuf, doscbuf, qscbuf]
+    per_op = len(bundle_bufs)
+
     @pl.when(first_of_round & (r == 0))
     def _copy_in():
         # local bundle -> its program-designated slot(s): one HBM->HBM copy
         # per operand per launch bank
         cps = []
         for idx, (cb, cslot) in enumerate(prog.copy_in):
-            cps += [
-                pltpu.make_async_copy(first_hbm, firstbuf[cb].at[cslot],
-                                      cp_sem.at[4 * idx]),
-                pltpu.make_async_copy(do_hbm, dobuf[cb].at[cslot],
-                                      cp_sem.at[4 * idx + 1]),
-                pltpu.make_async_copy(q_hbm, qbuf[cb].at[cslot],
-                                      cp_sem.at[4 * idx + 2]),
-                pltpu.make_async_copy(lse_hbm, lsebuf[cb].at[cslot],
-                                      cp_sem.at[4 * idx + 3]),
-            ]
+            for j, (src, bufs) in enumerate(zip(bundle_hbm, bundle_bufs)):
+                cps.append(pltpu.make_async_copy(
+                    src, bufs[cb].at[cslot], cp_sem.at[per_op * idx + j]))
         for c in cps:
             c.start()
         for c in cps:
@@ -303,15 +346,18 @@ def _fused_bwd_kernel(
             def _w(b=b):
                 # one wait per operand transfer; together they retire the
                 # full bundle regardless of landing order
-                for bufs in (firstbuf, dobuf, qbuf, lsebuf):
+                for bufs in bundle_bufs:
                     dma_sem_wait(precv[b].at[slot], bufs[b].at[slot])
 
     @pl.when(first_of_round & (sched_ref[r, sched_ir.DQ_RECV] == 1))
     def _dq_recv_wait():
         # every streamed dq block of the writer's previous serving round:
-        # the n_steps block transfers sum to exactly one slot entry
+        # the n_steps block transfers sum to exactly one slot entry (2x
+        # transfers under wire: each block's payload plus its scale)
         def _w(b):
             dma_sem_wait(dqrecv[b].at[dq_slot], dqbuf[b].at[dq_slot])
+            if wire is not None:
+                dma_sem_wait(dqrecv[b].at[dq_slot], dqscbuf[b].at[dq_slot])
 
         dq_banked(_w)
 
@@ -320,6 +366,8 @@ def _fused_bwd_kernel(
         def _dqi_recv_wait():
             dqi_slot = sched_ref[r, sched_ir.DQI_SLOT]
             dma_sem_wait(dqi_recv.at[dqi_slot], dqibuf.at[dqi_slot])
+            if wire is not None:
+                dma_sem_wait(dqi_recv.at[dqi_slot], dqiscbuf.at[dqi_slot])
 
     for ch in statics["ch_active"]:
         send_c, src_c, dst_c, take_c, meta_dst = _SENDC[ch]
@@ -336,7 +384,7 @@ def _fused_bwd_kernel(
                     pltpu.semaphore_wait(free_pay[ch].at[dst_slot], 1)
 
             def _emit(sb):
-                for bufs in (firstbuf, dobuf, qbuf, lsebuf):
+                for bufs in bundle_bufs:
                     pltpu.make_async_remote_copy(
                         src_ref=bufs[sb].at[src_slot],
                         dst_ref=bufs[ch].at[dst_slot],
@@ -410,6 +458,13 @@ def _fused_bwd_kernel(
                                   tile_sem.at[2]).start()
             pltpu.make_async_copy(lsebuf[b].at[slot, b_, h, i], lse_t,
                                   tile_sem.at[3]).start()
+            if wire is not None:
+                pltpu.make_async_copy(fscbuf[b].at[slot, b_, h], fsc_t,
+                                      tile_sem.at[4]).start()
+                pltpu.make_async_copy(doscbuf[b].at[slot, b_, h], dosc_t,
+                                      tile_sem.at[5]).start()
+                pltpu.make_async_copy(qscbuf[b].at[slot, b_, h], qsc_t,
+                                      tile_sem.at[6]).start()
 
     # start the arriving-dq loads early: they are only needed at the merge,
     # after the whole local sweep
@@ -418,6 +473,9 @@ def _fused_bwd_kernel(
         def _s(b):
             pltpu.make_async_copy(dqbuf[b].at[dq_slot, b_, h, i], dq_arr,
                                   dqio_sem.at[0]).start()
+            if wire is not None:
+                pltpu.make_async_copy(dqscbuf[b].at[dq_slot, b_, h, i],
+                                      dqsc_arr, dqio_sem.at[3]).start()
 
         dq_banked(_s)
 
@@ -427,8 +485,15 @@ def _fused_bwd_kernel(
             pltpu.make_async_copy(
                 dqibuf.at[sched_ref[r, sched_ir.DQI_SLOT], b_, h, i],
                 dqi_arr, dqio_sem.at[2]).start()
+            if wire is not None:
+                pltpu.make_async_copy(
+                    dqiscbuf.at[sched_ref[r, sched_ir.DQI_SLOT], b_, h, i],
+                    dqisc_arr, dqio_sem.at[5]).start()
 
-    for j, tile in enumerate((q_t, do_t, first_t, lse_t)):
+    tiles = [q_t, do_t, first_t, lse_t]
+    if wire is not None:
+        tiles += [fsc_t, dosc_t, qsc_t]
+    for j, tile in enumerate(tiles):
         dma_sem_wait(tile_sem.at[j], tile)
 
     # ---- local sweep over the resident chunk (no online softmax: p is
@@ -439,13 +504,21 @@ def _fused_bwd_kernel(
     # fully-masked rows carry lse = -inf; BIG_LSE makes p underflow to 0
     # on the fast path without an elementwise select (pallas_flash idiom)
     lse_col = jnp.where(lse_col == NEG_INF, BIG_LSE, lse_col * LOG2E)
-    q_raw = q_t[:]
-    do_raw = do_t[:]
+    if wire is None:
+        q_raw = q_t[:]
+        do_raw = do_t[:]
+        first_f = first_t[:]
+    else:
+        # in-tile column rescale BEFORE any accumulation: the quantized
+        # bundle dequantizes against its per-(batch, head) scale tiles
+        q_raw = q_t[:].astype(jnp.float32) * qsc_t[0, 0]
+        do_raw = do_t[:].astype(jnp.float32) * dosc_t[0, 0]
+        first_f = first_t[:].astype(jnp.float32) * fsc_t[0, 0]
     if opt_comm:
-        delta_col = _col_from_pack(first_t[:], bq, lp)
+        delta_col = _col_from_pack(first_f, bq, lp)
     else:
         delta_col = jnp.sum(
-            first_t[:].astype(jnp.float32) * do_raw.astype(jnp.float32),
+            first_f.astype(jnp.float32) * do_raw.astype(jnp.float32),
             axis=1, keepdims=True)
     q_sc = q_raw * (scale * LOG2E)
     dq_scr[:] = jnp.zeros_like(dq_scr)
@@ -506,7 +579,14 @@ def _fused_bwd_kernel(
     @pl.when(sched_ref[r, sched_ir.DQ_RECV] == 1)
     def _dq_merge():
         dma_sem_wait(dqio_sem.at[0], dq_arr)
-        dq_scr[:] = dq_arr[:] + dq_scr[:] * scale
+        if wire is None:
+            arr = dq_arr[:]
+        else:
+            # rescale the arriving quantized partial with the scale that
+            # rode its slot before folding into the fp32 accumulator
+            dma_sem_wait(dqio_sem.at[3], dqsc_arr)
+            arr = dq_arr[:].astype(jnp.float32) * dqsc_arr[0, 0]
+        dq_scr[:] = arr + dq_scr[:] * scale
 
     @pl.when(sched_ref[r, sched_ir.DQ_RECV] == 0)
     def _dq_seed():
@@ -517,13 +597,33 @@ def _fused_bwd_kernel(
         @pl.when(sched_ref[r, sched_ir.DQI_RECV] == 1)
         def _dqi_merge():
             dma_sem_wait(dqio_sem.at[2], dqi_arr)
-            dq_scr[:] = dq_scr[:] + dqi_arr[:]
+            if wire is None:
+                arr = dqi_arr[:]
+            else:
+                dma_sem_wait(dqio_sem.at[5], dqisc_arr)
+                arr = dqi_arr[:].astype(jnp.float32) * dqisc_arr[0, 0]
+            dq_scr[:] = dq_scr[:] + arr
 
     def _wb(b):
-        wb = pltpu.make_async_copy(dq_scr, dqbuf[b].at[dq_slot, b_, h, i],
-                                   dqio_sem.at[1])
-        wb.start()
-        wb.wait()
+        if wire is None:
+            wb = pltpu.make_async_copy(
+                dq_scr, dqbuf[b].at[dq_slot, b_, h, i], dqio_sem.at[1])
+            wb.start()
+            wb.wait()
+        else:
+            # re-quantize the folded fp32 partial with its REFRESHED
+            # per-block scale; payload and scale land in parallel slots
+            qt, sc = _wire_quant_tile(dq_scr[:], wire)
+            dq_q[:] = qt
+            dqsc_w[:] = sc[None, None]
+            wb1 = pltpu.make_async_copy(
+                dq_q, dqbuf[b].at[dq_slot, b_, h, i], dqio_sem.at[1])
+            wb2 = pltpu.make_async_copy(
+                dqsc_w, dqscbuf[b].at[dq_slot, b_, h, i], dqio_sem.at[4])
+            wb1.start()
+            wb2.start()
+            wb1.wait()
+            wb2.wait()
 
     dq_banked(_wb)
 
@@ -549,6 +649,15 @@ def _fused_bwd_kernel(
                 recv_sem=dqrecv[b].at[dst_slot],
                 device_id=sched_ref[R, _SENDC[b][4]],
                 device_id_type=LOGICAL).start()
+            if wire is not None:
+                # the block's refreshed scale rides the same slot credits
+                pltpu.make_async_remote_copy(
+                    src_ref=dqscbuf[b].at[dq_slot, b_, h, i],
+                    dst_ref=dqscbuf[b].at[dst_slot, b_, h, i],
+                    send_sem=dqsend[b].at[dst_slot],
+                    recv_sem=dqrecv[b].at[dst_slot],
+                    device_id=sched_ref[R, _SENDC[b][4]],
+                    device_id_type=LOGICAL).start()
 
     if hw_sync and prog.topology == "double" and 0 in \
             dq_statics["take_banks"]:
@@ -569,16 +678,22 @@ def _fused_bwd_kernel(
             # return-home hop: the completed partial lands in its OWNER's
             # dedicated home slot (index dq_slots[b], outside the ring
             # cycle) — one direct RDMA, `home_offsets[b]` positions away
-            home_idx = prog.dq_slots[b if prog.topology != "double" else 0]
+            src_b = b if prog.topology != "double" else 0
+            home_idx = prog.dq_slots[src_b]
+            home_dev = sched_ref[R, sched_ir.META_HOME0 if b == 0
+                                 else sched_ir.META_HOME1]
             pltpu.make_async_remote_copy(
-                src_ref=dqbuf[b if prog.topology != "double" else 0]
-                .at[dq_slot, b_, h, i],
-                dst_ref=dqbuf[b if prog.topology != "double" else 0]
-                .at[home_idx, b_, h, i],
+                src_ref=dqbuf[src_b].at[dq_slot, b_, h, i],
+                dst_ref=dqbuf[src_b].at[home_idx, b_, h, i],
                 send_sem=home_sems[b].at[0], recv_sem=home_sems[b].at[1],
-                device_id=sched_ref[R, sched_ir.META_HOME0 if b == 0
-                                    else sched_ir.META_HOME1],
-                device_id_type=LOGICAL).start()
+                device_id=home_dev, device_id_type=LOGICAL).start()
+            if wire is not None:
+                pltpu.make_async_remote_copy(
+                    src_ref=dqscbuf[src_b].at[dq_slot, b_, h, i],
+                    dst_ref=dqscbuf[src_b].at[home_idx, b_, h, i],
+                    send_sem=home_sems[b].at[0],
+                    recv_sem=home_sems[b].at[1],
+                    device_id=home_dev, device_id_type=LOGICAL).start()
 
     if has_dqi:
         @pl.when(dq_kind == sched_ir.DQ_BOUNDARY)
@@ -598,6 +713,14 @@ def _fused_bwd_kernel(
                 recv_sem=dqi_recv.at[dst_slot],
                 device_id=sched_ref[R, sched_ir.META_CH1_DST],
                 device_id_type=LOGICAL).start()
+            if wire is not None:
+                pltpu.make_async_remote_copy(
+                    src_ref=dqscbuf[0].at[dq_slot, b_, h, i],
+                    dst_ref=dqiscbuf.at[dst_slot, b_, h, i],
+                    send_sem=dqi_send.at[dst_slot],
+                    recv_sem=dqi_recv.at[dst_slot],
+                    device_id=sched_ref[R, sched_ir.META_CH1_DST],
+                    device_id_type=LOGICAL).start()
 
     # ---- dk/dv segment epilogue: stage the fp32 accumulators back to the
     # output buffers (final at the last round, with ds's deferred scale) ----
@@ -623,7 +746,7 @@ def _fused_bwd_kernel(
         @pl.when(last_of_round & (sched_ref[r, send_c] == 1))
         def _bundle_drain(ch=ch, dst_c=dst_c):
             dst_slot = sched_ref[r, dst_c]
-            for bufs in (firstbuf, dobuf, qbuf, lsebuf):
+            for bufs in bundle_bufs:
                 dma_sem_wait(psend[ch].at[dst_slot], bufs[ch].at[dst_slot])
 
     for b in dq_statics["ring_banks"]:
@@ -632,12 +755,16 @@ def _fused_bwd_kernel(
         def _dq_drain(b=b):
             ds_ = sched_ref[r, sched_ir.DQ_DST_SLOT]
             dma_sem_wait(dqsend[b].at[ds_], dqbuf[b].at[ds_])
+            if wire is not None:
+                dma_sem_wait(dqsend[b].at[ds_], dqscbuf[b].at[ds_])
 
     if has_dqi:
         @pl.when(last_of_round & (dq_kind == sched_ir.DQ_BOUNDARY))
         def _dqi_drain():
             ds_ = sched_ref[r, sched_ir.DQI_DST_SLOT]
             dma_sem_wait(dqi_send.at[ds_], dqibuf.at[ds_])
+            if wire is not None:
+                dma_sem_wait(dqi_send.at[ds_], dqiscbuf.at[ds_])
 
     for b in home_banks:
         send_round = dq_statics["home_rounds"][b]
@@ -649,20 +776,34 @@ def _fused_bwd_kernel(
             src_bank = b if prog.topology != "double" else 0
             home_idx = prog.dq_slots[src_bank]
             dma_sem_wait(home_sems[b].at[0], dqbuf[src_bank].at[home_idx])
+            if wire is not None:
+                dma_sem_wait(home_sems[b].at[0],
+                             dqscbuf[src_bank].at[home_idx])
 
     @pl.when(last_of_round & (r == R - 1))
     def _home_epilogue():
         # wait every home bank's arrivals, then land each home slot in its
         # own dq output (multiple partials are summed OUTSIDE the kernel —
         # one jnp add against one extra output, instead of a block loop in
-        # the final grid step)
+        # the final grid step; under wire the quantized partial and its
+        # scales come out as separate outputs and dequantize in XLA)
+        cps = []
         for j, b in enumerate(home_banks):
             src_bank = b if prog.topology != "double" else 0
             home_idx = prog.dq_slots[src_bank]
             dma_sem_wait(home_sems[b].at[1], dqbuf[src_bank].at[home_idx])
-            cp = pltpu.make_async_copy(dqbuf[src_bank].at[home_idx],
-                                       dq_refs[j], cp_sem.at[j])
+            cps.append(pltpu.make_async_copy(
+                dqbuf[src_bank].at[home_idx], dq_refs[j],
+                cp_sem.at[(2 if wire is not None else 1) * j]))
+            if wire is not None:
+                dma_sem_wait(home_sems[b].at[1],
+                             dqscbuf[src_bank].at[home_idx])
+                cps.append(pltpu.make_async_copy(
+                    dqscbuf[src_bank].at[home_idx], dqsc_refs[j],
+                    cp_sem.at[2 * j + 1]))
+        for cp in cps:
             cp.start()
+        for cp in cps:
             cp.wait()
 
     if hw_sync:
@@ -737,7 +878,9 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, seg=None, interpret=None,
                        bwd_slots=cfg.fused_bwd_slots,
                        ccw_slots=getattr(cfg, "fused_ccw_slots", None),
                        bwd_ccw_slots=getattr(cfg, "fused_bwd_ccw_slots",
-                                             None))
+                                             None),
+                       wire_dtype=getattr(cfg, "wire_dtype", None))
+    wire = rf.wire_dtype
     bq = _pick_block(s, rf.block_q_bwd)
     bkv = _pick_block(s, rf.block_kv_bwd)
     lp = _pick_block(bq, 128)
@@ -753,44 +896,68 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, seg=None, interpret=None,
     # bundle operands, pre-blocked so every slot/tile address is integer
     # indexing ([B, N, nqb, bq, D] is the same memory as [B, N, S, D]);
     # rank-3 stats ride in pallas_flash's packed [.., rows, lp] layout
-    q_in = q.reshape(b, n, nqb, bq, d)
-    do_in = do.reshape(b, n, nqb, bq, d)
+    # wire mode quantizes the three rotating payloads ONCE at entry (the
+    # bundle never changes as it circles, so quantize-at-entry is exactly
+    # quantize-on-send); lse stays fp32
+    if wire is not None:
+        q_q, qsc = wire_quantize(q, wire, (2, 3))      # scales (b, n, 1, 1)
+        do_q, dosc = wire_quantize(do, wire, (2, 3))
+        q_in = q_q.reshape(b, n, nqb, bq, d)
+        do_in = do_q.reshape(b, n, nqb, bq, d)
+    else:
+        q_in = q.reshape(b, n, nqb, bq, d)
+        do_in = do.reshape(b, n, nqb, bq, d)
     lse_in = _pack(lse.astype(jnp.float32), lp).reshape(b, n, nqb, rows, lp)
     if cfg.optimize_bwd_comm:
         delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
                         axis=-1)
+        if wire is not None:
+            delta, fsc = wire_quantize(delta, wire, (2,))  # (b, n, 1)
+            fsc = fsc[..., None]
         first_in = _pack(delta, lp).reshape(b, n, nqb, rows, lp)
         first_slot_shape = (b, n, nqb, rows, lp)
         first_tile_shape = (rows, lp)
-        first_dtype = jnp.float32
+        first_dtype = delta.dtype
     else:
         # ring payload grows by a factor of head_dim; delta is recomputed
         # per tile from the rotated (o, do) pair (reference parity)
-        first_in = o.reshape(b, n, nqb, bq, d)
+        first_in = o
+        if wire is not None:
+            first_in, fsc = wire_quantize(o, wire, (2, 3))
+        first_in = first_in.reshape(b, n, nqb, bq, d)
         first_slot_shape = (b, n, nqb, bq, d)
         first_tile_shape = (bq, d)
-        first_dtype = o.dtype
+        first_dtype = first_in.dtype
 
     kernel = functools.partial(
         _fused_bwd_kernel, prog=prog, statics=statics,
         dq_statics=dq_statics, scale=scale, bq=bq, bkv=bkv, lp=lp, nqb=nqb,
         nkb=nkb, group=group, n_b=b, n_h=n, hw_sync=not interpret,
         collect=collect_stats, opt_comm=cfg.optimize_bwd_comm,
-        wnd=cfg.window, has_seg=seg is not None,
+        wnd=cfg.window, has_seg=seg is not None, wire=wire,
     )
 
     home_banks = sorted(dq_statics["home_rounds"])
     dq_ring_banks = prog.n_dq_banks if topology != "double" else 1
     has_dqi = dq_statics["has_dqi"]
 
+    dq_out_dtype = jnp.float32 if wire is None else jnp.dtype(
+        jnp.int8 if wire == "int8" else jnp.float8_e4m3fn)
     out_specs = [pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
                  for _ in home_banks]                      # dq partial(s)
+    out_shape = [jax.ShapeDtypeStruct((b, n, nqb, bq, d), dq_out_dtype)
+                 for _ in home_banks]
+    if wire is not None:
+        # the arriving quantized partials' per-block scales, dequantized
+        # against their payload outputs by XLA just below
+        out_specs += [pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
+                      for _ in home_banks]
+        out_shape += [jax.ShapeDtypeStruct((b, n, nqb, 1, 1), jnp.float32)
+                      for _ in home_banks]
     out_specs += [
         pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # dk
         pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # dv
     ]
-    out_shape = [jax.ShapeDtypeStruct((b, n, nqb, bq, d), jnp.float32)
-                 for _ in home_banks]
     out_shape += [
         jax.ShapeDtypeStruct((b, n_kv, s, d), jnp.float32),
         jax.ShapeDtypeStruct((b, n_kv, s, d), jnp.float32),
@@ -801,43 +968,72 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, seg=None, interpret=None,
         out_shape.append(jax.ShapeDtypeStruct(
             (prog.n_banks, max(prog.slots)), jnp.int32))
 
+    dq_ring_dtype = jnp.float32 if wire is None else dq_out_dtype
     scratch = []
     for bank in range(prog.n_banks):
         sl = prog.slots[bank]
         scratch += [
             pltpu.ANY((sl,) + first_slot_shape, first_dtype),   # firstbuf
-            pltpu.ANY((sl, b, n, nqb, bq, d), do.dtype),        # dobuf
-            pltpu.ANY((sl, b, n, nqb, bq, d), q.dtype),         # qbuf
+            pltpu.ANY((sl, b, n, nqb, bq, d), do_in.dtype),     # dobuf
+            pltpu.ANY((sl, b, n, nqb, bq, d), q_in.dtype),      # qbuf
             pltpu.ANY((sl, b, n, nqb, rows, lp), jnp.float32),  # lsebuf
         ]
+    if wire is not None:
+        for bank in range(prog.n_banks):
+            sl = prog.slots[bank]
+            scratch += [
+                pltpu.ANY((sl, b, n, 1, 1), jnp.float32),   # fscbuf
+                pltpu.ANY((sl, b, n, 1, 1), jnp.float32),   # doscbuf
+                pltpu.ANY((sl, b, n, 1, 1), jnp.float32),   # qscbuf
+            ]
+    dq_bank_slots = []
     for bank in range(dq_ring_banks):
         # ring slots + (when this bank receives a home stream) the
         # dedicated return-home slot just past them
         extra = 1 if bank in home_banks or topology == "double" else 0
+        dq_bank_slots.append(prog.dq_slots[bank] + extra)
         scratch.append(pltpu.ANY(
-            (prog.dq_slots[bank] + extra, b, n, nqb, bq, d), jnp.float32))
+            (prog.dq_slots[bank] + extra, b, n, nqb, bq, d), dq_ring_dtype))
+    if wire is not None:
+        for sl in dq_bank_slots:
+            scratch.append(pltpu.ANY((sl, b, n, nqb, 1, 1),
+                                     jnp.float32))          # dqscbuf
     if has_dqi:
         scratch.append(pltpu.ANY((prog.dq_slots[1], b, n, nqb, bq, d),
-                                 jnp.float32))              # dqibuf
+                                 dq_ring_dtype))            # dqibuf
+        if wire is not None:
+            scratch.append(pltpu.ANY((prog.dq_slots[1], b, n, nqb, 1, 1),
+                                     jnp.float32))          # dqiscbuf
     scratch += [
         pltpu.VMEM((s, d), k.dtype),                  # kchunk
         pltpu.VMEM((s, d), v.dtype),                  # vchunk
         pltpu.VMEM((s, d), jnp.float32),              # dk_acc
         pltpu.VMEM((s, d), jnp.float32),              # dv_acc
-        pltpu.VMEM((bq, d), q.dtype),                 # q_t
-        pltpu.VMEM((bq, d), do.dtype),                # do_t
+        pltpu.VMEM((bq, d), q_in.dtype),              # q_t
+        pltpu.VMEM((bq, d), do_in.dtype),             # do_t
         pltpu.VMEM(first_tile_shape, first_dtype),    # first_t
         pltpu.VMEM((rows, lp), jnp.float32),          # lse_t
-        pltpu.VMEM((bq, d), jnp.float32),             # dq_arr
-        pltpu.VMEM((bq, d), jnp.float32),             # dqi_arr
+        pltpu.VMEM((bq, d), dq_ring_dtype),           # dq_arr
+        pltpu.VMEM((bq, d), dq_ring_dtype),           # dqi_arr
         pltpu.VMEM((bq, d), jnp.float32),             # dq_scr
-        pltpu.SemaphoreType.DMA((max(4 * len(prog.copy_in),
-                                     len(home_banks)),)),  # cp_sem
+        pltpu.SemaphoreType.DMA((max(
+            (7 if wire is not None else 4) * len(prog.copy_in),
+            (2 if wire is not None else 1) * len(home_banks)),)),  # cp_sem
         pltpu.SemaphoreType.DMA((2,)),                # chunk_sem
         pltpu.SemaphoreType.DMA((4,)),                # kvio_sem
-        pltpu.SemaphoreType.DMA((4,)),                # tile_sem
-        pltpu.SemaphoreType.DMA((3,)),                # dqio_sem
+        pltpu.SemaphoreType.DMA((7 if wire is not None else 4,)),  # tile_sem
+        pltpu.SemaphoreType.DMA((6 if wire is not None else 3,)),  # dqio_sem
     ]
+    if wire is not None:
+        scratch += [
+            pltpu.VMEM((1, 1), jnp.float32),          # fsc_t
+            pltpu.VMEM((1, 1), jnp.float32),          # dosc_t
+            pltpu.VMEM((1, 1), jnp.float32),          # qsc_t
+            pltpu.VMEM((1, 1), jnp.float32),          # dqsc_arr
+            pltpu.VMEM((1, 1), jnp.float32),          # dqisc_arr
+            pltpu.VMEM((bq, d), dq_ring_dtype),       # dq_q
+            pltpu.VMEM((1, 1), jnp.float32),          # dqsc_w
+        ]
     for bank in range(prog.n_banks):
         sl = prog.slots[bank]
         scratch += [
@@ -863,6 +1059,11 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, seg=None, interpret=None,
 
     in_specs = [pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)] * 6
     inputs = [sched, first_in, do_in, q_in, lse_in, k, v]
+    if wire is not None:
+        # per-(batch, head) bundle scales: popped by the kernel right
+        # after the six dense operands, ahead of any segment inputs
+        in_specs += [pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)] * 3
+        inputs += [fsc, dosc, qsc]
     if seg is not None:
         # local KV-side ids resident per batch; the gathered table (q-side
         # orientation: [B, world, S, 1]) stays in ANY space
@@ -899,12 +1100,23 @@ def fused_ring_bwd(cfg, q, k, v, o, lse, do, *, seg=None, interpret=None,
     )(*inputs)
     # a bidi owner receives its gradient as two complementary directional
     # partials; the sum is one fused XLA add — everything else already
-    # happened in-kernel
-    dq = outs[0]
-    for j in range(1, len(home_banks)):
-        dq = dq + outs[j]
+    # happened in-kernel.  Wire mode lands the partials quantized with
+    # their per-block scales in trailing outputs: dequantize (one rescale
+    # per home bank), THEN sum — the accumulators inside the kernel were
+    # fp32 throughout, only the return-home hop crossed the wire narrow.
+    nh = len(home_banks)
+    if wire is None:
+        dq = outs[0]
+        for j in range(1, nh):
+            dq = dq + outs[j]
+        n_out = nh
+    else:
+        dq = outs[0].astype(jnp.float32) * outs[nh]
+        for j in range(1, nh):
+            dq = dq + outs[j].astype(jnp.float32) * outs[nh + j]
+        n_out = 2 * nh
     dq = dq.reshape(b, n, s, d)
-    dk, dv = outs[len(home_banks)], outs[len(home_banks) + 1]
+    dk, dv = outs[n_out], outs[n_out + 1]
     if not collect_stats:
         return dq, dk, dv
-    return dq, dk, dv, outs[len(home_banks) + 2]
+    return dq, dk, dv, outs[n_out + 2]

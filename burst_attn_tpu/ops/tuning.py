@@ -71,6 +71,17 @@ class BlockTable(NamedTuple):
     # comm floors to retune).
     fused_ccw_slots: int = 2
     fused_bwd_ccw_slots: int = 2
+    # Wire precision of the ROTATING ring payloads (parallel/schedule.py
+    # WIRE_DTYPES): None ships the caller's dtypes; "int8"/"fp8" quantize
+    # the fwd K/V chunks, the bwd q-side bundle (lse exempt) and the dq
+    # partials to 1 byte/element with per-block fp32 scales riding the same
+    # HBM slots — ÷4 ring bytes vs the fp32 scan payloads.  Per-generation
+    # because the win is a function of the ICI:FLOPs ratio: every row stays
+    # None (bit-exact payloads) until an on-chip sweep
+    # (benchmarks/ring_overlap.py --wire-dtype) shows the comm floor is the
+    # bottleneck for that generation's links, at which point the measured
+    # row may opt in.  burst_attn(..., wire_dtype=...) overrides per call.
+    fused_wire_dtype: Optional[str] = None
 
 
 class ResolvedBlocks(NamedTuple):
@@ -231,12 +242,20 @@ class ResolvedFused(NamedTuple):
     bwd_slots: int
     ccw_slots: int
     bwd_ccw_slots: int
+    wire_dtype: Optional[str] = None
+
+    @property
+    def wire_itemsize(self) -> int:
+        """Bytes/element of the rotating payload banks (slot byte budgets
+        in supported()'s VMEM plans price quantized banks at 1 B/elem; the
+        per-block fp32 scales are O(1) per chunk and priced separately)."""
+        return 4 if self.wire_dtype is None else 1
 
 
 def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
                   device=None, block_q_bwd=None, block_kv_bwd=None,
                   bwd_slots=None, ccw_slots=None,
-                  bwd_ccw_slots=None) -> ResolvedFused:
+                  bwd_ccw_slots=None, wire_dtype=None) -> ResolvedFused:
     """Fill the fused ring kernels' knobs from the per-generation table.
 
     kv_slots / bwd_slots < 2 cannot double-buffer (the send target would
@@ -246,7 +265,10 @@ def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
     (resolved) fwd blocks, mirroring resolve_blocks: a caller who tunes
     the fwd blocks down for VMEM keeps that budget in the backward.
     ccw_slots / bwd_ccw_slots tune the SECOND slot bank (the ccw direction
-    of a bidi ring, or the double ring's inter prefetch bank) per pass."""
+    of a bidi ring, or the double ring's inter prefetch bank) per pass.
+    wire_dtype=None means "use the generation's fused_wire_dtype default"
+    (itself None on every row today — the wire stays bit-exact unless the
+    caller opts in per call)."""
     t = block_defaults(device)
     bq = t.fused_block_q if block_q is None else block_q
     bkv = t.fused_block_kv if block_kv is None else block_kv
@@ -267,8 +289,12 @@ def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
     if bcslots < 2:
         raise ValueError(
             f"fused ring bwd needs bwd_ccw_slots >= 2, got {bcslots}")
+    wire = t.fused_wire_dtype if wire_dtype is None else wire_dtype
+    if wire not in (None, "int8", "fp8"):
+        raise ValueError(
+            f"wire_dtype must be None, 'int8' or 'fp8', got {wire!r}")
     return ResolvedFused(bq, bkv, slots, t.fused_vmem_budget,
-                         bqb, bkvb, bslots, cslots, bcslots)
+                         bqb, bkvb, bslots, cslots, bcslots, wire)
 
 
 def resolve_blocks(block_q=None, block_kv=None, block_q_bwd=None,

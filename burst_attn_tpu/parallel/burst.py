@@ -53,7 +53,8 @@ from ..ops import tile as jnp_tile
 from ..ops.masks import (full_spec, live_round_prefix, round_spec, spec_live,
                          spec_pair_count)
 from .ring import (ppermute_by, ppermute_next, my_partition,
-                   partition_at_round, ring_round_counts)
+                   partition_at_round, ring_round_counts,
+                   wire_dequantize, wire_quantize)
 from ..utils.compat import axis_size, shard_map
 
 logger = logging.getLogger("burst_attn_tpu")
@@ -70,6 +71,10 @@ _M_ROUNDS = obs.counter(
     "burst.ring_rounds", "scheduled ring rounds (incl. the self round)")
 _M_HOPS = obs.counter(
     "burst.ring_hops", "scheduled KV ring hops, by mesh axis role")
+_M_WIRE = obs.counter(
+    "burst.wire_bytes",
+    "scheduled ring payload bytes per round by pass and stream "
+    "(parallel/schedule.wire_round_bytes; shrinks under cfg.wire_dtype)")
 
 
 @dataclass(frozen=True)
@@ -122,6 +127,18 @@ class BurstConfig:
     # interleaving defeats any per-round distance bound) and by non-causal
     # rings (wrap-around makes the live set a non-prefix band).
     max_segment_len: Optional[int] = None
+    # Wire precision of the ROTATING ring payloads (ROADMAP item 5): None
+    # ships the caller's dtypes bit-exactly; "int8"/"fp8" quantize the fwd
+    # K/V blocks, the bwd q-side bundle (delta|o, do, q — lse stays fp32)
+    # and the fp32 dq partials to 1 B/elem, with per-block fp32 SCALAR
+    # scales riding the same payload (scan ring: extra pytree leaves in the
+    # rotating tuple; fused kernels: parallel scale slot banks on the same
+    # semaphores/credits, ops/fused_ring*.py).  fp32 ACCUMULATION is never
+    # touched — every quantized tensor is rescaled before any dot/add, like
+    # ops/ragged_paged.py's int8 pool path — so the cost is a pinned
+    # quantization tolerance, not a different algorithm.  Resident tensors
+    # and the purely-local math never see the wire dtype.
+    wire_dtype: Optional[str] = None
     # Fused ring kernel knobs (backend="fused_ring" only): KV communication
     # slot count (>= 2) and the fused grid's q-row / kv-sweep blocks; None =
     # the per-TPU-generation table (ops/tuning.py resolve_fused).  The
@@ -180,6 +197,10 @@ class BurstConfig:
         if self.max_segment_len is not None and self.max_segment_len < 1:
             raise ValueError(
                 f"max_segment_len must be >= 1, got {self.max_segment_len}")
+        if self.wire_dtype not in (None, "int8", "fp8"):
+            raise ValueError(
+                f"wire_dtype must be None, 'int8' or 'fp8', got "
+                f"{self.wire_dtype!r}")
         if self.fused_topology not in ("auto", "uni", "bidi", "double"):
             raise ValueError(
                 f"fused_topology must be auto|uni|bidi|double, got "
@@ -318,10 +339,22 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None, collect=False):
     scale = cfg.scale if cfg.scale is not None else d**-0.5
     n_inter, n_intra = _sizes(cfg)
     part_me = my_partition(cfg.intra_axis, cfg.inter_axis)
+    wire = cfg.wire_dtype
 
     def compute(st, kv_c, r):
         kv_part = partition_at_round(r, cfg.intra_axis, cfg.inter_axis)
-        if seg is not None:
+        if wire is not None:
+            # rescale-on-consume: dequantize the rotating payload to the
+            # compute dtype BEFORE any tile math — the fp32 accumulation
+            # below never sees the wire dtype
+            if seg is not None:
+                k8, ksc, v8, vsc, kvseg_c = kv_c
+            else:
+                k8, ksc, v8, vsc = kv_c
+                kvseg_c = None
+            k_c = wire_dequantize(k8, ksc, k.dtype)
+            v_c = wire_dequantize(v8, vsc, v.dtype)
+        elif seg is not None:
             k_c, v_c, kvseg_c = kv_c
         else:
             k_c, v_c = kv_c
@@ -419,7 +452,18 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None, collect=False):
     # live set is not a prefix.)
     r_live = _r_live(cfg, s, k.shape[2], n_inter, n_intra)
 
-    kv = (k, v) if seg is None else (k, v, seg)
+    if wire is None:
+        kv = (k, v) if seg is None else (k, v, seg)
+    else:
+        # Quantize ONCE at ring entry with per-(batch, kv-head) scalar
+        # scales (amax over the local (s, d) chunk): the KV payload rotates
+        # unchanged, so quantize-at-entry is exactly quantize-on-send on
+        # every hop.  The peeled self round below still reads the resident
+        # full-precision k/v — only bytes that actually cross a link are
+        # quantized.  The int32 seg ids ride unquantized.
+        k8, ksc = wire_quantize(k, wire, (2, 3))
+        v8, vsc = wire_quantize(v, wire, (2, 3))
+        kv = (k8, ksc, v8, vsc) if seg is None else (k8, ksc, v8, vsc, seg)
     kv_base = kv
 
     # Round 0 is ALWAYS the self round (partition_at_round(0) == part_me:
@@ -492,11 +536,18 @@ def _fwd_impl(q, k, v, cfg: BurstConfig, seg=None, collect=False):
     m, lse, acc = state
     o = jnp_tile.finalize(m, lse, acc, q.dtype)
     if collect:
+        qam = jnp.float32(0.0)
+        if wire is not None:
+            # finite-range gauge: the largest |value| the wire quantizer
+            # mapped to its top code this dispatch (saturating blocks show
+            # up as a growing gauge, not silent clipping)
+            qam = jnp.maximum(jnp.max(jnp.abs(k.astype(jnp.float32))),
+                              jnp.max(jnp.abs(v.astype(jnp.float32))))
         stats = devstats.ring_stats(
             rounds=rounds_exec, rounds_live=dv[0], attn_pairs=dv[1],
             total_pairs=float(rounds_exec) * s * k.shape[2], head_dim=d,
             rounds_elided=n_inter * n_intra - rounds_exec,
-            m=m, lse=lse, acc=acc)
+            m=m, lse=lse, acc=acc, quant_absmax=qam)
         return o, lse, stats
     return o, lse
 
@@ -546,6 +597,30 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
     if seg is not None:
         payload = payload + (seg,)
 
+    wire = cfg.wire_dtype
+    if wire is not None:
+        # quantize the q-side bundle once at ring entry (it rotates
+        # unchanged): per-(batch, head) scalar scales; lse stays fp32 (its
+        # absolute accuracy sets every softmax rescale downstream)
+        first_p, do_p, q_p, lse_p = payload[:4]
+        f8, fsc = wire_quantize(first_p, wire,
+                                (2,) if cfg.optimize_bwd_comm else (2, 3))
+        do8, dosc = wire_quantize(do_p, wire, (2, 3))
+        q8, qsc = wire_quantize(q_p, wire, (2, 3))
+        payload = (f8, fsc, do8, dosc, q8, qsc, lse_p) + payload[4:]
+
+    if wire is None:
+        dq_hop = ppermute_next
+    else:
+        def dq_hop(g, axis):
+            # the dq add-and-forward ring: quantize-before-send with a
+            # REFRESHED per-(batch, head) scale (the partial grew by one
+            # local contribution since the last hop), dequantize-after-
+            # receive back to fp32 — the fold itself stays full precision
+            g8, gsc = wire_quantize(g, wire, (2, 3))
+            g8, gsc = ppermute_next((g8, gsc), axis)
+            return wire_dequantize(g8, gsc, jnp.float32)
+
     dk = jnp.zeros(k.shape, jnp.float32)
     dv = jnp.zeros(v.shape, jnp.float32)
     dq_intra = jnp.zeros(q.shape, jnp.float32)
@@ -555,7 +630,14 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
         q_part = partition_at_round(r, cfg.intra_axis, cfg.inter_axis)
         # roles flip vs forward: the rotating payload is the query side,
         # local k/v are resident.
-        if seg is not None:
+        if wire is not None:
+            f8, fsc, do8, dosc, q8, qsc, lse_r = pay[:7]
+            qseg_r = pay[7] if seg is not None else None
+            first = wire_dequantize(
+                f8, fsc, jnp.float32 if cfg.optimize_bwd_comm else o.dtype)
+            do_r = wire_dequantize(do8, dosc, do.dtype)
+            q_r = wire_dequantize(q8, qsc, q.dtype)
+        elif seg is not None:
             first, do_r, q_r, lse_r, qseg_r = pay
         else:
             first, do_r, q_r, lse_r = pay
@@ -647,7 +729,7 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
             # cycle boundary: fold the intra accumulator into the inter-ring
             # running sum (add-and-forward, reference comm.py:187-218) and
             # restart the intra accumulator at zero.
-            dq_inter = ppermute_next(dq_inter + dq_intra, cfg.inter_axis)
+            dq_inter = dq_hop(dq_inter + dq_intra, cfg.inter_axis)
             dq_intra = jnp.zeros_like(dq_intra)
         # ---- first round of the cycle (r = c*I): no dq rotation ----
         dqc, dkc, dvc = compute(payload, jnp.int32(c * n_intra))
@@ -670,7 +752,7 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
                     pay_next = ppermute_next(pay, cfg.intra_axis)
                     # dq leaves with the payload it accumulated for; the
                     # arriving dq belongs to the payload we hold this round.
-                    dq_rot = ppermute_next(dq_i, cfg.intra_axis)
+                    dq_rot = dq_hop(dq_i, cfg.intra_axis)
                     dqc, dkc, dvc = compute(pay, c * n_intra + s_idx)
                     return (pay_next, dq_rot + dqc, dk_c + dkc, dv_c + dvc), None
 
@@ -679,7 +761,7 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
                     jnp.arange(start, n_intra - 1)
                 )
             # ---- last round of the cycle: rotate dq but not the payload ----
-            dq_rot = ppermute_next(dq_intra, cfg.intra_axis)
+            dq_rot = dq_hop(dq_intra, cfg.intra_axis)
             dqc, dkc, dvc = compute(payload, jnp.int32(c * n_intra + n_intra - 1))
             dq_intra = dq_rot + dqc
             dk = dk + dkc
@@ -692,9 +774,9 @@ def _bwd_impl(cfg: BurstConfig, q, k, v, o, lse, do, seg=None):
     # held-out round-0 dq (truncated rings only — it never traveled).
     dq = dq_inter + dq_intra
     if cfg.inter_axis is not None:
-        dq = ppermute_next(dq, cfg.inter_axis)
+        dq = dq_hop(dq, cfg.inter_axis)
     if r_live > 1:
-        dq = ppermute_next(dq, cfg.intra_axis)
+        dq = dq_hop(dq, cfg.intra_axis)
     if dq_home is not None:
         dq = dq + dq_home
     return dq, dk, dv
@@ -927,6 +1009,20 @@ def _note_dispatch(cfg: BurstConfig, mesh, q_shape, k_shape, has_seg: bool,
         _M_HOPS.inc(intra_hops, axis="intra")
     if inter_hops:
         _M_HOPS.inc(inter_hops, axis="inter")
+    # per-round wire bytes from the ONE shared derivation
+    # (schedule.wire_round_bytes) — the same numbers ring_overlap records
+    # and tests/test_wire_quant.py replays against the compiled program
+    from . import schedule as sched_ir
+
+    b_l, n_l, s_l, d_l = q_local
+    fwd_b = sched_ir.wire_round_bytes("fwd", cfg.wire_dtype, b=b_l, n=n_l,
+                                      n_kv=k_local[1], s=s_l, d=d_l)
+    bwd_b = sched_ir.wire_round_bytes("bwd", cfg.wire_dtype, b=b_l, n=n_l,
+                                      n_kv=k_local[1], s=s_l, d=d_l,
+                                      opt_comm=cfg.optimize_bwd_comm)
+    _M_WIRE.inc(fwd_b["kv"], **{"pass": "fwd", "dir": "kv"})
+    _M_WIRE.inc(bwd_b["bundle"], **{"pass": "bwd", "dir": "bundle"})
+    _M_WIRE.inc(bwd_b["dq"], **{"pass": "bwd", "dir": "dq"})
 
 
 def _resolve_backend(backend: str) -> str:
@@ -974,6 +1070,7 @@ def burst_attn(
     fused_seq_factor: Optional[Tuple[int, int]] = None,
     fused_ccw_slots: Optional[int] = None,
     fused_bwd_ccw_slots: Optional[int] = None,
+    wire_dtype: Optional[str] = None,
     collect_stats: bool = False,
 ) -> jax.Array:
     """Burst attention on global arrays [B, N, S, D]; S must already be in
@@ -991,6 +1088,12 @@ def burst_attn(
     many tokens (a contract, not a runtime check — see
     BurstConfig.max_segment_len); contig-causal single rings use it to
     statically elide ring rounds no segment can reach.
+    wire_dtype: "int8" | "fp8" | None — quantize the ROTATING ring payloads
+    (fwd K/V, bwd bundle, dq partials; lse exempt) with per-block fp32
+    scales riding the same transport; None = the per-generation table
+    default (ops/tuning.py fused_wire_dtype, itself None = bit-exact wire).
+    fp32 accumulation is untouched; see docs/fused_ring.md for the pinned
+    tolerances.
     collect_stats: return `(o, obs.devstats.DevStats)` instead of `o` —
     in-graph ring telemetry with a leading per-device axis of length
     `world` (batch/head replica groups are pre-reduced in-graph).  Fold it
@@ -1005,11 +1108,15 @@ def burst_attn(
         inter_axis, intra_axis = seq_axes
     else:
         raise ValueError(f"seq_axes must have 1 or 2 names, got {seq_axes}")
-    from ..ops.tuning import resolve_blocks
+    from ..ops.tuning import block_defaults, resolve_blocks
 
     # window validation lives in BurstConfig.__post_init__ (constructed below)
     block_q, block_kv, block_q_bwd, block_kv_bwd, _ = resolve_blocks(
         block_q, block_kv, block_q_bwd, block_kv_bwd)
+    if wire_dtype is None:
+        # per-generation wire default (every table row is None today — the
+        # wire stays bit-exact unless the caller opts in per call)
+        wire_dtype = block_defaults().fused_wire_dtype
     cfg = BurstConfig(
         causal=causal,
         layout=layout,
@@ -1035,6 +1142,7 @@ def burst_attn(
         fused_seq_factor=fused_seq_factor,
         fused_ccw_slots=fused_ccw_slots,
         fused_bwd_ccw_slots=fused_bwd_ccw_slots,
+        wire_dtype=wire_dtype,
         # the host knows the mesh's full axis order: the fused kernels
         # compute multi-axis LOGICAL RDMA ids from it (ring.device_roles)
         mesh_axes=tuple((str(a), int(sz)) for a, sz in mesh.shape.items()),

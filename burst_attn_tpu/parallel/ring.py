@@ -45,6 +45,46 @@ def ppermute_by(x, axis_name: str, hops: int):
         return jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), x)
 
 
+# Symmetric wire quantization of rotating ring payloads (ROADMAP item 5).
+# One representable-range constant per wire dtype: int8 maps amax -> +-127;
+# fp8 (e4m3fn, no inf) maps amax -> +-448, its finite max.  Scales are
+# per-BLOCK SCALARS (amax over the reduced axes, keepdims) so they ride the
+# ring as O(1) fp32 sub-payloads next to the 1 B/elem tensors — the scan
+# ring rotates them in the same pytree, the fused kernels in parallel scale
+# slot banks on the same semaphores (ops/fused_ring.py).  Accumulation is
+# NEVER quantized: dequantize() is applied before any dot/add fold, exactly
+# like ops/ragged_paged.py's int8 pool rescale.
+WIRE_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def wire_quantize(x, wire, axes):
+    """(payload, scale) for one ring hop.  `axes` are the amax-reduction
+    axes (everything inside one scale block); scale keeps dims so
+    dequantization is a broadcast multiply.  wire=None passes `x` through
+    with scale=None — callers on the dense path never see a new op."""
+    if wire is None:
+        return x, None
+    if wire not in WIRE_QMAX:
+        raise ValueError(f"wire must be None, 'int8' or 'fp8', got {wire!r}")
+    f = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / WIRE_QMAX[wire]
+    if wire == "int8":
+        q = jnp.clip(jnp.round(f / scale), -127.0, 127.0).astype(jnp.int8)
+    else:
+        q = (f / scale).astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+def wire_dequantize(q, scale, dtype):
+    """Inverse of wire_quantize: rescale in fp32, then cast to the compute
+    dtype the dense ring would have shipped.  scale=None is the dense
+    pass-through."""
+    if scale is None:
+        return q
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 def ring_round_counts(n_inter: int, n_intra: int, r_live=None):
     """Host-side accounting of ONE forward ring schedule: (rounds,
     intra_hops, inter_hops).  The obs dispatch instrumentation

@@ -62,12 +62,22 @@ Everything here is host-side python/numpy: programs are compiled once per
 from the kernels' point of view.
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 TOPOLOGIES = ("uni", "bidi", "double")
+
+# wire precision of the rotating payloads (ROADMAP item 5): None ships the
+# caller's dtypes; "int8"/"fp8" quantize every rotating operand except lse
+# (which stays fp32 — it is already tiny and exponent-critical) to 1-byte
+# symmetric per-block values with an fp32 scale riding the SAME slot: the
+# scale sub-buffer is a parallel bank indexed by the identical slot ids,
+# its transfers signal the identical send/recv semaphores, and its reuse is
+# licensed by the identical per-slot credits — no new columns exist in the
+# op table, which is exactly what oracle.verify_ring_program checks.
+WIRE_DTYPES = (None, "int8", "fp8")
 
 # ---------------------------------------------------------------------------
 # table column layout (shared by both fused kernels; bwd extends fwd).
@@ -143,6 +153,11 @@ class RingProgram:
     home_offsets: Tuple[Tuple[int, int], ...] = ()  # per dq bank:
     #   (inter_off, intra_off) — the final home hop targets the device
     #   `offset` positions forward of the sender
+    # wire precision of the rotating payloads (see WIRE_DTYPES): purely a
+    # payload-encoding attribute — the op table is IDENTICAL to the dense
+    # compile of the same topology (asserted by burstlint), only the slot
+    # dtypes, the scale sub-banks and the remote-DMA census change
+    wire: Optional[str] = None
 
     @property
     def world(self) -> int:
@@ -181,7 +196,7 @@ class RingProgram:
             "slots": self.slots, "channels": self.channels,
             "copy_in": self.copy_in, "rot_inter": self.rot_inter,
             "rot_intra": self.rot_intra, "dq_slots": self.dq_slots,
-            "home_offsets": self.home_offsets,
+            "home_offsets": self.home_offsets, "wire": self.wire,
             "rows": {k: tuple(v) for k, v in self.rows.items()},
         }
 
@@ -334,7 +349,8 @@ def _bidi_order(world: int) -> List[Tuple[str, int]]:
 
 def compile_fwd(topology: str, n_intra: int, n_inter: int = 1, *,
                 slots: int = 2, slots1: Optional[int] = None,
-                r_live: Optional[int] = None) -> RingProgram:
+                r_live: Optional[int] = None,
+                wire: Optional[str] = None) -> RingProgram:
     """Compile a forward (KV-rotation) ring schedule.
 
     n_intra/n_inter: ring factorization (uni/bidi use n_inter == 1; double
@@ -356,9 +372,17 @@ def compile_fwd(topology: str, n_intra: int, n_inter: int = 1, *,
     offset IS the round index, so prefix truncation applies directly, and
     the inter prefetch for a cycle that would start at or past r_live is
     elided with it.
+
+    wire: wire precision of the rotating payloads (WIRE_DTYPES) — attached
+    to the program so `expected_remote_dma` and `wire_round_bytes` account
+    the scale sub-payloads; the op table itself is identical to the dense-
+    precision compile (scales ride the same slots, sems and credits).
     """
     if topology not in TOPOLOGIES:
         raise ScheduleError(f"unknown topology {topology!r}")
+    if wire not in WIRE_DTYPES:
+        raise ScheduleError(f"unknown wire dtype {wire!r} "
+                            f"(must be one of {WIRE_DTYPES})")
     if slots < 2:
         raise ScheduleError(f"need slots >= 2, got {slots}")
     world = n_inter * n_intra
@@ -378,17 +402,20 @@ def compile_fwd(topology: str, n_intra: int, n_inter: int = 1, *,
             r_live = None  # no dead tail: compile the dense program
 
     if topology == "uni":
-        return _compile_fwd_uni(world, slots, r_live)
-    if topology == "bidi":
+        prog = _compile_fwd_uni(world, slots, r_live)
+    elif topology == "bidi":
         if r_live is not None:
             # a truncated bidi degrades to the cw-only prefix program: the
             # live offsets {0..r_live-1} all fit one direction, and the
             # bidi interleave's own tail is not a round prefix
-            return _compile_fwd_uni(world, slots, r_live)
-        return _compile_fwd_bidi(world, slots,
-                                 slots if slots1 is None else slots1)
-    return _compile_fwd_double(n_inter, n_intra, slots,
-                               2 if slots1 is None else slots1, r_live)
+            prog = _compile_fwd_uni(world, slots, r_live)
+        else:
+            prog = _compile_fwd_bidi(world, slots,
+                                     slots if slots1 is None else slots1)
+    else:
+        prog = _compile_fwd_double(n_inter, n_intra, slots,
+                                   2 if slots1 is None else slots1, r_live)
+    return prog if wire is None else replace(prog, wire=wire)
 
 
 def _compile_fwd_uni(world: int, slots: int, r_live=None) -> RingProgram:
@@ -555,7 +582,8 @@ def _compile_fwd_double(n_inter: int, n_intra: int, slots: int,
 def compile_bwd(topology: str, n_intra: int, n_inter: int = 1, *,
                 slots: int = 2, slots1: Optional[int] = None,
                 dq_slots: Optional[int] = None,
-                r_live: Optional[int] = None) -> RingProgram:
+                r_live: Optional[int] = None,
+                wire: Optional[str] = None) -> RingProgram:
     """Compile a backward schedule: the bundle rotates exactly like the
     forward KV (same banks/channels/credits), and a dq plan rides along —
     one accumulating ring per direction, each one hop behind its bundle,
@@ -577,6 +605,9 @@ def compile_bwd(topology: str, n_intra: int, n_inter: int = 1, *,
     case to the scan ring).
     """
     world = n_inter * n_intra
+    if wire not in WIRE_DTYPES:
+        raise ScheduleError(f"unknown wire dtype {wire!r} "
+                            f"(must be one of {WIRE_DTYPES})")
     if r_live is not None:
         if not (1 <= r_live <= world):
             raise ScheduleError(
@@ -586,9 +617,10 @@ def compile_bwd(topology: str, n_intra: int, n_inter: int = 1, *,
                 raise ScheduleError(
                     "bwd r_live truncation needs r_live >= 2 (a self-only "
                     "ring has no dq return hop)")
-            return _compile_bwd_truncated(world, r_live, slots,
+            prog = _compile_bwd_truncated(world, r_live, slots,
                                           slots if dq_slots is None
                                           else dq_slots)
+            return prog if wire is None else replace(prog, wire=wire)
         r_live = None  # dense (r_live == world, or double: see docstring)
     fwd = compile_fwd(topology, n_intra, n_inter, slots=slots, slots1=slots1)
     n_rounds = fwd.n_rounds
@@ -676,7 +708,7 @@ def compile_bwd(topology: str, n_intra: int, n_inter: int = 1, *,
         n_intra=fwd.n_intra, slots=fwd.slots, channels=fwd.channels,
         copy_in=fwd.copy_in, rows={k: tuple(v) for k, v in rows.items()},
         rot_inter=fwd.rot_inter, rot_intra=fwd.rot_intra,
-        dq_slots=dq_slots_t, home_offsets=homes)
+        dq_slots=dq_slots_t, home_offsets=homes, wire=wire)
 
 
 def _compile_bwd_truncated(world: int, r_live: int, slots: int,
@@ -780,6 +812,17 @@ def hop_totals(program: RingProgram):
     return totals
 
 
+def quantized_operands(program: RingProgram) -> int:
+    """Payload-bundle operands that carry a quantized wire encoding (and
+    therefore an extra scale transfer per send site) under this program's
+    wire dtype: fwd rotates k+v (both quantized); the bwd bundle rotates
+    (delta|o, do, q, lse) of which lse is exempt — it stays fp32.  Zero
+    when the program ships dense payloads."""
+    if program.wire is None:
+        return 0
+    return 2 if program.kind == "fwd" else 3
+
+
 def expected_remote_dma(program: RingProgram, operands_ch: int = 2) -> int:
     """Remote dma_start CALL SITES the fused kernel lowered from this
     program must contain — the fused-ring-fused census (burstlint).
@@ -787,30 +830,89 @@ def expected_remote_dma(program: RingProgram, operands_ch: int = 2) -> int:
     operands_ch: arrays per payload send (fwd: k+v = 2; bwd bundle: 4).
     Channel 0 contributes one site per (operand, src bank) it ever sources
     from; channel 1 one per operand; each dq bank one ring site (if it has
-    ring sends) and one home/boundary/final site."""
+    ring sends) and one home/boundary/final site.
+
+    A quantized program (program.wire) adds the scale sub-payload sites:
+    one extra transfer per QUANTIZED operand per payload send site
+    (quantized_operands — lse never quantizes), and every dq site doubles
+    (the streamed dq partial is int8|fp8 + its refreshed per-block scale).
+    The scale transfers ride the same semaphores and credits, so they add
+    call sites but no schedule rows."""
+    per_send = operands_ch + quantized_operands(program)
     n = 0
     src_banks0 = {program.rows["src_bank0"][r]
                   for r in range(program.n_rounds)
                   if program.rows["send0"][r]}
-    n += operands_ch * len(src_banks0)
+    n += per_send * len(src_banks0)
     if any(program.rows["send1"][r] for r in range(program.n_rounds)):
-        n += operands_ch
+        n += per_send
     if program.kind == "bwd":
+        dq_mult = 2 if program.wire is not None else 1
         kinds = {program.rows["dq_send"][r] for r in range(program.n_rounds)}
         for bank in range(program.n_dq_banks):
             ring = any(program.rows["dq_send"][r] == DQ_RING
                        and program.rows["dq_bank"][r] == bank
                        for r in range(program.n_rounds))
-            n += int(ring)
+            n += int(ring) * dq_mult
         n += int(DQ_HOME in kinds and 0 in
                  {program.rows["dq_bank"][r] for r in range(program.n_rounds)
-                  if program.rows["dq_send"][r] == DQ_HOME})
+                  if program.rows["dq_send"][r] == DQ_HOME}) * dq_mult
         n += int(any(program.rows["dq_send"][r] == DQ_HOME
                      and program.rows["dq_bank"][r] == 1
-                     for r in range(program.n_rounds)))
-        n += int(DQ_BOUNDARY in kinds)
-        n += int(DQ_FINAL in kinds)
+                     for r in range(program.n_rounds))) * dq_mult
+        n += int(DQ_BOUNDARY in kinds) * dq_mult
+        n += int(DQ_FINAL in kinds) * dq_mult
     return n
+
+
+# ---------------------------------------------------------------------------
+# wire byte accounting — the ONE derivation of per-round ring bytes.  The
+# obs dispatch counters (parallel/burst._note_dispatch), the comm-floor
+# benchmark (benchmarks/ring_overlap.py) and the schedule-replay test
+# (tests/test_wire_quant.py) all call this helper, so they cannot drift
+# from each other by construction.
+
+
+def wire_itemsize(wire: Optional[str], dense_itemsize: int = 4) -> int:
+    """Bytes per element a rotating operand ships under a wire dtype."""
+    if wire is None:
+        return dense_itemsize
+    if wire not in WIRE_DTYPES:
+        raise ScheduleError(f"unknown wire dtype {wire!r}")
+    return 1
+
+
+def wire_round_bytes(pass_: str, wire: Optional[str], *, b: int, n: int,
+                     n_kv: int, s: int, d: int, opt_comm: bool = True,
+                     itemsize: int = 4) -> Dict[str, int]:
+    """Per-ROUND per-DEVICE payload bytes each rotating stream ships over
+    one ring hop, by stream name:
+
+      fwd  {"kv": ...}                    the k+v chunk (+ scales)
+      bwd  {"bundle": ..., "dq": ...}     the q-side bundle (+ scales) and
+                                          the streamed dq partial
+
+    `itemsize` is the dense per-element width of the caller's tensors
+    (4 for the fp32 comm-floor rows — the acceptance baseline).  Quantized
+    streams ship 1 byte/element plus one fp32 scale per quantized block at
+    the scan ring's granularity (fwd: per (batch, kv head); bundle: per
+    (batch, head) per operand; dq: per (batch, head)); lse always ships
+    b*n*s fp32.  Shapes are PER-SHARD."""
+    wi = wire_itemsize(wire, itemsize)
+    scale_b = 0 if wire is None else 4
+    if pass_ == "fwd":
+        kv = 2 * b * n_kv * s * d * wi + 2 * b * n_kv * scale_b
+        return {"kv": kv}
+    if pass_ != "bwd":
+        raise ValueError(f"pass_ must be 'fwd' or 'bwd', got {pass_!r}")
+    # bundle: (delta | o), do, q quantize; lse stays fp32
+    first = b * n * s * (4 if wire is None else 1) if opt_comm \
+        else b * n * s * d * wi
+    bundle = (first + 2 * b * n * s * d * wi      # do + q
+              + b * n * s * 4                      # lse (fp32, exempt)
+              + 3 * b * n * scale_b)               # delta|o, do, q scales
+    dq = b * n * s * d * (4 if wire is None else 1) + b * n * scale_b
+    return {"bundle": bundle, "dq": dq}
 
 
 def partition_for_round(program: RingProgram, r: int, inter_rank, intra_rank):

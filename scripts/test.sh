@@ -8,6 +8,7 @@ cd "$(dirname "$0")/.."
 args=("$@")
 filtered=()
 fast=0; tpu=0; fused=0; obs=0; schedule=0; serve=0; loadgen=0; fleet=0
+quant=0
 for a in "${args[@]}"; do
   case "$a" in
     --fast) fast=1 ;;
@@ -18,6 +19,7 @@ for a in "${args[@]}"; do
     --serve) serve=1 ;;
     --loadgen) loadgen=1 ;;
     --fleet) fleet=1 ;;
+    --quant) quant=1 ;;
     *) filtered+=("$a") ;;
   esac
 done
@@ -137,6 +139,17 @@ elif [[ $schedule == 1 ]]; then
   python -m pytest tests/test_fused_ring.py tests/test_fused_ring_bwd.py \
     tests/test_devstats.py -q \
     -k "window or segment or elided or elision or supported" \
+    ${filtered[@]+"${filtered[@]}"}
+elif [[ $quant == 1 ]]; then
+  # focused lane for the wire-precision layer (cfg.wire_dtype): fwd/grad
+  # parity matrices vs the fp32 ring (slow-marked sweeps included here on
+  # purpose), wire_dtype=None bit-identity, byte-accounting replay against
+  # schedule.wire_round_bytes, and the scale-proof burstlint mutations
+  # (dropped rescale, escaped unscaled output, raw quantized dot, fp16
+  # accum behind quant, credit-neutral recompile) — the quick iteration
+  # loop while working on the quantizers + scale slot banks
+  python -m pytest tests/test_wire_quant.py -q ${filtered[@]+"${filtered[@]}"}
+  python -m pytest tests/test_analysis.py -q -k "wire" \
     ${filtered[@]+"${filtered[@]}"}
 elif [[ $fused == 1 ]]; then
   # focused lane for the fused RDMA-ring kernels' interpret-mode parity

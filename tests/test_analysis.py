@@ -820,6 +820,140 @@ def test_elision_mutation_fires_fused_ring_schedule():
 
 
 # ---------------------------------------------------------------------------
+# wire-precision scale-handling proof (ISSUE 14): the fused-ring-fused
+# family now proves every quantized send has a matching in-tile rescale
+# before accumulation.  The mutations — a dropped rescale, a raw int8
+# MXU operand, a f16 accumulator smuggled behind the dequant, a bogus
+# wire dtype in the schedule IR — must each fire, or the proof has no
+# teeth.  The clean direction rides the real wire traces via
+# test_clean_run_on_real_package (verify_fused_topologies' wire-* rows).
+
+
+S4 = jax.ShapeDtypeStruct((64, 16), jnp.float32)
+S8 = jax.ShapeDtypeStruct((64, 16), jnp.int8)
+SC = jax.ShapeDtypeStruct((), jnp.float32)
+
+
+@pytest.mark.fused_ring
+def test_wire_dropped_rescale_fires():
+    """Dequantizing a wire payload and accumulating WITHOUT the per-block
+    scale multiply is exactly the silent-corruption defect the proof
+    exists to catch."""
+
+    def bad(q, k8):
+        k = k8.astype(jnp.float32)          # dequant, scale dropped
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return jnp.sum(s)                   # reduction eats the raw value
+
+    jx = jax.make_jaxpr(bad)(S4, S8)
+    findings = numerics.check_wire_trace(jx, where="seeded", anchor=ANCHOR)
+    assert findings, "dropped rescale did not fire"
+    assert _rules_of(findings) == {"fused-ring-fused"}
+    assert any("rescale" in f.message for f in findings)
+    assert findings[0].file == "seeded.py" and findings[0].line == 7
+
+
+@pytest.mark.fused_ring
+def test_wire_escaped_unscaled_output_fires():
+    """An unscaled dequantized value flowing straight to the trace output
+    (through taint-transparent reshapes) is also a dropped rescale."""
+    jx = jax.make_jaxpr(
+        lambda k8: k8.astype(jnp.float32).reshape(16, 64))(S8)
+    findings = numerics.check_wire_trace(jx, where="seeded", anchor=ANCHOR)
+    assert any("never met its scale" in f.message for f in findings), [
+        f.format() for f in findings]
+
+
+@pytest.mark.fused_ring
+def test_wire_raw_quant_dot_fires():
+    """A raw int8 operand into dot_general bypasses the cast-up-then-
+    rescale contract entirely."""
+
+    def bad(a8, b8):
+        return jax.lax.dot_general(a8, b8, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+
+    jx = jax.make_jaxpr(bad)(S8, S8)
+    findings = numerics.check_wire_trace(jx, where="seeded", anchor=ANCHOR)
+    assert any("raw" in f.message and "int8" in f.message
+               for f in findings), [f.format() for f in findings]
+
+
+@pytest.mark.fused_ring
+def test_wire_fp16_accum_behind_quant_fires():
+    """A f16 accumulator smuggled BEHIND the dequant+rescale: the scale
+    proof is satisfied (the mul is there) but the fp32-accum census of the
+    same verifier must still fire — quantizing the wire never licenses a
+    low-precision accumulator."""
+
+    def bad(q, k8, sc):
+        k = k8.astype(jnp.float16) * sc.astype(jnp.float16)
+        return jax.lax.dot_general(q.astype(jnp.float16), k,
+                                   (((1,), (1,)), ((), ())))
+
+    jx = jax.make_jaxpr(bad)(S4, S8, SC)
+    findings = ringcheck.verify_fused_bwd_trace(jx, where="seeded bwd",
+                                                anchor=ANCHOR)
+    assert "fp32-accum" in _rules_of(findings), [
+        f.format() for f in findings]
+    # and the rescale itself kept the scale proof quiet
+    assert not any("rescale" in f.message for f in findings
+                   if f.rule == "fused-ring-fused")
+
+
+@pytest.mark.fused_ring
+def test_wire_deferred_rescale_after_dot_is_quiet():
+    """The fused forward's idiom — cast up, dot, THEN fold the scalar
+    scale into the score (distributivity) — must stay quiet."""
+
+    def good(q, k8, sc):
+        k = k8.astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sc
+        return jnp.sum(s)
+
+    jx = jax.make_jaxpr(good)(S4, S8, SC)
+    assert numerics.check_wire_trace(jx, where="seeded", anchor=ANCHOR) == []
+
+
+@pytest.mark.fused_ring
+def test_wire_program_bogus_dtype_fires():
+    """The schedule-IR oracle validates the wire field: a program claiming
+    an unknown wire dtype must not prove."""
+    from burst_attn_tpu.parallel import schedule
+
+    prog = _export(schedule.compile_fwd("uni", 8, wire="int8"))
+    oracle.verify_ring_program(prog)  # the real one proves
+    prog["wire"] = "int4"
+    with pytest.raises(AssertionError, match="wire"):
+        oracle.verify_ring_program(prog)
+
+
+@pytest.mark.fused_ring
+def test_wire_recompile_credit_neutral():
+    """The wire recompile of every topology keeps the op table, slot
+    banks, and copy-in list bit-identical to the dense compile (scale
+    sub-payloads ride the SAME slot credits) while the remote-DMA census
+    strictly grows."""
+    from burst_attn_tpu.parallel import schedule as sched
+
+    for topo, ni, na in (("uni", 1, 8), ("bidi", 1, 4), ("double", 2, 4)):
+        for compiler, payload in ((sched.compile_fwd, 2),
+                                  (sched.compile_bwd, 4)):
+            dense = compiler(topo, na, ni)
+            wired = compiler(topo, na, ni, wire="int8")
+            assert np.array_equal(np.asarray(wired.to_table()),
+                                  np.asarray(dense.to_table())), (
+                topo, compiler.__name__)
+            assert tuple(wired.slots) == tuple(dense.slots)
+            assert list(wired.copy_in) == list(dense.copy_in)
+            assert (sched.expected_remote_dma(wired, payload)
+                    > sched.expected_remote_dma(dense, payload)), (
+                topo, compiler.__name__)
+
+
+# ---------------------------------------------------------------------------
 # pagepool-cow-safe mutations (ISSUE 13): the prefix-cache write barrier.
 # poolcheck drives a real tiny prefix-cache engine and checks every launch's
 # scatter columns against the live allocator, then proves the pool drains;
