@@ -18,6 +18,15 @@ def main(argv=None) -> int:
                     help="files/dirs for the AST rules (default: package)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit machine-readable JSON")
+    ap.add_argument("--sarif", metavar="PATH",
+                    help="also write findings as SARIF 2.1.0 to PATH "
+                         "(CI annotations); stdout output is unchanged")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="incremental mode: lint files changed since the "
+                         "merge-base with the default branch and skip "
+                         "dynamic rule families whose watched sources "
+                         "are untouched; falls back to a FULL run when "
+                         "git is unavailable")
     ap.add_argument("--ast-only", action="store_true",
                     help="skip the jaxpr tracing family (fast editor hook)")
     ap.add_argument("--disable", action="append", default=[],
@@ -34,11 +43,12 @@ def main(argv=None) -> int:
                                    + " --xla_force_host_platform_device_count=8")
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    from .core import RULES, render, run_analysis
+    from .core import RULES, render, render_sarif, run_analysis
 
     if args.list_rules:
         # force registration of the lazy rule families
-        from . import astlint, numerics, obscheck, ringcheck  # noqa: F401
+        from . import (astlint, numerics, obscheck,  # noqa: F401
+                       poolcheck, protocheck, ringcheck, servecheck)
 
         for name in sorted(RULES):
             r = RULES[name]
@@ -54,11 +64,17 @@ def main(argv=None) -> int:
             paths += default_paths(p) if os.path.isdir(p) else [p]
     try:
         findings = run_analysis(disable=args.disable, ast_only=args.ast_only,
-                                paths=paths)
+                                paths=paths,
+                                changed_only=args.changed_only)
     except Exception as e:  # noqa: BLE001 — CLI boundary: report, exit 2
         print(f"burstlint: internal error: {type(e).__name__}: {e}",
               file=sys.stderr)
         return 2
+    if args.sarif:
+        sarif_dir = os.path.dirname(os.path.abspath(args.sarif))
+        os.makedirs(sarif_dir, exist_ok=True)
+        with open(args.sarif, "w") as fh:
+            fh.write(render_sarif(findings))
     print(render(findings, args.as_json))
     return 1 if findings else 0
 

@@ -69,29 +69,101 @@ def filter_suppressed(findings: List[Finding], src_lines: List[str]):
     return out
 
 
+# Per-family source watchlists for --changed-only: a dynamic family
+# (jaxpr tracing / model checking) re-runs iff some changed file lives
+# under one of its watched subpackages.  Each list includes analysis/
+# so editing a rule always re-proves it.
+FAMILY_WATCH = {
+    "ringcheck": ("ops/", "parallel/", "utils/", "analysis/"),
+    "numerics": ("ops/", "analysis/"),
+    "obscheck": ("obs/", "models/", "parallel/", "serving/", "utils/",
+                 "analysis/"),
+    "servecheck": ("ops/", "serving/", "models/", "analysis/"),
+    "poolcheck": ("serving/", "models/", "analysis/"),
+    "protocheck": ("protocols/", "fleet/", "serving/", "models/",
+                   "analysis/"),
+}
+
+
+def changed_files(root) -> Optional[List[str]]:
+    """Absolute paths changed since the merge-base with the default
+    branch, plus uncommitted and untracked work.  Returns None when git
+    is unavailable or errors — callers MUST fall back to a full run."""
+    import os
+    import subprocess
+
+    def git(*args):
+        return subprocess.run(
+            ["git", "-C", root] + list(args), capture_output=True,
+            text=True, timeout=30)
+
+    try:
+        top = git("rev-parse", "--show-toplevel")
+        if top.returncode != 0:
+            return None
+        repo = top.stdout.strip()
+        names = set()
+        for branch in ("main", "master"):
+            mb = git("merge-base", "HEAD", branch)
+            if mb.returncode == 0:
+                d = git("diff", "--name-only", mb.stdout.strip(), "HEAD")
+                if d.returncode != 0:
+                    return None
+                names |= set(d.stdout.splitlines())
+                break
+        for args in (("diff", "--name-only", "HEAD"),
+                     ("ls-files", "--others", "--exclude-standard")):
+            r = git(*args)
+            if r.returncode != 0:
+                return None
+            names |= set(r.stdout.splitlines())
+        return sorted(os.path.join(repo, n) for n in names if n)
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _family_touched(family: str, changed: List[str]) -> bool:
+    watch = FAMILY_WATCH.get(family, ())
+    return any(f"burst_attn_tpu/{w}" in path.replace("\\", "/")
+               for path in changed for w in watch)
+
+
 def run_analysis(root=None, *, disable=(), ast_only=False,
-                 paths=None) -> List[Finding]:
+                 paths=None, changed_only=False) -> List[Finding]:
     """Run every registered rule; returns the surviving findings.
 
     root: package directory to lint (default: this package).  ast_only
-    skips the jaxpr tracing family (used by fast editor hooks); `paths`
-    overrides the AST lint file set."""
+    skips the dynamic families (used by fast editor hooks); `paths`
+    overrides the AST lint file set.  changed_only restricts the AST
+    rules to files changed since the merge-base with the default branch
+    and skips dynamic families whose watchlist (FAMILY_WATCH) is
+    untouched; when git is unavailable it silently degrades to the full
+    run (an incremental lint must never be LESS safe than none)."""
     import os
 
     from . import astlint
 
     if root is None:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    changed = changed_files(root) if changed_only else None
+    incremental = changed_only and changed is not None
     findings: List[Finding] = []
-    findings += astlint.lint_paths(paths or astlint.default_paths(root))
+    ast_paths = paths or astlint.default_paths(root)
+    if incremental:
+        keep = set(changed)
+        ast_paths = [p for p in ast_paths if os.path.abspath(p) in keep]
+    findings += astlint.lint_paths(ast_paths)
     if not ast_only:
-        from . import ringcheck, numerics, obscheck, poolcheck, servecheck
+        from . import (ringcheck, numerics, obscheck, poolcheck,
+                       protocheck, servecheck)
 
-        findings += ringcheck.check_all()
-        findings += numerics.check_all()
-        findings += obscheck.check_all()
-        findings += servecheck.check_all()
-        findings += poolcheck.check_all()
+        families = (("ringcheck", ringcheck), ("numerics", numerics),
+                    ("obscheck", obscheck), ("servecheck", servecheck),
+                    ("poolcheck", poolcheck), ("protocheck", protocheck))
+        for name, mod in families:
+            if incremental and not _family_touched(name, changed):
+                continue
+            findings += mod.check_all()
     return [f for f in findings if f.rule not in set(disable)]
 
 
@@ -111,3 +183,45 @@ def render(findings: List[Finding], as_json: bool) -> str:
     lines = [f.format() for f in findings]
     lines.append(f"burstlint: {len(findings)} finding(s)")
     return "\n".join(lines)
+
+
+def render_sarif(findings: List[Finding]) -> str:
+    """SARIF 2.1.0 — the schema CI annotation uploaders consume.  The
+    shape is pinned by tests/test_analysis.py's round-trip test; grow
+    it additively or fix the test with intent."""
+    import os
+
+    def location(f: Finding):
+        uri = f.file
+        if os.path.isabs(uri):
+            uri = os.path.relpath(uri, os.getcwd())
+        return {
+            "physicalLocation": {
+                "artifactLocation": {"uri": uri.replace(os.sep, "/")},
+                "region": {"startLine": max(1, f.line)},
+            }
+        }
+
+    sarif = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "burstlint",
+                "informationUri":
+                    "https://example.invalid/burst-attn-tpu/docs/analysis",
+                "rules": [{"id": name,
+                           "shortDescription": {"text": RULES[name].doc},
+                           "properties": {"kind": RULES[name].kind}}
+                          for name in sorted(RULES)],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [location(f)],
+            } for f in findings],
+        }],
+    }
+    return json.dumps(sarif, indent=1)

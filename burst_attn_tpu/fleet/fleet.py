@@ -69,6 +69,7 @@ import numpy as np
 from .. import obs
 from ..loadgen.driver import DONE, Outcome, ReplayReport, RetryBackoff
 from ..loadgen.trace import Trace
+from ..protocols import kvtransfer as kv_proto
 from . import kvplane
 from .transport import (
     Dedup, QueueTransport, SocketTransport, TransportError, accept, listen,
@@ -312,16 +313,26 @@ def prefill_main(wid: int, model_spec: dict, prefill_spec: dict,
                     rid=rid, max_new=max_new, first_token=first,
                     resume_toks=resume, prompt_len=len(prompt),
                     digests=[kvplane.page_digest(pg) for pg in pages])
-                send_with_retry(tr, {"op": "kv_begin", "rid": rid,
-                                     "seq": 0, "meta": meta}, rid=rid)
-                for j, pg in enumerate(pages):
-                    if die_mid_ship is not None and j >= die_mid_ship:
-                        tr.flush()   # delivered frames stay delivered
-                        os._exit(17)
-                    send_with_retry(tr, {"op": "kv_page", "rid": rid,
-                                         "seq": j + 1, "page": pg}, rid=rid)
-                send_with_retry(tr, {"op": "kv_end", "rid": rid,
-                                     "seq": len(pages) + 1}, rid=rid)
+                # the frame sequence (ops + seq numbers) comes from the
+                # transfer machine's sender_plan — the same tuple the
+                # burstcheck sender model walks, so the shipped protocol
+                # cannot drift from the checked one.  The plan also pins
+                # the credit contract: every frame ships without waiting
+                # (the one kv_ack arrives only after the replica commits)
+                for op, seq in kv_proto.sender_plan(len(pages)):
+                    if op == "kv_begin":
+                        frame = {"op": op, "rid": rid, "seq": seq,
+                                 "meta": meta}
+                    elif op == "kv_page":
+                        j = seq - 1
+                        if die_mid_ship is not None and j >= die_mid_ship:
+                            tr.flush()  # delivered frames stay delivered
+                            os._exit(17)
+                        frame = {"op": op, "rid": rid, "seq": seq,
+                                 "page": pages[j]}
+                    else:  # kv_end
+                        frame = {"op": op, "rid": rid, "seq": seq}
+                    send_with_retry(tr, frame, rid=rid)
                 pending[rid] = int(meta["n_pages"])
             elif stopping and not backlog and not pending:
                 _export(obs_path, wid)

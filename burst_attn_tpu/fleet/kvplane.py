@@ -32,6 +32,7 @@ import numpy as np
 
 from .. import obs
 from ..models.paged_decode import PagePool, PagedState, _write_table_row
+from ..protocols import kvtransfer as _kvp, pool as _pool_proto
 
 M_KV_PAGES_SHIPPED = obs.counter(
     "fleet.kv_pages_shipped", "pool pages serialized onto the wire")
@@ -95,35 +96,54 @@ def page_digest(pg: dict) -> str:
 class KvReceiver:
     """Staging area + transactional commit on the decode side.  Staging
     never touches the pool; only `commit` does, and only after every
-    precondition passes."""
+    precondition passes.
+
+    Control decisions — staging lifecycle, commit preconditions, and
+    the page ids a commit acquires — come from the PURE machine
+    `protocols.kvtransfer.recv_step`, the same transition function
+    burstcheck's transfer model explores (proto-transfer-atomic).  This
+    class keeps the payload arrays (which the machine does not model)
+    in lockstep with the machine's staging set and asserts the real
+    pool hands out exactly the ids the machine computed."""
 
     def __init__(self):
         self._staging: Dict[int, dict] = {}
+        self._proto = _kvp.RecvState((), _pool_proto.init(1), (), 0)
+
+    def _proto_step(self, event):
+        self._proto, outs = _kvp.recv_step(self._proto, event)
+        return outs
 
     def begin(self, rid: int, meta: dict) -> None:
         # a re-shipped attempt for the same rid replaces stale staging
+        self._proto_step(("begin", rid, int(meta["n_pages"])))
         self._staging[rid] = {"meta": dict(meta), "pages": {}}
 
     def add_page(self, rid: int, j: int, pg: dict) -> None:
-        st = self._staging.get(rid)
-        if st is None:
-            raise KeyError(f"kv_page for rid {rid} with no kv_begin")
+        # machine first: it owns the "page with no begin" staging check;
+        # shape validation failures roll the (pure, free to keep) prior
+        # machine state back so payloads and staging never diverge
+        prev = self._proto
+        self._proto_step(("page", rid, int(j)))
+        st = self._staging[rid]
         meta = st["meta"]
-        want = (meta["n_kv"], meta["page"], meta["d_head"])
-        for a in list(pg["k"]) + list(pg["v"]):
-            if tuple(np.shape(a)) != want:
-                raise ValueError(f"page {j} shape {np.shape(a)} != {want}")
-        if len(pg["k"]) != meta["n_layers"] \
-                or len(pg["v"]) != meta["n_layers"]:
-            raise ValueError(f"page {j} layer count mismatch")
+        try:
+            want = (meta["n_kv"], meta["page"], meta["d_head"])
+            for a in list(pg["k"]) + list(pg["v"]):
+                if tuple(np.shape(a)) != want:
+                    raise ValueError(
+                        f"page {j} shape {np.shape(a)} != {want}")
+            if len(pg["k"]) != meta["n_layers"] \
+                    or len(pg["v"]) != meta["n_layers"]:
+                raise ValueError(f"page {j} layer count mismatch")
+        except ValueError:
+            self._proto = prev
+            raise
         st["pages"][int(j)] = pg
 
     def complete(self, rid: int) -> bool:
-        st = self._staging.get(rid)
-        return (st is not None
-                and len(st["pages"]) == st["meta"]["n_pages"]
-                and all(j in st["pages"]
-                        for j in range(st["meta"]["n_pages"])))
+        ent = _kvp.staged_entry(self._proto, rid)
+        return ent is not None and _kvp.staging_complete(ent)
 
     def staged(self, rid: int) -> Optional[dict]:
         return self._staging.get(rid)
@@ -133,10 +153,24 @@ class KvReceiver:
 
     def abort(self, rid: int) -> bool:
         """Drop staging for `rid`.  Pool untouched by construction."""
-        dropped = self._staging.pop(rid, None) is not None
+        dropped = bool(self._proto_step(("abort", rid)))
+        self._staging.pop(rid, None)
         if dropped:
             M_KV_ABORTED.inc()
         return dropped
+
+    def _proto_snapshot(self, state: PagedState, pool: PagePool,
+                        n_slots: int) -> "_kvp.RecvState":
+        """The machine's view of THIS commit: real staging + the real
+        pool/slot occupancy (slot page sets are irrelevant to commit
+        preconditions, so they stay empty)."""
+        lengths = np.asarray(state.lengths)
+        return _kvp.RecvState(
+            staging=self._proto.staging,
+            pool=pool.proto_state(),
+            slots=tuple((1 if int(lengths[i]) else 0, ())
+                        for i in range(n_slots)),
+            table_width=int(state.page_table.shape[1]))
 
     def commit(self, rid: int, state: PagedState, pool: PagePool,
                slot: int) -> PagedState:
@@ -145,13 +179,15 @@ class KvReceiver:
         drop staging.  Raises with ZERO pool mutation when the transfer
         cannot be admitted (incomplete staging, live slot, table
         overflow, pool exhaustion)."""
-        st = self._staging.get(rid)
-        if st is None:
+        snap = self._proto_snapshot(state, pool, int(state.lengths.shape[0]))
+        ent = _kvp.staged_entry(snap, rid)
+        if ent is None:
             raise KeyError(f"commit for rid {rid} with no staging")
-        if not self.complete(rid):
+        if not _kvp.staging_complete(ent):
             raise ValueError(
-                f"rid {rid} staged {len(st['pages'])}/"
-                f"{st['meta']['n_pages']} pages; transfer incomplete")
+                f"rid {rid} staged {len(ent[2])}/{ent[1]} pages; "
+                f"transfer incomplete")
+        st = self._staging[rid]
         meta = st["meta"]
         n = int(meta["n_pages"])
         page = int(state.k_pages[0].shape[2])
@@ -160,15 +196,15 @@ class KvReceiver:
                              f"page size {page}")
         if len(state.k_pages) != meta["n_layers"]:
             raise ValueError("layer count mismatch")
-        if n > state.page_table.shape[1]:
-            raise ValueError(f"transfer needs {n} pages > table width "
-                             f"{state.page_table.shape[1]}")
-        if int(state.lengths[slot]) != 0:
-            raise RuntimeError(f"slot {slot} is still live; retire it first")
-        if pool.available < n:
-            raise RuntimeError(f"page pool exhausted: want {n}, have "
-                               f"{pool.available}")
-        ids = pool.acquire(n)
+        # the remaining control preconditions + the acquire run the full
+        # machine commit on the snapshot; the real pool then replays the
+        # acquire and MUST hand out the machine's exact ids
+        snap2, outs = _kvp.recv_step(snap, ("commit", rid, slot))
+        ids = list(outs[0][2])
+        got = pool.acquire(n)
+        assert got == ids, (
+            f"pool/machine divergence: machine acquired {ids}, "
+            f"pool acquired {got}")
         try:
             idx = jnp.asarray(ids, jnp.int32)
             k_pages, v_pages = list(state.k_pages), list(state.v_pages)
@@ -192,6 +228,7 @@ class KvReceiver:
         except Exception:
             pool.release(ids)
             raise
+        self._proto = self._proto._replace(staging=snap2.staging)
         del self._staging[rid]
         M_KV_COMMITTED.inc()
         return state

@@ -45,7 +45,6 @@ import base64
 import json
 import queue as _queue
 import socket
-import struct
 import time
 import zlib
 from collections import deque
@@ -54,17 +53,20 @@ from typing import Any, Callable, List, Optional, Tuple
 import numpy as np
 
 from .. import obs
+from ..protocols import transport as _proto_wire
 
 try:  # the container bakes msgpack in; the JSON codec keeps this soft
     import msgpack as _msgpack
 except ImportError:  # pragma: no cover - exercised via force_json paths
     _msgpack = None
 
-MAGIC = b"BAF1"
-_HEADER = struct.Struct("!4sII")  # magic, payload length, crc32(payload)
+# framing constants live with the pure parse machine — ONE definition
+# for production, the offline scanner, and the model checker
+MAGIC = _proto_wire.MAGIC
+_HEADER = _proto_wire._HEADER  # magic, payload length, crc32(payload)
 CODEC_MSGPACK = 1
 CODEC_JSON = 2
-MAX_FRAME = 1 << 28  # 256 MiB: a corrupt length field must not OOM us
+MAX_FRAME = _proto_wire.MAX_FRAME  # a corrupt length must not OOM us
 
 M_FRAMES_SENT = obs.counter(
     "fleet.frames_sent", "transport frames sent")
@@ -76,6 +78,16 @@ M_FRAMES_CRC_REJECTED = obs.counter(
     "fleet.frames_crc_rejected", "frames dropped on CRC mismatch")
 M_FRAMES_TORN = obs.counter(
     "fleet.frames_torn", "partial final frames from dead peers")
+M_PEER_LOSS_SWALLOWED = obs.counter(
+    "fleet.peer_loss_swallowed",
+    "dead-peer errors absorbed on transport protocol paths (each one is "
+    "also logged — silent-by-design must still be countable)")
+
+
+def _log():
+    from ..obs.logs import get_logger
+
+    return get_logger("burst_attn_tpu.fleet.transport")
 M_FRAMES_DEDUPED = obs.counter(
     "fleet.frames_deduped", "duplicate (rid, seq) frames dropped")
 M_SEND_RETRIES = obs.counter(
@@ -268,63 +280,72 @@ class FrameBuffer:
     — the peer's retry re-ships it); broken magic or an absurd length
     means lost sync and raises FrameError; `eof()` with a partial frame
     pending counts a torn tail, exactly like read_journal's final line.
+
+    The parse itself is the PURE machine `protocols.transport.wire_step`
+    — the same transition function burstcheck's wire model explores —
+    this class only applies its outputs (frame queue, obs counters, the
+    desync raise).
     """
 
     def __init__(self):
-        self._buf = bytearray()
+        self._wire = _proto_wire.wire_init()
         self.frames: deque = deque()
-        self.crc_rejected = 0
-        self.torn = 0
+
+    @property
+    def crc_rejected(self) -> int:
+        return self._wire.crc_rejected
+
+    @property
+    def torn(self) -> int:
+        return self._wire.torn
 
     def feed(self, chunk: bytes) -> None:
-        self._buf += chunk
-        while len(self._buf) >= _HEADER.size:
-            magic, length, crc = _HEADER.unpack_from(self._buf)
-            if magic != MAGIC or length > MAX_FRAME:
-                raise FrameError(
-                    f"stream lost sync (magic={bytes(magic)!r}, "
-                    f"length={length})")
-            end = _HEADER.size + length
-            if len(self._buf) < end:
-                return  # incomplete frame; wait for more bytes
-            payload = bytes(self._buf[_HEADER.size:end])
-            del self._buf[:end]
-            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
-                self.crc_rejected += 1
+        self._wire, outs = _proto_wire.wire_step(self._wire,
+                                                 ("feed", bytes(chunk)))
+        for out in outs:
+            if out[0] == "frame":
+                self.frames.append(out[1])
+                M_FRAMES_RECV.inc()
+            elif out[0] == "crc_reject":
                 M_FRAMES_CRC_REJECTED.inc()
-                continue  # drop; sender retry re-ships
-            self.frames.append(payload)
-            M_FRAMES_RECV.inc()
+            else:  # ("desync", msg): terminal — the stream lost sync
+                raise FrameError(out[1])
 
     def eof(self) -> None:
         """Peer closed: a pending partial frame is a torn tail."""
-        if self._buf:
-            self.torn += 1
+        self._wire, outs = _proto_wire.wire_step(self._wire, ("eof",))
+        if outs:
             M_FRAMES_TORN.inc()
-            self._buf.clear()
 
     def pending(self) -> int:
-        return len(self._buf)
+        return len(self._wire.buf)
 
 
 class Dedup:
     """At-least-once -> exactly-once: retried sends may deliver a frame
-    twice; consumers key idempotency by (rid, seq) and drop repeats."""
+    twice; consumers key idempotency by (rid, seq) and drop repeats.
+    Decisions come from `protocols.transport.dedup_step` — the machine
+    the checker's redelivery model runs."""
 
     def __init__(self):
-        self._seen = set()
+        self._state = _proto_wire.dedup_init()
+
+    @property
+    def _seen(self):
+        return set(self._state.seen)
 
     def accept(self, rid, seq) -> bool:
-        key = (rid, seq)
-        if key in self._seen:
+        self._state, outs = _proto_wire.dedup_step(self._state,
+                                                   ("frame", rid, seq))
+        if outs[0][0] == "dup":
             M_FRAMES_DEDUPED.inc()
             return False
-        self._seen.add(key)
         return True
 
     def forget_rid(self, rid) -> None:
         """A new transfer attempt for `rid` restarts its seq space."""
-        self._seen = {k for k in self._seen if k[0] != rid}
+        self._state, _ = _proto_wire.dedup_step(self._state,
+                                                ("forget", rid))
 
 
 # -- carriers ---------------------------------------------------------------
@@ -358,8 +379,15 @@ class QueueTransport:
                 frame = self._recv_q.get_nowait()
         except _queue.Empty:
             return None
-        except (OSError, EOFError, ValueError):
-            return None  # queue torn down under us (dead peer)
+        except (OSError, EOFError, ValueError) as e:
+            # queue torn down under us (dead peer): None is the contract,
+            # but the absorbed error must stay observable — a recv loop
+            # spinning on a dead queue shows up as this counter climbing
+            M_PEER_LOSS_SWALLOWED.inc()
+            from ..obs.logs import safe_warn
+            safe_warn(_log(), "recv queue torn down (%s: %s); "
+                      "returning None", type(e).__name__, e)
+            return None
         return decode_message(unpack_frame(frame))
 
     def flush(self) -> None:
@@ -445,7 +473,13 @@ class SocketTransport:
                 if time.monotonic() >= deadline:
                     return None
                 continue
-            except (ConnectionResetError, OSError):
+            except (ConnectionResetError, OSError) as e:
+                # peer reset mid-read: converted to EOF so the torn-tail
+                # accounting below runs, but logged + counted first
+                M_PEER_LOSS_SWALLOWED.inc()
+                from ..obs.logs import safe_warn
+                safe_warn(_log(), "socket recv failed (%s: %s); "
+                          "treating as EOF", type(e).__name__, e)
                 chunk = b""
             if not chunk:
                 self._closed = True
@@ -464,8 +498,13 @@ class SocketTransport:
         self._closed = True
         try:
             self._sock.close()
-        except OSError:
-            pass  # already dead; close is best-effort by contract
+        except OSError as e:
+            # already dead; close is best-effort by contract — but count
+            # it, so "every teardown errors" is visible in obs
+            M_PEER_LOSS_SWALLOWED.inc()
+            from ..obs.logs import safe_warn
+            safe_warn(_log(), "socket close failed (%s: %s); ignored",
+                      type(e).__name__, e)
 
 
 def listen(host: str = "127.0.0.1", port: int = 0):

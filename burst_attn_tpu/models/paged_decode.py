@@ -146,6 +146,13 @@ class PagePool:
     physical page referenced from several sequences' table rows and/or the
     prefix cache).  The pool never touches device memory: pages are
     recycled by table rewrite, stale contents are simply never addressed.
+
+    Every mutation runs through the PURE transition function
+    `protocols.pool.step` — the same function burstcheck's model checker
+    explores over all interleavings (proto-pool-conserved) — with
+    `_free`/`_refs` kept as the mutable mirror of the machine state
+    (checkpoint serialization and the fuzz integrity recount read them
+    directly).
     """
 
     def __init__(self, n_pages: int):
@@ -156,6 +163,20 @@ class PagePool:
         self.n_pages = n_pages
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
         self._refs = [0] * n_pages
+
+    def proto_state(self):
+        """The allocator as the machine's immutable PoolState."""
+        from ..protocols import pool as _pp
+
+        return _pp.from_lists(self.n_pages, self._free, self._refs)
+
+    def _step(self, event):
+        from ..protocols import pool as _pp
+
+        st, out = _pp.step(self.proto_state(), event)
+        self._free = list(st.free)
+        self._refs = list(st.refs)
+        return out
 
     @property
     def available(self) -> int:
@@ -183,42 +204,18 @@ class PagePool:
         return self._refs[int(i)]
 
     def acquire(self, n: int) -> List[int]:
-        if n > len(self._free):
-            raise RuntimeError(
-                f"page pool exhausted: want {n}, have {len(self._free)}")
-        out = [self._free.pop() for _ in range(n)]
-        for i in out:
-            self._refs[i] = 1
-        return out
+        out = self._step(("acquire", int(n)))
+        return list(out[0][1])
 
     def share(self, ids) -> None:
         """Add one reference to already-live pages (prefix reuse)."""
-        ids = [int(i) for i in ids]
-        for i in ids:
-            if not 0 < i < self.n_pages:
-                raise ValueError(f"bad page id {i}")
-            if self._refs[i] == 0:
-                raise ValueError(f"page {i} is free; share() needs a live page")
-        for i in ids:
-            self._refs[i] += 1
+        self._step(("share", tuple(int(i) for i in ids)))
 
     def release(self, ids) -> None:
         # an over-release would put the page on the free list while another
-        # sequence still references it — corrupt both, silently
-        ids = [int(i) for i in ids]
-        counts: dict = {}
-        for i in ids:
-            counts[i] = counts.get(i, 0) + 1
-        for i, c in counts.items():
-            if not 0 < i < self.n_pages:  # page 0 is the reserved sink
-                raise ValueError(f"bad page id {i}")
-            if self._refs[i] < c:
-                raise ValueError(
-                    f"page {i} released {c}x but has {self._refs[i]} refs")
-        for i in ids:
-            self._refs[i] -= 1
-            if self._refs[i] == 0:
-                self._free.append(i)
+        # sequence still references it — corrupt both, silently (the
+        # machine validates the whole batch before mutating anything)
+        self._step(("release", tuple(int(i) for i in ids)))
 
 
 class PrefixCache:

@@ -461,6 +461,10 @@ class ServeEngine:
         done = self._step()
         if self.journal is not None:
             self.journal.sync()
+            # delivery barrier (protocols.journal): every stream leaving
+            # this tick must already be durable, or delivered() raises
+            for rid, toks in done:
+                self.journal.delivered(rid, len(toks))
         return done
 
     def _step(self) -> List[Tuple[int, List[int]]]:

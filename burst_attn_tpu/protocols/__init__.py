@@ -1,0 +1,48 @@
+"""Pure protocol state machines — the specs burstcheck model-checks.
+
+Each module here extracts ONE production protocol into a pure
+
+    step(state, event) -> (state, outputs)
+
+transition function over immutable (hashable) state.  The production
+classes (`PagePool`, `TokenJournal`, `FrameBuffer`/`Dedup`,
+`KvReceiver`) DELEGATE their decisions to these functions, and the
+model checker (`analysis/modelcheck.py`) explores exactly the same
+functions over all bounded interleavings with crashes injected at
+every step — so the checked model cannot drift from shipped behavior
+(code-is-spec, stateright/dslabs style).
+
+Conventions shared by every machine:
+
+  * state is a NamedTuple of plain hashable values (tuples, ints,
+    frozensets) — the checker hashes states for dedup, so no lists,
+    dicts, or arrays;
+  * events are tuples `(kind, *args)`; outputs are a tuple of the same
+    shape (observable effects the caller applies: metrics, payload
+    moves, admitted decisions);
+  * transition functions raise the SAME exception types with the SAME
+    messages production historically raised — the delegating classes
+    re-raise them verbatim, which is what keeps the existing test
+    matrix byte-stable across this refactor;
+  * a `("crash",)` event is defined wherever a process death has
+    protocol-visible semantics (buffered-not-durable state vanishes);
+    the checker injects it between any two steps.
+
+Modules:
+
+  pool        PagePool refcount/free-list algebra + the CoW write barrier
+  journal     write-ahead token journal (append/sync/deliver/recover)
+  transport   frame parse (CRC/torn-tail) + (rid, seq) dedup
+  kvtransfer  transactional KV page transfer (stage/commit/abort + the
+              sender's hold-until-ack plan)
+"""
+
+
+class ProtocolError(Exception):
+    """Base for machine-raised protocol violations (each machine also
+    derives from the builtin type production historically raised, so
+    delegating call sites keep their existing `except` behavior)."""
+
+
+# submodules import ProtocolError from the package, so it must exist first
+from . import journal, kvtransfer, pool, transport  # noqa: E402,F401

@@ -83,8 +83,17 @@ M_JOURNAL_RECORDS = obs.counter(
     "serve.journal_records", "write-ahead token journal records appended")
 M_SNAPSHOT_SAVES = obs.counter(
     "serve.checkpoint_saves", "atomic engine snapshots written")
+M_JOURNAL_REOPEN_CORRUPT = obs.counter(
+    "serve.journal_reopen_corrupt",
+    "append-mode journal reopens that found an unreadable file")
 
 SNAPSHOT_VERSION = 1
+
+
+def _log():
+    from ..obs.logs import get_logger
+
+    return get_logger("burst_attn_tpu.serving.checkpoint")
 
 
 # -- write-ahead token journal ---------------------------------------------
@@ -106,17 +115,49 @@ class TokenJournal:
     Appends buffer in the file object; `sync()` (flush + fsync) is the
     durability barrier — the engines call it once per step(), AFTER the
     tick's appends and BEFORE returning results, so any token a caller
-    has seen is on disk."""
+    has seen is on disk.
+
+    Every append/sync/deliver runs the PURE machine
+    `protocols.journal.step` in lockstep with the file — the same
+    transition function burstcheck model-checks (proto-journal-durable).
+    `delivered(rid, n)` is the engines' delivery barrier: it raises
+    `DurabilityViolation` if a caller is about to see tokens the fsync
+    has not covered, so a sync-ordering regression fails in every
+    journaled test run, not just in the checker."""
 
     def __init__(self, path: str, *, truncate: bool = False):
+        from ..protocols import journal as _jp
+
         self.path = path
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        self._proto = _jp.init()
+        if not truncate and os.path.exists(path) and os.path.getsize(path):
+            # append-mode reopen: seed the machine's durable view with the
+            # existing file's fold so delivery checks stay exact
+            try:
+                view = journal_view(path)
+                self._proto = self._proto._replace(
+                    durable=tuple(sorted((r, len(t))
+                                         for r, t in view.tokens.items())),
+                    durable_done=tuple(sorted(view.done)))
+            except ValueError as e:
+                M_JOURNAL_REOPEN_CORRUPT.inc()
+                _log().warning(
+                    "journal %s unreadable on append-mode reopen (%s); "
+                    "delivery tracking restarts empty", path, e)
         self._f = open(path, "w" if truncate else "a", encoding="utf-8")
         self._dirty = False
 
+    def _proto_step(self, event) -> None:
+        from ..protocols import journal as _jp
+
+        self._proto, _ = _jp.step(self._proto, event)
+
     def _append(self, rec: dict) -> None:
+        self._proto_step(("append", rec["record"], rec["rid"],
+                          len(rec.get("toks", ()))))
         self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._dirty = True
         M_JOURNAL_RECORDS.inc()
@@ -142,6 +183,14 @@ class TokenJournal:
             self._f.flush()
             os.fsync(self._f.fileno())
             self._dirty = False
+            self._proto_step(("sync",))
+
+    def delivered(self, rid: int, n_total: int) -> None:
+        """The delivery barrier: a caller is observing `rid` at
+        `n_total` total journaled tokens.  Raises DurabilityViolation
+        (protocols.journal) when those tokens are not yet durable —
+        i.e. someone returned results before sync()."""
+        self._proto_step(("deliver", int(rid), int(n_total)))
 
     def close(self) -> None:
         if not self._f.closed:

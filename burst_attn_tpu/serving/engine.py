@@ -610,6 +610,13 @@ class RaggedServeEngine:
         done = self._step()
         if self.journal is not None:
             self.journal.sync()
+            # delivery barrier: run the journal machine's deliver
+            # transition for every stream leaving the engine this tick —
+            # protocols.journal raises if any returned token is not yet
+            # durable (the delivered ⟹ durable contract burstcheck
+            # model-checks as proto-journal-durable)
+            for rid, toks in done:
+                self.journal.delivered(rid, len(toks))
         return done
 
     def _step(self) -> List[Tuple[int, List[int]]]:

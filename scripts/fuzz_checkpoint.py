@@ -54,6 +54,35 @@ MODEL_SPEC = dict(vocab=97, d_model=32, n_layers=1, n_heads=2,
 ENGINE_SPEC = dict(slots=2, n_pages=8, page=128, max_pages_per_seq=2,
                    chunk=8)
 
+# The cache-fuzz kill points are NAMED BY burstcheck transitions: each
+# mode maps onto a transition label in the model checker's pool model
+# (burst_attn_tpu.analysis.modelcheck.pool_model), and
+# `checker_kill_modes` asserts the label is in the checker's enumerated
+# event vocabulary before fuzzing.  The fuzzer kills the REAL engine at
+# the step the checker explores symbolically — one shared event
+# vocabulary, so the two harnesses cannot drift apart silently.
+KILL_POINTS = {
+    # kill inside the CoW privatization (replacement acquired, shared
+    # ref not yet dropped) — the checker's CoW-barrier append step
+    "mid-cow": "append B (CoW barrier + write)",
+    # kill after the prefix-cache hit pinned pages (refcounts bumped,
+    # slot not yet wired) — the checker's cache-hit admission step
+    "mid-admission": "admit B (cache hit: share + acquire 1)",
+}
+
+
+def checker_kill_modes():
+    """The fuzz modes, validated against the checker's enumerated
+    transition steps."""
+    from burst_attn_tpu.analysis import modelcheck as mc
+
+    vocab = mc.event_vocabulary(mc.pool_model())
+    for mode, label in KILL_POINTS.items():
+        assert label in vocab, (
+            f"fuzz mode {mode!r} names checker step {label!r} which the "
+            f"pool model no longer enumerates; vocabulary: {vocab}")
+    return tuple(KILL_POINTS)
+
 
 def run_seed(seed: int, n_requests: int, out_dir: str) -> dict:
     import numpy as np
@@ -230,7 +259,7 @@ def run_cache_seed(seed: int, n_requests: int, out_dir: str) -> dict:
     n_total_steps = drive(eng, oracle)
 
     results = {}
-    for mode in ("mid-cow", "mid-admission"):
+    for mode in checker_kill_modes():
         snap_step = 1
         journal = ckpt.TokenJournal(jour, truncate=True)
         eng = build_engine(CACHE_MODEL_SPEC, cached_spec, journal=journal)

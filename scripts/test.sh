@@ -24,10 +24,14 @@ for a in "${args[@]}"; do
   esac
 done
 # burstlint pre-test gate: CPU-only static verification (ring invariants,
-# numerics contract, AST hygiene) in a few seconds — tier-1 fails on new
-# violations before any test runs.
+# numerics contract, AST hygiene, protocol model checking) in a few
+# seconds — tier-1 fails on new violations before any test runs.  The
+# SARIF copy feeds CI annotation uploaders; the gate itself keys off the
+# exit status.
 echo "== burstlint (python -m burst_attn_tpu.analysis) =="
-JAX_PLATFORMS=cpu python -m burst_attn_tpu.analysis
+mkdir -p results
+JAX_PLATFORMS=cpu python -m burst_attn_tpu.analysis \
+  --sarif results/burstlint.sarif
 
 if [[ $obs == 1 ]]; then
   # focused lane for the observability subsystem (registry math, spans,

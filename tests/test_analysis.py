@@ -39,7 +39,7 @@ def _rules_of(findings):
 
 def test_at_least_8_rules_registered():
     from burst_attn_tpu.analysis import astlint, numerics, obscheck, \
-        ringcheck  # noqa: F401
+        poolcheck, protocheck, ringcheck, servecheck  # noqa: F401
 
     assert len(RULES) >= 8
     for expected in ("silent-except", "mesh-shape-index",
@@ -48,7 +48,10 @@ def test_at_least_8_rules_registered():
                      "ring-order", "dq-return-home", "window-truncation",
                      "fp32-accum", "lse-fp32",
                      "fused-ring-schedule", "fused-ring-fused",
-                     "obs-jit-safe", "ckpt-jit-safe"):
+                     "obs-jit-safe", "ckpt-jit-safe",
+                     "ragged-serve-safe", "pagepool-cow-safe",
+                     "proto-transfer-atomic", "proto-journal-durable",
+                     "proto-pool-conserved", "proto-no-deadlock"):
         assert expected in RULES, expected
 
 
@@ -1004,3 +1007,330 @@ def test_poolcheck_refcount_leak_fires(monkeypatch):
     assert "pagepool-cow-safe" in _rules_of(findings)
     assert any("leak" in f.message for f in findings), [
         f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# proto-* model-checked protocol rules (ISSUE 15): burstcheck BFS-explores
+# every interleaving of the protocol machines (crash injected at every
+# step).  The machines below are the SAME module-level functions production
+# delegates to (tests/test_protocols.py proves the delegation), so each
+# mutation here is a defect both the checker and the serving stack would
+# execute — and each must fire exactly one proto-* rule with a minimal
+# counterexample trace in the message.
+
+
+def test_protocheck_rules_registered_and_anchored():
+    from burst_attn_tpu.analysis import protocheck
+
+    for name in ("proto-transfer-atomic", "proto-journal-durable",
+                 "proto-pool-conserved", "proto-no-deadlock"):
+        assert name in RULES and RULES[name].kind == "model"
+    # anchors must resolve into the production code that EXECUTES the
+    # violated machine, not <trace>
+    for model, tail in (("transfer", "kvplane.py"),
+                        ("journal", "checkpoint.py"),
+                        ("pool", "paged_decode.py")):
+        path, line = protocheck._anchor(model)
+        assert path.endswith(tail) and line > 0, (model, path)
+
+
+def test_proto_journal_dropped_fsync_fires(monkeypatch):
+    """The fsync barrier silently no-op'd: the engine's step boundary
+    delivers tokens that were never durable — a crash un-happens
+    delivered output.  proto-journal-durable must produce the minimal
+    generate -> step-boundary counterexample."""
+    from burst_attn_tpu.analysis import protocheck
+    from burst_attn_tpu.protocols import journal as jp
+
+    real = jp.step
+
+    def dropped_fsync(st, ev):
+        if ev[0] == "sync":
+            return st, ()
+        return real(st, ev)
+
+    monkeypatch.setattr(jp, "step", dropped_fsync)
+    findings = protocheck.check_all()
+    assert _rules_of(findings) == {"proto-journal-durable"}
+    msg = findings[0].message
+    assert "counterexample" in msg and "DurabilityViolation" in msg
+    assert "engine step boundary" in msg
+    assert findings[0].file.endswith("checkpoint.py")
+
+
+def test_proto_transfer_skipped_preconditions_fires(monkeypatch):
+    """commit_preconditions skipped (every control check gone): a
+    kv_end that outlives a receiver restart commits a half-shipped
+    transfer — pool pages materialize that never crossed the wire."""
+    from burst_attn_tpu.analysis import protocheck
+    from burst_attn_tpu.protocols import kvtransfer as kvp
+
+    def no_checks(st, rid, slot):
+        ent = kvp.staged_entry(st, rid)
+        return ent[1] if ent is not None else 2
+
+    monkeypatch.setattr(kvp, "commit_preconditions", no_checks)
+    findings = protocheck.check_all()
+    assert _rules_of(findings) == {"proto-transfer-atomic"}
+    msg = findings[0].message
+    assert "counterexample" in msg
+    assert "atomicity broken" in msg or "never shipped" in msg
+    assert findings[0].file.endswith("kvplane.py")
+
+
+def test_proto_transfer_eager_staging_leak_fires(monkeypatch):
+    """A receiver that acquires pool pages while STAGING (instead of at
+    commit) leaks them on any kill/abort mid-transfer — the checker's
+    held-vs-owned census catches the very first staged page."""
+    from burst_attn_tpu.analysis import protocheck
+    from burst_attn_tpu.protocols import kvtransfer as kvp
+    from burst_attn_tpu.protocols import pool as pl
+
+    real = kvp.recv_step
+
+    def eager(st, ev):
+        if ev[0] == "page":
+            npool, _ = pl.step(st.pool, ("acquire", 1))
+            st = st._replace(pool=npool)
+        return real(st, ev)
+
+    monkeypatch.setattr(kvp, "recv_step", eager)
+    findings = protocheck.check_all()
+    assert _rules_of(findings) == {"proto-transfer-atomic"}
+    msg = findings[0].message
+    assert "leak" in msg and "counterexample" in msg
+
+
+def test_proto_pool_noop_cow_fires(monkeypatch):
+    """The CoW privatization no-op'd (returns the same shared page):
+    B's append writes into a page the prefix cache still references —
+    the machine's own write barrier fires under the interleaving where
+    the cache entry is live."""
+    from burst_attn_tpu.analysis import protocheck
+    from burst_attn_tpu.protocols import pool as pp
+
+    real = pp.step
+
+    def no_cow(st, ev):
+        if ev[0] == "cow":
+            return st, (("cow", ev[1], ev[1]),)
+        return real(st, ev)
+
+    monkeypatch.setattr(pp, "step", no_cow)
+    findings = protocheck.check_all()
+    assert _rules_of(findings) == {"proto-pool-conserved"}
+    msg = findings[0].message
+    assert "CowViolation" in msg and "counterexample" in msg
+    assert "append B (CoW barrier + write)" in msg
+    assert findings[0].file.endswith("paged_decode.py")
+
+
+def test_proto_credit_window_deadlock_fires(monkeypatch):
+    """A per-page credit window against the commit-time-only ack is a
+    circular wait: the sender stalls for credits the receiver only
+    grants after kv_end, which the sender can never ship.  Bounded
+    liveness (proto-no-deadlock) must catch the wedge."""
+    from burst_attn_tpu.analysis import protocheck
+    from burst_attn_tpu.protocols import kvtransfer as kvp
+
+    monkeypatch.setattr(kvp, "PAGE_CREDIT_WINDOW", 1)
+    findings = protocheck.check_all()
+    assert _rules_of(findings) == {"proto-no-deadlock"}
+    msg = findings[0].message
+    assert "deadlock" in msg and "counterexample" in msg
+
+
+# ---------------------------------------------------------------------------
+# ragged-serve-safe mutations: the serving kernel's static contract.
+# Each seeds one contract violation into the traced launch and the rule
+# must fire (the clean run rides tier-1 via test_clean_run_on_real_package).
+
+
+def _fake_ragged(body):
+    """A stand-in for ragged_paged_attention with the production call
+    signature; `body(q_lens)` runs inside the trace."""
+
+    def kernel(q, kp, vp, table, q_lens, kv_lens, k_scales=None,
+               v_scales=None, interpret=True):
+        body(q_lens)
+        return q
+
+    return kernel
+
+
+def test_servecheck_callback_in_launch_fires(monkeypatch):
+    from burst_attn_tpu.analysis import servecheck
+    from burst_attn_tpu.ops import ragged_paged
+
+    monkeypatch.setattr(
+        ragged_paged, "ragged_paged_attention",
+        _fake_ragged(lambda lens: jax.debug.callback(lambda v: None, lens)))
+    findings = servecheck.check_all()
+    assert "ragged-serve-safe" in _rules_of(findings)
+    assert any("host-callback" in f.message for f in findings), [
+        f.format() for f in findings]
+
+
+def test_servecheck_remote_dma_census_fires(monkeypatch):
+    from burst_attn_tpu.analysis import ringcheck, servecheck
+
+    monkeypatch.setattr(ringcheck, "_remote_dma_starts",
+                        lambda jx: ["dma_start"])
+    findings = servecheck.check_all()
+    assert "ragged-serve-safe" in _rules_of(findings)
+    assert any("remote DMA" in f.message and "census" in f.message
+               for f in findings), [f.format() for f in findings]
+
+
+def test_servecheck_trace_failure_fires(monkeypatch):
+    """A host concretization of traced q_lens (`int()` on a tracer)
+    breaks jit-safety for the engine — the trace failure IS the
+    finding, at every launch width."""
+    from burst_attn_tpu.analysis import servecheck
+    from burst_attn_tpu.ops import ragged_paged
+
+    monkeypatch.setattr(ragged_paged, "ragged_paged_attention",
+                        _fake_ragged(lambda lens: int(lens[0])))
+    findings = servecheck.check_all()
+    assert len(findings) == 3  # all three engine-width cases fail
+    assert all(f.rule == "ragged-serve-safe"
+               and "not jit-safe" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# output formats: the pinned JSON and SARIF 2.1.0 shapes CI consumes.
+# render_sarif's docstring points here — grow the schema additively or
+# change these asserts with intent.
+
+
+def test_json_render_round_trips():
+    import json
+
+    from burst_attn_tpu.analysis.core import Finding, render
+
+    findings = [Finding(rule="time-in-jit", message="m", file="f.py",
+                        line=3)]
+    d = json.loads(render(findings, as_json=True))
+    assert set(d) == {"rules_registered", "n_findings", "findings"}
+    assert d["rules_registered"] == sorted(RULES)
+    assert d["n_findings"] == 1
+    assert d["findings"][0] == {"rule": "time-in-jit", "message": "m",
+                                "file": "f.py", "line": 3}
+
+
+def test_sarif_round_trips_pinned_schema():
+    import json
+
+    # force full registration so the SARIF rule table is complete
+    from burst_attn_tpu.analysis import (astlint, numerics,  # noqa: F401
+                                         obscheck, poolcheck, protocheck,
+                                         ringcheck, servecheck)
+    from burst_attn_tpu.analysis.core import Finding, render_sarif
+
+    findings = [
+        Finding(rule="silent-except", message="swallowed",
+                file="burst_attn_tpu/x.py", line=12),
+        Finding(rule="proto-no-deadlock", message="wedged"),  # line=0
+    ]
+    d = json.loads(render_sarif(findings))
+    assert d["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in d["$schema"]
+    assert len(d["runs"]) == 1
+    driver = d["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "burstlint"
+    ids = [r["id"] for r in driver["rules"]]
+    assert ids == sorted(RULES)
+    for r in driver["rules"]:
+        assert r["shortDescription"]["text"] == RULES[r["id"]].doc
+        assert r["properties"]["kind"] == RULES[r["id"]].kind
+    results = d["runs"][0]["results"]
+    assert [x["ruleId"] for x in results] == ["silent-except",
+                                              "proto-no-deadlock"]
+    for x in results:
+        assert x["level"] == "error"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("burst_attn_tpu/x.py")
+    assert loc["region"]["startLine"] == 12
+    # line 0 (no anchor) clamps to SARIF's 1-based minimum
+    loc0 = results[1]["locations"][0]["physicalLocation"]
+    assert loc0["region"]["startLine"] == 1
+
+
+def test_cli_sarif_flag_writes_file(tmp_path):
+    import json
+
+    from burst_attn_tpu.analysis.__main__ import main
+
+    out = tmp_path / "nested" / "burstlint.sarif"
+    rc = main(["--ast-only", "--sarif", str(out)])
+    assert rc == 0
+    d = json.loads(out.read_text())
+    assert d["version"] == "2.1.0"
+    assert d["runs"][0]["results"] == []
+
+
+# ---------------------------------------------------------------------------
+# --changed-only incremental mode: AST rules restricted to the changed
+# set, dynamic families skipped when their watchlist is untouched, FULL
+# run whenever git can't answer.
+
+
+def _spy_families(monkeypatch):
+    """Stub every dynamic family's check_all with a recorder."""
+    from burst_attn_tpu.analysis import (numerics, obscheck, poolcheck,
+                                         protocheck, ringcheck, servecheck)
+
+    ran = []
+    for name, mod in (("ringcheck", ringcheck), ("numerics", numerics),
+                      ("obscheck", obscheck), ("servecheck", servecheck),
+                      ("poolcheck", poolcheck), ("protocheck", protocheck)):
+        monkeypatch.setattr(mod, "check_all",
+                            lambda name=name: (ran.append(name), [])[1])
+    return ran
+
+
+def test_changed_only_runs_touched_families_only(monkeypatch):
+    from burst_attn_tpu.analysis import core
+
+    ran = _spy_families(monkeypatch)
+    monkeypatch.setattr(
+        core, "changed_files",
+        lambda root: ["/r/burst_attn_tpu/protocols/pool.py"])
+    findings = core.run_analysis(changed_only=True)
+    # protocols/ is watched by protocheck alone; the changed path is not
+    # a real AST lint target so the AST pass sees zero files
+    assert ran == ["protocheck"]
+    assert findings == []
+
+
+def test_changed_only_empty_change_set_skips_everything(monkeypatch):
+    from burst_attn_tpu.analysis import core
+
+    ran = _spy_families(monkeypatch)
+    monkeypatch.setattr(core, "changed_files", lambda root: [])
+    assert core.run_analysis(changed_only=True) == []
+    assert ran == []
+
+
+def test_changed_only_falls_back_to_full_run_without_git(monkeypatch):
+    from burst_attn_tpu.analysis import core
+
+    ran = _spy_families(monkeypatch)
+    monkeypatch.setattr(core, "changed_files", lambda root: None)
+    core.run_analysis(changed_only=True)
+    # git unavailable: the incremental mode must degrade to the FULL
+    # dynamic sweep, never a silent skip
+    assert sorted(ran) == ["numerics", "obscheck", "poolcheck",
+                           "protocheck", "ringcheck", "servecheck"]
+
+
+def test_changed_files_on_this_repo_answers_or_declines():
+    import os
+
+    from burst_attn_tpu.analysis import core
+
+    root = os.path.dirname(os.path.abspath(core.__file__))
+    got = core.changed_files(root)
+    assert got is None or isinstance(got, list)
+    if got is not None:
+        assert all(os.path.isabs(p) for p in got)

@@ -1,0 +1,178 @@
+"""burstcheck core: the bounded explicit-state checker itself, the
+three protocol models at gate bounds (the tier-1 canary), and the deep
+@slow sweeps at larger models/bounds.
+
+The checker mechanics are proven on tiny toy models where the full
+state graph is known by hand (minimal counterexample length, dedup,
+deadlock vs quiescence, fault exclusion); the protocol models are then
+proven CLEAN — their mutation proofs (each proto-* rule firing on a
+seeded defect) live in tests/test_analysis.py with the other burstlint
+mutation coverage.
+"""
+
+from typing import NamedTuple
+
+import pytest
+
+from burst_attn_tpu.analysis import modelcheck as mc
+from burst_attn_tpu.analysis import protocheck
+from burst_attn_tpu.protocols import ProtocolError
+
+
+# ---------------------------------------------------------------------------
+# checker mechanics on toy models
+
+
+class _Toy(NamedTuple):
+    x: int
+
+
+def _counter_model(bug_at=None, stuck_at=None, target=3):
+    """Counts 0..target by +1, with an optional seeded invariant bug or
+    a wedged state.  A 'reset' fault transition is always enabled."""
+
+    def transitions(s):
+        out = []
+        if s.x < target and s.x != stuck_at:
+            out.append((f"inc to {s.x + 1}", _Toy(s.x + 1)))
+        out.append(("crash reset", _Toy(0)))
+        return tuple(out)
+
+    return mc.Model(
+        "toy", _Toy(0), transitions,
+        invariant=lambda s: (f"hit the seeded bug at {s.x}"
+                             if s.x == bug_at else None),
+        quiescent=lambda s: s.x >= target)
+
+
+def test_clean_toy_model_exhausts():
+    r = mc.check(_counter_model(), max_depth=10)
+    assert r.ok and not r.truncated and r.violation is None
+    assert r.states == 4  # 0..3, reset dedups into 0
+
+
+def test_minimal_counterexample_by_bfs_order():
+    r = mc.check(_counter_model(bug_at=2), max_depth=10)
+    assert not r.ok and r.violation.kind == "invariant"
+    # shortest path to x==2 is exactly two increments — BFS guarantees
+    # the trace is minimal, not merely "a" trace
+    assert r.violation.trace == ("inc to 1", "inc to 2")
+
+
+def test_deadlock_detected_and_faults_dont_mask_it():
+    # at x==1 only the "crash reset" fault is enabled: wedged
+    r = mc.check(_counter_model(stuck_at=1), max_depth=10)
+    assert not r.ok and r.violation.kind == "deadlock"
+    assert r.violation.trace == ("inc to 1",)
+    assert "crash reset" in r.violation.message
+
+
+def test_depth_bound_sets_truncated():
+    r = mc.check(_counter_model(target=50), max_depth=3)
+    assert r.ok and r.truncated
+    assert r.depth == 3
+
+
+def test_guarded_turns_protocol_errors_into_violated():
+    class Boom(ProtocolError):
+        pass
+
+    def blow():
+        raise Boom("the machine said no")
+
+    label, state = mc.guarded("step", blow)
+    assert isinstance(state, mc.Violated)
+    assert "Boom" in state.message and "said no" in state.message
+
+
+def test_canonicalization_dedups_frozenset_orderings():
+    a = ("x", frozenset({1, 2, 3}))
+    b = ("x", frozenset({3, 1, 2}))
+    assert mc.canon(a) == mc.canon(b)
+    assert mc.state_key(a) == mc.state_key(b)
+    assert mc.canon(("y", frozenset({1}))) != mc.canon(("y", frozenset()))
+
+
+def test_format_trace_renders_counterexample():
+    v = mc.Violation("invariant", "boom", ("a", "b"))
+    s = mc.format_trace(v)
+    assert "boom" in s and "a -> b" in s and "2 step(s)" in s
+
+
+# ---------------------------------------------------------------------------
+# the protocol models, gate bounds (this is the tier-1 fast canary: the
+# same specs the burstlint gate runs)
+
+
+@pytest.mark.parametrize("spec", protocheck._GATE,
+                         ids=lambda s: s[0].__name__)
+def test_protocol_models_clean_at_gate_bounds(spec):
+    mk, kw, depth, states = spec
+    r = mc.check(mk(**kw), max_depth=depth, max_states=states)
+    assert r.ok, mc.format_trace(r.violation)
+    # the gate bounds must be EXHAUSTIVE for the gate models — a clean-
+    # but-truncated canary would be a silent soundness hole
+    assert not r.truncated, (r.states, r.depth)
+
+
+def test_event_vocabulary_names_fuzz_kill_points():
+    """scripts/fuzz_checkpoint.py's kill modes are names of checker
+    steps; the shared vocabulary is the anti-drift contract."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "fuzz_checkpoint", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "fuzz_checkpoint.py"))
+    fuzz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fuzz)
+    assert fuzz.checker_kill_modes() == ("mid-cow", "mid-admission")
+    vocab = mc.event_vocabulary(mc.pool_model())
+    for label in fuzz.KILL_POINTS.values():
+        assert label in vocab
+
+
+def test_transfer_model_vocabulary_is_the_wire_protocol():
+    vocab = mc.event_vocabulary(mc.transfer_model())
+    for stem in ("ship kv_begin", "ship kv_page", "ship kv_end",
+                 "deliver kv_begin", "deliver kv_end", "take kv_ack",
+                 "crash receiver (restart from snapshot)",
+                 "crash sender (router aborts transfer)"):
+        assert stem in vocab, (stem, vocab)
+
+
+# ---------------------------------------------------------------------------
+# deep-bound sweeps: larger models, exhaustive to higher depth.  Marked
+# slow by POLICY (they belong to the full suite / release runs; the
+# fast lane keeps the gate-bound canary above), not by measured
+# duration — so the marker lives here, not in conftest's timing list.
+
+
+@pytest.mark.slow
+def test_deep_sweep_transfer_three_pages():
+    r = mc.check(mc.transfer_model(n_pages=3, pool_pages=5),
+                 max_depth=80, max_states=2_000_000)
+    assert r.ok and not r.truncated, mc.format_trace(r.violation)
+
+
+@pytest.mark.slow
+def test_deep_sweep_transfer_four_pages_wide_pool():
+    r = mc.check(mc.transfer_model(n_pages=4, pool_pages=7,
+                                   table_width=6),
+                 max_depth=120, max_states=2_000_000)
+    assert r.ok and not r.truncated, mc.format_trace(r.violation)
+
+
+@pytest.mark.slow
+def test_deep_sweep_journal_five_tokens():
+    r = mc.check(mc.journal_model(n_tokens=5), max_depth=60,
+                 max_states=2_000_000)
+    assert r.ok and not r.truncated, mc.format_trace(r.violation)
+
+
+@pytest.mark.slow
+def test_deep_sweep_pool_larger():
+    r = mc.check(mc.pool_model(n_pages=7), max_depth=40,
+                 max_states=2_000_000)
+    assert r.ok and not r.truncated, mc.format_trace(r.violation)

@@ -1,0 +1,299 @@
+"""The pure protocol machines (burst_attn_tpu.protocols) and the
+production classes that execute them.
+
+Two families of proof here:
+
+  * machine semantics: the transition functions implement the exact
+    historical behavior (free-list pop order, CRC/desync policy,
+    journal fold, commit precondition order + messages);
+  * delegation: the PRODUCTION classes run THESE machines — spies on
+    the module-level step functions see production's calls, and the
+    machine's exceptions surface verbatim from production APIs.  This
+    is what makes burstcheck's models trustworthy: the checker and the
+    serving stack share one transition function per protocol, so they
+    cannot drift apart.
+"""
+
+import inspect
+
+import numpy as np
+import pytest
+
+from burst_attn_tpu.protocols import (ProtocolError, journal as jp,
+                                      kvtransfer as kvp, pool as pp,
+                                      transport as wp)
+
+
+# ---------------------------------------------------------------------------
+# pool machine + PagePool delegation
+
+
+def test_pool_machine_matches_pagepool_exactly():
+    from burst_attn_tpu.models.paged_decode import PagePool
+
+    pool = PagePool(n_pages=6)
+    st = pp.init(6)
+    got = pool.acquire(2)
+    st, out = pp.step(st, ("acquire", 2))
+    assert got == list(out[0][1]) == [1, 2]
+    pool.share([1])
+    st, _ = pp.step(st, ("share", (1,)))
+    pool.release([1, 2])
+    st, _ = pp.step(st, ("release", (1, 2)))
+    assert tuple(pool._free) == st.free
+    assert tuple(pool._refs) == st.refs
+    assert pp.conserved(st)
+
+
+def test_pagepool_calls_the_machine(monkeypatch):
+    """PagePool.acquire/share/release must EXECUTE protocols.pool.step —
+    the delegation burstcheck's pool model relies on."""
+    from burst_attn_tpu.models.paged_decode import PagePool
+
+    events = []
+    real = pp.step
+
+    def spy(st, ev):
+        events.append(ev)
+        return real(st, ev)
+
+    monkeypatch.setattr(pp, "step", spy)
+    pool = PagePool(n_pages=5)
+    ids = pool.acquire(2)
+    pool.share(ids[:1])
+    pool.release(ids + ids[:1])
+    assert ("acquire", 2) in events
+    assert ("share", (ids[0],)) in events
+    assert ("release", tuple(ids + ids[:1])) in events
+
+
+def test_pool_machine_exceptions_surface_from_pagepool():
+    from burst_attn_tpu.models.paged_decode import PagePool
+
+    pool = PagePool(n_pages=3)
+    with pytest.raises(RuntimeError, match="page pool exhausted"):
+        pool.acquire(5)
+    with pytest.raises(ValueError, match="is free"):
+        pool.share([1])
+    # both are ProtocolError subclasses, so callers can catch either way
+    with pytest.raises(ProtocolError):
+        pool.acquire(5)
+
+
+def test_pool_conservation_and_cow_algebra():
+    st = pp.init(5)
+    st, out = pp.step(st, ("acquire", 2))
+    a, b = out[0][1]
+    st, _ = pp.step(st, ("share", (a,)))
+    with pytest.raises(pp.CowViolation):
+        pp.step(st, ("write", a))
+    st, out = pp.step(st, ("cow", a))
+    _, old, new = out[0]
+    assert old == a and new not in (a, b)
+    st, _ = pp.step(st, ("write", new))  # private now: no raise
+    assert pp.conserved(st)
+
+
+# ---------------------------------------------------------------------------
+# journal machine + TokenJournal delegation
+
+
+def test_journal_machine_sync_fold_and_crash():
+    st = jp.init()
+    st, _ = jp.step(st, ("append", "tokens", 0, 2))
+    assert jp.durable_tokens(st, 0) == 0  # buffered only
+    st, _ = jp.step(st, ("sync",))
+    assert jp.durable_tokens(st, 0) == 2
+    st, _ = jp.step(st, ("append", "tokens", 0, 3))
+    st, _ = jp.step(st, ("crash",))
+    assert jp.durable_tokens(st, 0) == 2  # buffered records vanished
+
+
+def test_journal_deliver_barrier_raises_before_sync():
+    st = jp.init()
+    st, _ = jp.step(st, ("append", "tokens", 0, 1))
+    with pytest.raises(jp.DurabilityViolation, match="only 0 are durable"):
+        jp.step(st, ("deliver", 0, 1))
+    st, _ = jp.step(st, ("sync",))
+    st, _ = jp.step(st, ("deliver", 0, 1))
+    assert jp.durable_within_delivered(st)
+
+
+def test_tokenjournal_executes_the_machine(tmp_path, monkeypatch):
+    from burst_attn_tpu.serving import checkpoint as ckpt
+
+    events = []
+    real = jp.step
+
+    def spy(st, ev):
+        events.append(ev[0])
+        return real(st, ev)
+
+    monkeypatch.setattr(jp, "step", spy)
+    j = ckpt.TokenJournal(str(tmp_path / "j.jsonl"), truncate=True)
+    j.tokens(0, [1, 2])
+    with pytest.raises(RuntimeError, match="sync\\(\\) must run"):
+        j.delivered(0, 2)  # tokens buffered, not fsynced: the barrier
+    j.sync()
+    j.delivered(0, 2)  # durable now
+    assert events.count("append") == 1
+    assert "sync" in events and "deliver" in events
+
+
+# ---------------------------------------------------------------------------
+# wire machine + FrameBuffer/Dedup delegation
+
+
+def test_wire_machine_parses_crc_rejects_and_desyncs():
+    from burst_attn_tpu.fleet import transport as tp
+
+    good = tp.pack_frame(b"\x02{}")
+    bad = bytearray(tp.pack_frame(b"\x02[]"))
+    bad[-1] ^= 1  # payload bit flip: CRC must reject
+    st = wp.wire_init()
+    st, outs = wp.wire_step(st, ("feed", good + bytes(bad)))
+    assert [o[0] for o in outs] == ["frame", "crc_reject"]
+    st, outs = wp.wire_step(st, ("feed", b"JUNKJUNKJUNKJUNK"))
+    assert outs[-1][0] == "desync"
+    # bad bytes stay buffered: the next feed re-reports, like the
+    # historical FrameBuffer raise-per-feed
+    st, outs = wp.wire_step(st, ("feed", b""))
+    assert outs[-1][0] == "desync"
+
+
+def test_framebuffer_executes_the_machine(monkeypatch):
+    from burst_attn_tpu.fleet import transport as tp
+
+    events = []
+    real = wp.wire_step
+
+    def spy(st, ev):
+        events.append(ev[0])
+        return real(st, ev)
+
+    monkeypatch.setattr(wp, "wire_step", spy)
+    fb = tp.FrameBuffer()
+    fb.feed(tp.pack_frame(b"\x02{}"))
+    fb.eof()
+    assert events == ["feed", "eof"]
+    with pytest.raises(tp.FrameError, match="stream lost sync"):
+        fb.feed(b"NOPE" + b"\x00" * 12)
+
+
+def test_dedup_executes_the_machine():
+    from burst_attn_tpu.fleet import transport as tp
+
+    d = tp.Dedup()
+    assert d.accept(3, 0) and not d.accept(3, 0)
+    d.forget_rid(3)
+    assert d.accept(3, 0)
+    # the state IS machine state
+    assert isinstance(d._state, wp.DedupState)
+
+
+# ---------------------------------------------------------------------------
+# kv transfer machine + KvReceiver / prefill ship-loop delegation
+
+
+def test_sender_plan_shape_and_prefill_uses_it():
+    assert kvp.sender_plan(2) == (("kv_begin", 0), ("kv_page", 1),
+                                  ("kv_page", 2), ("kv_end", 3))
+    from burst_attn_tpu.fleet import fleet
+
+    # the prefill worker's ship loop iterates the machine's plan — the
+    # frame sequence on the wire IS sender_plan, not a parallel copy
+    assert "sender_plan" in inspect.getsource(fleet.prefill_main)
+
+
+def test_send_machine_holds_until_ack():
+    st = kvp.send_init(2, (5, 6))
+    sent = []
+    while kvp.send_enabled(st):
+        st, outs = kvp.send_step(st, ("send",))
+        sent.append(outs[0])
+    assert tuple(sent) == kvp.sender_plan(2)
+    assert st.holding == (5, 6)  # pinned until the ack
+    st, outs = kvp.send_step(st, ("ack",))
+    assert st.holding == () and outs == (("retire", (5, 6)),)
+
+
+def test_recv_machine_commit_precondition_order_and_messages():
+    st = kvp.recv_init(pp.init(4), 1, 4)
+    with pytest.raises(KeyError, match="no staging"):
+        kvp.recv_step(st, ("commit", 9, 0))
+    st, _ = kvp.recv_step(st, ("begin", 9, 2))
+    with pytest.raises(ValueError, match="staged 0/2 pages"):
+        kvp.recv_step(st, ("commit", 9, 0))
+    st, _ = kvp.recv_step(st, ("page", 9, 0))
+    st, _ = kvp.recv_step(st, ("page", 9, 1))
+    st, outs = kvp.recv_step(st, ("commit", 9, 0))
+    assert outs == (("committed", 9, (1, 2)),)
+    with pytest.raises(RuntimeError, match="still live"):
+        # re-commit to the live slot (after a hypothetical re-stage)
+        st2, _ = kvp.recv_step(st, ("begin", 9, 1))
+        st2, _ = kvp.recv_step(st2, ("page", 9, 0))
+        kvp.recv_step(st2, ("commit", 9, 0))
+
+
+def test_kvreceiver_routes_through_the_machine(monkeypatch):
+    from burst_attn_tpu.fleet.kvplane import KvReceiver
+
+    rx = KvReceiver()
+    with pytest.raises(KeyError, match="no kv_begin"):
+        rx.add_page(4, 0, {"k": [], "v": []})
+    rx.begin(4, {"n_pages": 1, "n_kv": 1, "page": 128, "d_head": 16,
+                 "n_layers": 1, "length": 2, "dtype": "float32"})
+    pg = {"k": [np.zeros((1, 128, 16), np.float32)],
+          "v": [np.zeros((1, 128, 16), np.float32)]}
+    rx.add_page(4, 0, pg)
+    assert rx.complete(4)
+
+    # commit must run the machine's precondition seam: a marker raise
+    # there surfaces from production's commit
+    class Marker(ProtocolError):
+        pass
+
+    def boom(st, rid, slot):
+        raise Marker("machine seam reached")
+
+    monkeypatch.setattr(kvp, "commit_preconditions", boom)
+    import jax.numpy as jnp
+
+    from burst_attn_tpu.models.paged_decode import init_paged_state
+    from burst_attn_tpu.models.transformer import ModelConfig
+
+    cfg = ModelConfig(n_layers=1, n_kv_heads=1, d_head=16,
+                      dtype=jnp.float32)
+    state, pool = init_paged_state(cfg, slots=1, n_pages=4, page=128,
+                                   max_pages_per_seq=2)
+    with pytest.raises(Marker):
+        # the machine raises right after production's payload-geometry
+        # checks pass — proof the control path runs the machine
+        rx.commit(4, state, pool, 0)
+
+
+def test_machine_and_pagepool_agree_on_commit_ids():
+    """The divergence assertion inside KvReceiver.commit, proven from
+    the outside: machine acquire and PagePool.acquire hand out the
+    same ids from the same free-list state."""
+    from burst_attn_tpu.models.paged_decode import PagePool
+
+    pool = PagePool(n_pages=6)
+    pool.acquire(1)  # disturb the free list first
+    st = kvp.recv_init(pool.proto_state(), 1, 4)
+    st, _ = kvp.recv_step(st, ("begin", 1, 2))
+    st, _ = kvp.recv_step(st, ("page", 1, 0))
+    st, _ = kvp.recv_step(st, ("page", 1, 1))
+    _, outs = kvp.recv_step(st, ("commit", 1, 0))
+    assert list(outs[0][2]) == pool.acquire(2)
+
+
+def test_crash_clears_staging_only():
+    st = kvp.recv_init(pp.init(4), 1, 4)
+    st, _ = kvp.recv_step(st, ("begin", 2, 1))
+    st, _ = kvp.recv_step(st, ("page", 2, 0))
+    st, _ = kvp.recv_step(st, ("commit", 2, 0))
+    st, _ = kvp.recv_step(st, ("begin", 3, 1))
+    st, _ = kvp.recv_step(st, ("crash",))
+    assert st.staging == ()
+    assert st.slots[0][0] == 1  # the committed slot is the MODEL's call
