@@ -61,6 +61,13 @@ fwd/bwd row also records `wire_bytes_per_round` — the per-round
 per-device ring bytes from schedule.wire_round_bytes, the single
 derivation the obs counters and the schedule-replay test share — so the
 fp32 vs int8 byte ratio is read straight off the jsonl.
+
+Every row additionally records the STATIC cost model's predicted floors
+(analysis/costmodel.py roofline: `t_comm_pred_s`, `t_compute_pred_s`)
+and `pred_ratio` (measured fused time over the model's binding floor),
+so each TPU window calibrates the model's spec-sheet HW table for free —
+the cost-model-consistent lint rule reads TPU rows back and fails when a
+measured comm floor drifts outside the model's calibration band.
 """
 
 import argparse
@@ -486,6 +493,36 @@ def run_config(seq, world, layout, n, d, causal, out_path, pass_="fwd",
             "ring_vs_floor_scan": round(t_scan / max(t_compute, t_comm), 4),
             "ring_vs_floor_fused": round(t_fused / max(t_compute, t_comm), 4),
         })
+    # the static cost model's predicted floors (analysis/costmodel.py)
+    # beside the measured ones: every TPU row calibrates the roofline's
+    # spec-sheet HW table for free (the cost-model-consistent lint rule
+    # reads these rows back), and pred_ratio is the measured-over-model
+    # correction factor.  Best-effort: the benchmark never fails on the
+    # model — a row without pred fields is a model bug to chase, not a
+    # lost measurement.
+    try:
+        from burst_attn_tpu.analysis import costmodel
+
+        pred_passes = ("fwd", "bwd") if pass_ == "fwd+bwd" else (pass_,)
+        t_comm_pred = t_compute_pred = 0.0
+        for p_ in pred_passes:
+            tc_, tx_ = costmodel.predict_floors(
+                p_, b=1, n=n, n_kv=n, s=seq // world, d=d, world=world,
+                topology=topology, wire=wire, layout=layout,
+                causal=causal, window=window,
+                opt_comm=scan_cfg.optimize_bwd_comm,
+                itemsize=jnp.dtype(dtype).itemsize)
+            t_comm_pred += tc_
+            t_compute_pred += tx_
+        # ns precision: CPU smoke shapes have sub-microsecond model floors
+        rec.update({
+            "t_comm_pred_s": round(t_comm_pred, 9),
+            "t_compute_pred_s": round(t_compute_pred, 9),
+            "pred_ratio": round(
+                t_fused / max(t_comm_pred, t_compute_pred), 4),
+        })
+    except Exception as e:  # noqa: BLE001 — keep the measurement
+        rec["pred_error"] = f"{type(e).__name__}: {e}"
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "a") as f:
         f.write(json.dumps(rec) + "\n")

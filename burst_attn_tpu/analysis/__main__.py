@@ -33,6 +33,12 @@ def main(argv=None) -> int:
                     metavar="RULE", help="disable a rule by name")
     ap.add_argument("--list-rules", action="store_true",
                     help="print registered rules and exit")
+    ap.add_argument("--cost-json", action="store_true",
+                    help="print the burstcost static resource/roofline "
+                         "table (schema burstcost-v1) as JSON and exit: "
+                         "the full tuning-table x topology x wire-dtype x "
+                         "pass matrix the autotuner prunes on and "
+                         "fleet/sim.py prices replicas with")
     args = ap.parse_args(argv)
 
     # the jaxpr family needs 8 simulated devices and must never grab a TPU:
@@ -47,12 +53,26 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         # force registration of the lazy rule families
-        from . import (astlint, numerics, obscheck,  # noqa: F401
-                       poolcheck, protocheck, ringcheck, servecheck)
+        from . import (astlint, costcheck, numerics,  # noqa: F401
+                       obscheck, poolcheck, protocheck, ringcheck,
+                       servecheck)
 
         for name in sorted(RULES):
             r = RULES[name]
             print(f"{name:22s} [{r.kind}]  {r.doc}")
+        return 0
+
+    if args.cost_json:
+        import json
+
+        from . import costmodel
+
+        try:
+            print(json.dumps(costmodel.cost_table(), indent=1))
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"burstcost: internal error: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
         return 0
 
     paths = None

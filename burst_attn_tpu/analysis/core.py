@@ -82,6 +82,7 @@ FAMILY_WATCH = {
     "poolcheck": ("serving/", "models/", "analysis/"),
     "protocheck": ("protocols/", "fleet/", "serving/", "models/",
                    "analysis/"),
+    "costcheck": ("ops/", "parallel/", "analysis/"),
 }
 
 
@@ -154,12 +155,13 @@ def run_analysis(root=None, *, disable=(), ast_only=False,
         ast_paths = [p for p in ast_paths if os.path.abspath(p) in keep]
     findings += astlint.lint_paths(ast_paths)
     if not ast_only:
-        from . import (ringcheck, numerics, obscheck, poolcheck,
-                       protocheck, servecheck)
+        from . import (costcheck, ringcheck, numerics, obscheck,
+                       poolcheck, protocheck, servecheck)
 
         families = (("ringcheck", ringcheck), ("numerics", numerics),
                     ("obscheck", obscheck), ("servecheck", servecheck),
-                    ("poolcheck", poolcheck), ("protocheck", protocheck))
+                    ("poolcheck", poolcheck), ("protocheck", protocheck),
+                    ("costcheck", costcheck))
         for name, mod in families:
             if incremental and not _family_touched(name, changed):
                 continue

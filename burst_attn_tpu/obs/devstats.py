@@ -28,7 +28,11 @@ caller sees per-device arrays of leading length `world`:
   rounds_live    rounds whose mask had ANY attending pair (ops/masks.spec_live)
   attn_pairs     attended (q, kv) pairs summed over rounds (f32)
   total_pairs    s_q * s_kv summed over executed rounds (occupancy denom)
-  flops          ~4 * head_dim * attn_pairs — the per-device balance measure
+  flops          ~4 * head_dim * attn_pairs — the per-device balance
+                 measure; the burstcost roofline carries the same algebra
+                 in closed form (analysis/costmodel.pass_flops), with the
+                 cost-model-consistent lint rule pinning the closed-form
+                 pair count to the per-round sum these counters integrate
   m_max          max running row-max after the ring (scan ring only; the
                  fused kernel keeps m internal — reported as -inf there)
   lse_min/max    finite range of the final log-sum-exp

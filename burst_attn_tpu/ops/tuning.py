@@ -159,6 +159,25 @@ def _log_resolution(kind: str, canonical: Optional[str], row: BlockTable,
         )
 
 
+def generations():
+    """Every named generation row of the tuning table plus the "default"
+    fallback, in sorted order — the static iteration domain of the cost
+    verifier (analysis/costmodel.py), which must prove budgets for rows a
+    CPU lint host can never resolve through jax.devices()."""
+    return tuple(sorted(_TABLE)) + ("default",)
+
+
+def generation_row(kind: str) -> BlockTable:
+    """The BlockTable row for a canonical generation name (or "default"),
+    with no device in hand — the device-free twin of block_defaults()."""
+    if kind == "default":
+        return _DEFAULT
+    if kind not in _TABLE:
+        raise KeyError(
+            f"unknown generation {kind!r}; expected one of {generations()}")
+    return _TABLE[kind]
+
+
 def canonical_kind(device=None):
     """Canonical generation name ("v5e"/"v5p"/"v4"/"v6") for a device's
     device_kind, or None when unrecognized — the one place device-kind
@@ -255,7 +274,8 @@ class ResolvedFused(NamedTuple):
 def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
                   device=None, block_q_bwd=None, block_kv_bwd=None,
                   bwd_slots=None, ccw_slots=None,
-                  bwd_ccw_slots=None, wire_dtype=None) -> ResolvedFused:
+                  bwd_ccw_slots=None, wire_dtype=None,
+                  table: Optional[BlockTable] = None) -> ResolvedFused:
     """Fill the fused ring kernels' knobs from the per-generation table.
 
     kv_slots / bwd_slots < 2 cannot double-buffer (the send target would
@@ -268,8 +288,11 @@ def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
     of a bidi ring, or the double ring's inter prefetch bank) per pass.
     wire_dtype=None means "use the generation's fused_wire_dtype default"
     (itself None on every row today — the wire stays bit-exact unless the
-    caller opts in per call)."""
-    t = block_defaults(device)
+    caller opts in per call).  `table` bypasses the device probe with an
+    explicit BlockTable row — how the static cost verifier resolves every
+    generation's knobs through the SAME defaulting algebra the dispatch
+    runs, from a host with no TPU."""
+    t = block_defaults(device) if table is None else table
     bq = t.fused_block_q if block_q is None else block_q
     bkv = t.fused_block_kv if block_kv is None else block_kv
     slots = t.fused_kv_slots if kv_slots is None else kv_slots
@@ -299,7 +322,8 @@ def resolve_fused(block_q=None, block_kv=None, kv_slots=None,
 
 def resolve_blocks(block_q=None, block_kv=None, block_q_bwd=None,
                    block_kv_bwd=None, block_kv_compute=None,
-                   device=None) -> ResolvedBlocks:
+                   device=None,
+                   table: Optional[BlockTable] = None) -> ResolvedBlocks:
     """Fill unspecified kernel block sizes from the per-generation table.
 
     The bwd defaults never exceed the (resolved) fwd blocks, so a caller who
@@ -308,9 +332,10 @@ def resolve_blocks(block_q=None, block_kv=None, block_q_bwd=None,
     past the generation's measured VMEM cliff are clamped (see
     _clamp_cliff; budgets come from the device's BlockTable row).  Always
     returns a 5-field ResolvedBlocks; callers without a compute sub-block
-    ignore the last field.
+    ignore the last field.  `table` bypasses the device probe with an
+    explicit BlockTable row (see resolve_fused).
     """
-    t = block_defaults(device)
+    t = block_defaults(device) if table is None else table
     bq = t.fwd_block_q if block_q is None else block_q
     bkv = t.fwd_block_kv if block_kv is None else block_kv
     bqb = min(t.bwd_block_q, bq) if block_q_bwd is None else block_q_bwd

@@ -897,7 +897,13 @@ def wire_round_bytes(pass_: str, wire: Optional[str], *, b: int, n: int,
     streams ship 1 byte/element plus one fp32 scale per quantized block at
     the scan ring's granularity (fwd: per (batch, kv head); bundle: per
     (batch, head) per operand; dq: per (batch, head)); lse always ships
-    b*n*s fp32.  Shapes are PER-SHARD."""
+    b*n*s fp32.  Shapes are PER-SHARD.
+
+    This is THE byte derivation: the burst.wire_bytes counters integrate
+    it per dispatch, and the burstcost roofline re-derives it
+    independently (analysis/costmodel.stream_bytes) with the
+    cost-model-consistent lint rule pinning the two equal — a change
+    here that the model doesn't mirror fails the gate."""
     wi = wire_itemsize(wire, itemsize)
     scale_b = 0 if wire is None else 4
     if pass_ == "fwd":

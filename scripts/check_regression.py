@@ -28,7 +28,12 @@ old number, not a regression, but a driver round gating on a 58-hour-old
 record should say so out loud.  `--strict-cache` escalates those warnings
 to exit 1 for lanes that must run on fresh measurements.  `--summary-json
 PATH` additionally writes the machine-readable verdict summary (gate,
-exit_code, per-metric verdicts) for CI annotation.
+exit_code, per-metric verdicts) for CI annotation; each verdict carries
+a `predicted` field — the static cost model's analytic roofline
+expectation for the metric (burst_attn_tpu.analysis.costmodel), so a
+stale cached number is read beside its analytic ceiling.  That one
+import is lazy and best-effort (predicted: null where the package or
+jax can't import) — the gate itself still runs stdlib-only.
 
 Exit status: 0 clean (or --dry-run), 1 regression, 2 internal error
 (missing/unparseable current headline counts as 2 — the gate cannot run).
@@ -118,11 +123,35 @@ def _cached_note(rec):
     return f" [cached, {float(age):.1f}h old]"
 
 
+_PREDICTED_CACHE = {}
+
+
+def predicted_value(metric):
+    """Analytic roofline expectation for this metric from the static cost
+    model (burst_attn_tpu.analysis.costmodel.predict_metric), or None
+    when the model can't price it.  Lazy best-effort import behind a
+    broad except: this script's no-third-party contract stands — where
+    the package (and jax) can't import, verdicts carry predicted: null
+    instead of failing the gate."""
+    if metric in _PREDICTED_CACHE:
+        return _PREDICTED_CACHE[metric]
+    try:
+        if ROOT not in sys.path:
+            sys.path.insert(0, ROOT)
+        from burst_attn_tpu.analysis import costmodel
+
+        value = costmodel.predict_metric(metric)
+    except Exception:  # noqa: BLE001 — model absence must not gate
+        value = None
+    _PREDICTED_CACHE[metric] = value
+    return value
+
+
 def check(headlines, history, tolerance, max_cached_age=None):
-    """[(status, line, direction)] verdicts; status in PASS/REGRESSION/
-    NO-HISTORY/STALE-CACHE, direction in "higher"/"lower" (the metric's
-    regression sense).  STALE-CACHE entries are warnings riding NEXT TO
-    the metric's real verdict — they never gate."""
+    """[(status, line, direction, metric)] verdicts; status in PASS/
+    REGRESSION/NO-HISTORY/STALE-CACHE, direction in "higher"/"lower" (the
+    metric's regression sense).  STALE-CACHE entries are warnings riding
+    NEXT TO the metric's real verdict — they never gate."""
     verdicts = []
     for path, metric, value, rec in headlines:
         note = _cached_note(rec)
@@ -137,7 +166,7 @@ def check(headlines, history, tolerance, max_cached_age=None):
             verdicts.append(("NO-HISTORY",
                              f"NO-HISTORY  {metric}: {value:g} "
                              f"({os.path.basename(path)}){note} — nothing "
-                             "to compare against", sense))
+                             "to compare against", sense, metric))
         else:
             best, source = (min if lower else max)(prior,
                                                    key=lambda vs: vs[0])
@@ -155,9 +184,11 @@ def check(headlines, history, tolerance, max_cached_age=None):
                     f"tolerance {tolerance:g}"
                     + (", direction=lower)" if lower else ")"))
             if regressed:
-                verdicts.append(("REGRESSION", f"REGRESSION  {line}", sense))
+                verdicts.append(("REGRESSION", f"REGRESSION  {line}",
+                                 sense, metric))
             else:
-                verdicts.append(("PASS", f"PASS        {line}", sense))
+                verdicts.append(("PASS", f"PASS        {line}", sense,
+                                 metric))
         if (max_cached_age is not None and rec.get("cached")
                 and float(rec.get("cached_age_hours", float("inf")))
                 > max_cached_age):
@@ -166,7 +197,8 @@ def check(headlines, history, tolerance, max_cached_age=None):
                 "STALE-CACHE",
                 f"STALE-CACHE {metric}: replayed record is {age}h old "
                 f"(> --max-cached-age {max_cached_age:g}) — warn only; "
-                "land a fresh on-chip run to refresh the cache", sense))
+                "land a fresh on-chip run to refresh the cache", sense,
+                metric))
     return verdicts
 
 
@@ -221,8 +253,8 @@ def main(argv=None) -> int:
         print(f"check_regression: {e}", file=sys.stderr)
         return 2
 
-    regressed = [line for st, line, _ in verdicts if st == "REGRESSION"]
-    stale = [line for st, line, _ in verdicts if st == "STALE-CACHE"]
+    regressed = [line for st, line, _, _ in verdicts if st == "REGRESSION"]
+    stale = [line for st, line, _, _ in verdicts if st == "STALE-CACHE"]
     gate_fail = bool(regressed) or (args.strict_cache and bool(stale))
     exit_code = 1 if gate_fail and not args.dry_run else 0
     summary = {
@@ -233,13 +265,18 @@ def main(argv=None) -> int:
         "n_stale_cached": len(stale),
         "exit_code": exit_code,
         "gate": "FAIL" if gate_fail else "PASS",
-        "verdicts": [{"status": st, "detail": line, "direction": sense}
-                     for st, line, sense in verdicts],
+        # `predicted` is the static cost model's analytic expectation for
+        # the metric (burstcost roofline) — null when the model can't
+        # price it or can't import; it sits beside stale cached numbers
+        # so a 5-day-old replay is read against the analytic ceiling
+        "verdicts": [{"status": st, "detail": line, "direction": sense,
+                      "predicted": predicted_value(metric)}
+                     for st, line, sense, metric in verdicts],
     }
     if args.as_json:
         print(json.dumps(summary, indent=1))
     else:
-        for _, line, _ in verdicts:
+        for _, line, _, _ in verdicts:
             print(line)
         print(f"check_regression: {len(regressed)} regression(s), "
               f"{len(stale)} stale-cache "

@@ -24,8 +24,10 @@ for a in "${args[@]}"; do
   esac
 done
 # burstlint pre-test gate: CPU-only static verification (ring invariants,
-# numerics contract, AST hygiene, protocol model checking) in a few
-# seconds — tier-1 fails on new violations before any test runs.  The
+# numerics contract, AST hygiene, protocol model checking, and the
+# burstcost resource/roofline family — the full tuning-table x topology x
+# wire-dtype x pass VMEM-budget matrix, sub-second) in a few seconds —
+# tier-1 fails on new violations before any test runs.  The
 # SARIF copy feeds CI annotation uploaders; the gate itself keys off the
 # exit status.
 echo "== burstlint (python -m burst_attn_tpu.analysis) =="

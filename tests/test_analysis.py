@@ -38,8 +38,8 @@ def _rules_of(findings):
 
 
 def test_at_least_8_rules_registered():
-    from burst_attn_tpu.analysis import astlint, numerics, obscheck, \
-        poolcheck, protocheck, ringcheck, servecheck  # noqa: F401
+    from burst_attn_tpu.analysis import astlint, costcheck, numerics, \
+        obscheck, poolcheck, protocheck, ringcheck, servecheck  # noqa: F401
 
     assert len(RULES) >= 8
     for expected in ("silent-except", "mesh-shape-index",
@@ -51,7 +51,9 @@ def test_at_least_8_rules_registered():
                      "obs-jit-safe", "ckpt-jit-safe",
                      "ragged-serve-safe", "pagepool-cow-safe",
                      "proto-transfer-atomic", "proto-journal-durable",
-                     "proto-pool-conserved", "proto-no-deadlock"):
+                     "proto-pool-conserved", "proto-no-deadlock",
+                     "kernel-vmem-budget", "cost-model-consistent",
+                     "tuning-table-sound"):
         assert expected in RULES, expected
 
 
@@ -1222,9 +1224,9 @@ def test_sarif_round_trips_pinned_schema():
     import json
 
     # force full registration so the SARIF rule table is complete
-    from burst_attn_tpu.analysis import (astlint, numerics,  # noqa: F401
-                                         obscheck, poolcheck, protocheck,
-                                         ringcheck, servecheck)
+    from burst_attn_tpu.analysis import (astlint, costcheck,  # noqa: F401
+                                         numerics, obscheck, poolcheck,
+                                         protocheck, ringcheck, servecheck)
     from burst_attn_tpu.analysis.core import Finding, render_sarif
 
     findings = [
@@ -1277,13 +1279,15 @@ def test_cli_sarif_flag_writes_file(tmp_path):
 
 def _spy_families(monkeypatch):
     """Stub every dynamic family's check_all with a recorder."""
-    from burst_attn_tpu.analysis import (numerics, obscheck, poolcheck,
-                                         protocheck, ringcheck, servecheck)
+    from burst_attn_tpu.analysis import (costcheck, numerics, obscheck,
+                                         poolcheck, protocheck, ringcheck,
+                                         servecheck)
 
     ran = []
     for name, mod in (("ringcheck", ringcheck), ("numerics", numerics),
                       ("obscheck", obscheck), ("servecheck", servecheck),
-                      ("poolcheck", poolcheck), ("protocheck", protocheck)):
+                      ("poolcheck", poolcheck), ("protocheck", protocheck),
+                      ("costcheck", costcheck)):
         monkeypatch.setattr(mod, "check_all",
                             lambda name=name: (ran.append(name), [])[1])
     return ran
@@ -1320,8 +1324,9 @@ def test_changed_only_falls_back_to_full_run_without_git(monkeypatch):
     core.run_analysis(changed_only=True)
     # git unavailable: the incremental mode must degrade to the FULL
     # dynamic sweep, never a silent skip
-    assert sorted(ran) == ["numerics", "obscheck", "poolcheck",
-                           "protocheck", "ringcheck", "servecheck"]
+    assert sorted(ran) == ["costcheck", "numerics", "obscheck",
+                           "poolcheck", "protocheck", "ringcheck",
+                           "servecheck"]
 
 
 def test_changed_files_on_this_repo_answers_or_declines():
@@ -1334,3 +1339,109 @@ def test_changed_files_on_this_repo_answers_or_declines():
     assert got is None or isinstance(got, list)
     if got is not None:
         assert all(os.path.isabs(p) for p in got)
+
+
+# ---------------------------------------------------------------------------
+# cost-* family (burstcost, ISSUE 16): clean on the real tables, and each
+# rule killed by its mutation — an inflated slot plan / deflated budget
+# (kernel-vmem-budget), a window-blind pair function (cost-model-
+# consistent), and a fwd<bwd table inversion (tuning-table-sound).
+
+
+def _v5e_row(**overrides):
+    from burst_attn_tpu.ops import tuning
+
+    return tuning.generation_row("v5e")._replace(**overrides)
+
+
+def test_cost_family_clean_on_real_tables():
+    from burst_attn_tpu.analysis import costcheck
+
+    findings = costcheck.check_all()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_kernel_vmem_budget_fires_on_deflated_budget():
+    """A row whose budget its OWN canonical-shape gate plan violates: the
+    dispatch gate would reject its own generation."""
+    from burst_attn_tpu.analysis import costcheck
+
+    row = _v5e_row(fused_vmem_budget=8 * 1024 * 1024)
+    findings = costcheck.check_vmem_budget(table=row)
+    assert findings
+    assert all(f.rule == "kernel-vmem-budget" for f in findings)
+    assert any("exceeds fused_vmem_budget" in f.message for f in findings)
+
+
+def test_kernel_vmem_budget_fires_on_inflated_slot_plan():
+    """Inflating the slot banks past the semaphore tripwires on a wide
+    ring: an unintended per-slot array growing the schedule is a lint
+    finding, not an on-device surprise."""
+    from burst_attn_tpu.analysis import costcheck
+
+    row = _v5e_row(fused_kv_slots=64, fused_bwd_slots=64)
+    findings = costcheck.check_vmem_budget(table=row, world=64)
+    assert any(f.rule == "kernel-vmem-budget"
+               and "semaphore census" in f.message for f in findings)
+
+
+def test_cost_model_consistent_fires_on_dropped_elision_term():
+    """A pair function that ignores the window term (counts the full
+    causal triangle) splits from the closed form on the windowed/elided
+    case — the devstats counters would integrate the wrong FLOPs."""
+    from burst_attn_tpu.analysis import costcheck
+    from burst_attn_tpu.ops.masks import _host_round_pairs
+
+    def window_blind(layout, q_part, kv_part, s, causal, window):
+        return _host_round_pairs(layout, q_part, kv_part, s, causal, None)
+
+    findings = costcheck.check_cost_consistency(pair_fn=window_blind)
+    assert any(f.rule == "cost-model-consistent"
+               and "pair algebra split" in f.message for f in findings)
+
+
+def test_tuning_table_sound_fires_on_fwd_bwd_inversion():
+    """A RAW bwd block larger than its fwd partner is dead weight
+    resolve_fused silently clamps away — the rule checks the raw fields
+    so the min() clamp cannot hide the inversion."""
+    from burst_attn_tpu.analysis import costcheck
+
+    row = _v5e_row(fused_block_q_bwd=1024, fused_block_q=512)
+    findings = costcheck.check_tuning_sound(table=row)
+    assert any(f.rule == "tuning-table-sound"
+               and "fused_block_q_bwd" in f.message for f in findings)
+
+
+def test_cost_json_cli_pinned_schema(capsys):
+    """--cost-json prints the burstcost-v1 table: the machine-readable
+    matrix the autotuner prunes on and fleet/sim.py prices with.  Grow
+    the schema additively or change these asserts with intent."""
+    import json
+
+    from burst_attn_tpu.analysis.__main__ import main
+
+    assert main(["--cost-json"]) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["schema"] == "burstcost-v1"
+    assert set(d) == {"schema", "world", "shape", "hw", "n_rows", "rows",
+                      "ragged"}
+    assert d["world"] == 8
+    assert set(d["shape"]) == {"b", "n", "n_kv", "s", "d"}
+    # 5 generations (4 named + default) x 3 topologies x 3 wires x 2 passes
+    assert d["n_rows"] == len(d["rows"]) == 90
+    row_keys = {"generation", "topology", "wire", "pass", "block_q",
+                "block_kv", "slots", "n_rounds", "gate_bytes", "vmem_bytes",
+                "slot_bytes", "sem_dma", "sem_regular", "budget",
+                "vmem_limit", "max_shard_seq", "vmem_bytes_at_max", "fits",
+                "flops", "hbm_bytes", "ici_bytes", "t_compute_s",
+                "t_comm_s"}
+    for row in d["rows"]:
+        assert set(row) == row_keys
+        # the acceptance bar: every tuning-table entry x topology x
+        # wire-dtype x pass statically proven within budget
+        assert row["fits"] is True, row
+    assert d["ragged"]
+    for row in d["ragged"]:
+        assert row["fits"] is True, row
+    for spec in d["hw"].values():
+        assert set(spec) == {"peak_flops", "hbm_bw", "ici_bw"}
