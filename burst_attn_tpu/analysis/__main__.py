@@ -35,7 +35,7 @@ def main(argv=None) -> int:
                     help="print registered rules and exit")
     ap.add_argument("--cost-json", action="store_true",
                     help="print the burstcost static resource/roofline "
-                         "table (schema burstcost-v1) as JSON and exit: "
+                         "table (schema burstcost-v2) as JSON and exit: "
                          "the full tuning-table x topology x wire-dtype x "
                          "pass matrix the autotuner prunes on and "
                          "fleet/sim.py prices replicas with")

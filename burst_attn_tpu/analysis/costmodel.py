@@ -304,6 +304,26 @@ RAGGED_MATRIX = tuple(
     for quantized in (False, True))
 
 
+# pool storage dtypes the paged KV cache can hold natively (ISSUE 17),
+# with their per-element byte cost; the 1 B/elem dtypes additionally
+# stream one fp32 scale per (token, kv head) for each of K and V
+POOL_DTYPES = {"fp32": 4, "int8": 1, "fp8": 1}
+
+
+def ragged_hbm_bytes(*, d_head: int, n_kv: int, kv_len: int,
+                     pool_dtype: str) -> int:
+    """Analytic HBM bytes ONE decode step's attention must stream per
+    sequence: the full resident K+V at the pool's storage width, plus —
+    on natively quantized pools — the fp32 per-token scale columns.
+    Decode is bandwidth-bound (the q tile is one token), so this ratio
+    IS the analytic decode-throughput win of a quantized pool."""
+    eb = POOL_DTYPES[pool_dtype]
+    total = 2 * n_kv * kv_len * d_head * eb         # K + V pages
+    if eb == 1:
+        total += 2 * n_kv * kv_len * 4              # fp32 scale sidecars
+    return total
+
+
 # ---------------------------------------------------------------------------
 # FLOPs: closed forms over the global mask, and the devstats per-round sum
 
@@ -576,7 +596,8 @@ def cost_table(world: int = DEFAULT_WORLD,
     machine-readable row per config: resolved knobs, static resource plan
     (at the canonical shape AND the largest gate-admitted shard), roofline
     estimates, and a `fits` verdict the autotuner prunes on.  Plus the
-    ragged-paged serving plans.  Schema "burstcost-v1" is pinned by
+    ragged-paged serving plans and the per-pool-dtype decode HBM pricing
+    (`ragged_hbm`, new in v2).  Schema "burstcost-v2" is pinned by
     tests/test_analysis.py."""
     shp = dict(DEFAULT_SHAPE if shape is None else shape)
     b, n, n_kv, s, d = (shp[k] for k in ("b", "n", "n_kv", "s", "d"))
@@ -629,8 +650,24 @@ def cost_table(world: int = DEFAULT_WORLD,
         pb = ragged_plan_bytes(**cfgr)
         ragged.append({**cfgr, "plan_bytes": pb, "vmem_limit": VMEM_LIMIT,
                        "fits": bool(pb <= VMEM_LIMIT)})
+    # per-pool-dtype decode bandwidth: what one decode step streams at
+    # the canonical shape's resident length, and the analytic win a
+    # 1 B/elem pool buys over fp32 (scale sidecars included)
+    ragged_hbm = []
+    for d_head in (128, 256):
+        base = ragged_hbm_bytes(d_head=d_head, n_kv=n_kv, kv_len=s,
+                                pool_dtype="fp32")
+        for pool_dtype, eb in sorted(POOL_DTYPES.items()):
+            hb = ragged_hbm_bytes(d_head=d_head, n_kv=n_kv, kv_len=s,
+                                  pool_dtype=pool_dtype)
+            ragged_hbm.append({
+                "d_head": d_head, "n_kv": n_kv, "kv_len": s,
+                "pool_dtype": pool_dtype, "kv_elem_bytes": eb,
+                "hbm_bytes": hb,
+                "win_vs_fp32": base / hb,
+            })
     return {
-        "schema": "burstcost-v1",
+        "schema": "burstcost-v2",
         "world": world,
         "shape": shp,
         "hw": {g: {"peak_flops": h.peak_flops, "hbm_bw": h.hbm_bw,
@@ -638,4 +675,5 @@ def cost_table(world: int = DEFAULT_WORLD,
         "n_rows": len(rows),
         "rows": rows,
         "ragged": ragged,
+        "ragged_hbm": ragged_hbm,
     }

@@ -221,10 +221,22 @@ class TransferState(NamedTuple):
     crashed_r: int                      # receiver restarts so far (bound 1)
     sender_dead: int
     aborted: int
+    # quantized pools only: page indexes whose fp32 scale sidecar is
+    # staged.  Kept OUTSIDE the machine (which models control, not
+    # payload) so a mutation that splits the (page, scale) pair — frames
+    # carrying one half — diverges from the machine's staging set and
+    # the pair invariant catches it.
+    scales: frozenset = frozenset()
 
 
 def transfer_model(n_pages: int = 2, pool_pages: int = 4,
-                   table_width: int = 4) -> Model:
+                   table_width: int = 4, quantized: bool = False) -> Model:
+    """`quantized=True` models a natively quantized (int8/fp8) pool's
+    transfer: every kv_page frame carries a (page, scale) PAIR
+    (`kv_proto.pair_members`), and the invariants additionally prove
+    exactly-once PAIR landing — staging never splits a pair, a commit
+    never materializes a page column whose scale sidecar did not ship,
+    and crash/abort at any step drops both halves together."""
     holding = tuple(range(1, n_pages + 1))  # sender-side pinned pages
     init = TransferState(
         send=kv_proto.send_init(n_pages, holding),
@@ -248,13 +260,22 @@ def transfer_model(n_pages: int = 2, pool_pages: int = 4,
             return s
         if op == "kv_begin":
             nrecv, _ = kv_proto.recv_step(s.recv, ("begin", _RID, n_pages))
-            return s._replace(recv=nrecv)
+            # a re-begin replaces staging: its sidecars reset with it
+            return s._replace(recv=nrecv, scales=frozenset())
         if op == "kv_page":
-            try:
-                nrecv, _ = kv_proto.recv_step(s.recv, ("page", _RID, seq - 1))
-            except ProtocolError:
-                return s  # stale page after a receiver restart: dropped
-            return s._replace(recv=nrecv)
+            staged_page = False
+            for unit, j in kv_proto.pair_members(seq - 1):
+                if unit == "page":
+                    try:
+                        nrecv, _ = kv_proto.recv_step(
+                            s.recv, ("page", _RID, j))
+                    except ProtocolError:
+                        return s  # stale page after a restart: dropped
+                    s = s._replace(recv=nrecv)
+                    staged_page = True
+                elif unit == "scale" and quantized and staged_page:
+                    s = s._replace(scales=s.scales | {j})
+            return s
         # kv_end: the commit attempt
         pre = kv_proto.staged_entry(s.recv, _RID)
         try:
@@ -262,7 +283,7 @@ def transfer_model(n_pages: int = 2, pool_pages: int = 4,
         except ProtocolError:
             # rejected: router aborts staging, kv_abort goes back
             nrecv, _ = kv_proto.recv_step(s.recv, ("abort", _RID))
-            return s._replace(recv=nrecv, nacks=1)
+            return s._replace(recv=nrecv, nacks=1, scales=frozenset())
         landed = couts[0][2] if couts and couts[0][0] == "committed" else ()
         got = len(pre[2]) if pre is not None else 0
         if pre is None or not kv_proto.staging_complete(pre):
@@ -271,7 +292,14 @@ def transfer_model(n_pages: int = 2, pool_pages: int = 4,
                 f"{got}/{n_pages} shipped pages staged — transfer "
                 f"atomicity broken (pages materialized that never "
                 f"shipped)")
-        return s._replace(recv=nrecv, committed=s.committed + 1, acks=1)
+        if quantized and s.scales != frozenset(range(n_pages)):
+            return Violated(
+                f"commit landed {len(landed)} quantized page column(s) "
+                f"with only {len(s.scales)}/{n_pages} scale sidecars "
+                f"staged — (page, scale) pair landing broken (a resident "
+                f"page would dequant with stale or missing scales)")
+        return s._replace(recv=nrecv, committed=s.committed + 1, acks=1,
+                          scales=frozenset())
 
     def transitions(s: TransferState):
         out = []
@@ -306,7 +334,7 @@ def transfer_model(n_pages: int = 2, pool_pages: int = 4,
                 return s._replace(
                     recv=kv_proto.recv_init(pool_proto.init(pool_pages),
                                             1, table_width),
-                    crashed_r=1)
+                    crashed_r=1, scales=frozenset())
             out.append(guarded("crash receiver (restart from snapshot)",
                                crash_recv))
         if not s.sender_dead and not s.send.acked and not s.committed:
@@ -316,7 +344,7 @@ def transfer_model(n_pages: int = 2, pool_pages: int = 4,
                 nrecv, _ = kv_proto.recv_step(s.recv, ("abort", _RID))
                 return s._replace(send=nsend, wire=(), recv=nrecv,
                                   acks=0, nacks=0, sender_dead=1,
-                                  aborted=1)
+                                  aborted=1, scales=frozenset())
             out.append(guarded("crash sender (router aborts transfer)",
                                crash_send))
         for op, seq in sorted(s.delivered):
@@ -350,6 +378,18 @@ def transfer_model(n_pages: int = 2, pool_pages: int = 4,
                     f"plane must leave the pool exactly as it was")
         if s.send.acked and s.send.holding:
             return "sender acked but still holds shipped pages"
+        if quantized and not isinstance(s, Violated):
+            # pair-staging integrity: the sidecar set must mirror the
+            # machine's staged page set at EVERY reachable state — a
+            # frame that carried one half of a (page, scale) pair shows
+            # up here as a split before commit can even be attempted
+            ent = kv_proto.staged_entry(s.recv, _RID)
+            got = frozenset(ent[2]) if ent is not None else frozenset()
+            if s.scales != got:
+                return (f"(page, scale) staging split: page columns "
+                        f"{sorted(got)} staged but scale sidecars "
+                        f"{sorted(s.scales)} — a kv_page frame carried "
+                        f"one half of a pair")
         return None
 
     def quiescent(s: TransferState) -> bool:

@@ -25,8 +25,32 @@ every usable page and every refcount must be zero — a refcount leak in
 release (pages held forever) or a double-free (free-list duplicates)
 both surface here.
 
+`pool-quant-safe` proves the natively quantized pool (ISSUE 17) keeps
+its (page, scale) pairs atomic under the same sharing-heavy schedule,
+driven on an fp8-storage engine.  A 1 B/elem pool is only meaningful
+WITH its per-token fp32 scale column — a page that lands without its
+scale (or a CoW copy that privatizes the page column but not the scale
+column) dequantizes to garbage up to the quant range (448x off for
+fp8-e4m3) with no error anywhere.  Three checks:
+
+  - every launch on the quantized pool must carry scale banks whose
+    shape mirrors the page banks (pair residency);
+  - every `_copy_pages_jit` CoW copy must carry all FOUR banks — K/V
+    pages and K/V scales — byte-exactly from source to private page
+    (the copy seam is wrapped; zero copies observed is itself a
+    finding, so the check cannot degenerate silently);
+  - after every launch, each K/V column the launch wrote must
+    dequantize (stored q * stored scale) back to the independently
+    recomputed true projection rows within fp8 quantization tolerance
+    — a scatter that lands the page bytes without updating the scale
+    column leaves a self-consistent-LOOKING pair that is numerically
+    wrong, and only ground truth can see it.
+
 Mutation coverage (tests/test_analysis.py): no-op'ing cow_pages fires
-the scatter check; a release that forgets to free fires the drain check.
+the scatter check; a release that forgets to free fires the drain
+check; a _copy_pages_jit that copies pages but not scales, and a step
+that reverts freshly scattered scale columns, each fire
+pool-quant-safe.
 """
 
 from typing import List
@@ -39,8 +63,14 @@ rule("pagepool-cow-safe", "jaxpr",
      "no jitted launch scatters K/V into a page held at refcount>1 "
      "(post-CoW table only), and the shared pool drains to empty after "
      "retire + full eviction")(None)
+rule("pool-quant-safe", "jaxpr",
+     "on a natively quantized (fp8) pool, every scatter and every CoW "
+     "copy lands the page and its scale column as one unit — written "
+     "columns dequantize to the true projections, privatized pages "
+     "carry their scales")(None)
 
 _RULE = "pagepool-cow-safe"
+_QRULE = "pool-quant-safe"
 
 
 def _anchor():
@@ -55,7 +85,19 @@ def _anchor():
         return "<trace>", 0
 
 
-def check_all() -> List[Finding]:
+def _quant_anchor():
+    import inspect
+
+    from ..serving import model as serve_model
+
+    try:
+        fn = serve_model.cow_pages
+        return inspect.getsourcefile(fn), inspect.getsourcelines(fn)[1]
+    except (OSError, TypeError):
+        return "<trace>", 0
+
+
+def _check_cow() -> List[Finding]:
     """Drive the shared-prefix schedule on a tiny engine; every launch is
     precondition-checked against the live allocator."""
     import jax
@@ -154,3 +196,180 @@ def check_all() -> List[Finding]:
                     f"page(s) never returned to the free list "
                     f"(still-referenced pages: {held})"))
     return findings
+
+
+def _check_quant() -> List[Finding]:
+    """Drive the same sharing-heavy schedule on an fp8-native pool; every
+    CoW copy and every scatter is checked for (page, scale) atomicity,
+    the latter against independently recomputed ground truth."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import ModelConfig, init_params
+    from ..serving import engine as eng_mod
+    from ..serving import model as serve_model
+
+    path, line = _quant_anchor()
+    findings: List[Finding] = []
+    cfg = ModelConfig(vocab=61, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_head=16, d_ff=64, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    page = 128
+    rng = np.random.default_rng(0x90002)
+    tmpl = rng.integers(1, 61, size=page)
+    prompts = [np.concatenate([tmpl, rng.integers(1, 61, size=7)]),
+               np.concatenate([tmpl, rng.integers(1, 61, size=11)]),
+               tmpl.copy()]  # FULL-prompt hit: the CoW pair-copy event
+
+    violations: List[str] = []
+    copies = {"n": 0}
+    real_step = eng_mod.ragged_model_step
+    real_copy = serve_model._copy_pages_jit
+
+    def checked_copy(state, src, dst):
+        # the pair-copy contract: a privatized page column arrives with
+        # its K/V bytes AND its scale column, byte-exactly (check on the
+        # RETURNED state only — the input state is donated)
+        ns = real_copy(state, src, dst)
+        s_ix, d_ix = np.asarray(src), np.asarray(dst)
+        copies["n"] += len(d_ix)
+        if ns.k_scales is None or ns.v_scales is None:
+            violations.append("CoW copy on the quantized pool returned a "
+                              "state with no scale banks")
+            return ns
+        banks = (("K page", ns.k_pages), ("V page", ns.v_pages),
+                 ("K scale", ns.k_scales), ("V scale", ns.v_scales))
+        for name, bank in banks:
+            for li, arr in enumerate(bank):
+                a = np.asarray(arr)
+                for s, d in zip(s_ix, d_ix):
+                    if a[int(d)].tobytes() != a[int(s)].tobytes():
+                        violations.append(
+                            f"CoW copy {int(s)}->{int(d)} layer {li}: the "
+                            f"{name} column was not carried to the private "
+                            "page — the (page, scale) pair split")
+        return ns
+
+    def checked_step(params_, toks, q_lens, state, cfg_, **kw):
+        # (1) pair residency: scale banks present and mirroring the page
+        #     banks' [P, Nkv, page] geometry at every launch
+        if state.k_scales is None or state.v_scales is None:
+            violations.append(
+                "a launch on the quantized pool carried no scale banks")
+            return real_step(params_, toks, q_lens, state, cfg_, **kw)
+        for li, (kpg, ksc) in enumerate(zip(state.k_pages, state.k_scales)):
+            if tuple(ksc.shape) != tuple(kpg.shape[:3]):
+                violations.append(
+                    f"layer {li}: scale bank {tuple(ksc.shape)} does not "
+                    f"mirror the page bank {tuple(kpg.shape[:3])}")
+        # precompute scatter targets and ground-truth projections BEFORE
+        # the launch (the state is donated to the jit); with n_layers=1
+        # the layer-0 K/V rows are a pure function of the input tokens,
+        # so the eager recomputation is exact modulo compile scheduling
+        ql = np.asarray(q_lens)
+        lens = np.asarray(state.lengths)
+        table = np.asarray(state.page_table)
+        pg = state.k_pages[0].shape[2]
+        toks_np = np.asarray(toks)
+        slots_n, qt = toks_np.shape
+        live = ql > 0
+        base = np.where(live, lens, 0)
+        t_ix = np.arange(qt)[None, :]
+        real = (t_ix < ql[:, None]) & live[:, None]
+        pos = base[:, None] + t_ix
+        safe_col = np.minimum(pos // pg, table.shape[1] - 1)
+        pids = np.where(real, table[np.arange(slots_n)[:, None], safe_col], 0)
+        offs = pos % pg
+        x = jnp.asarray(params_["embed"]).astype(cfg_.dtype)[
+            jnp.asarray(toks_np)]
+        _, k, v = serve_model._qkv_proj(params_["layers"][0], x,
+                                        jnp.asarray(pos), cfg_)
+        k_true = np.asarray(jnp.moveaxis(k, 1, 2), np.float32)
+        v_true = np.asarray(jnp.moveaxis(v, 1, 2), np.float32)
+
+        out = real_step(params_, toks, q_lens, state, cfg_, **kw)
+        ns = out[1]
+        # (2) atomic scatter: every column this launch wrote must
+        #     dequantize back to the true projection within quantization
+        #     tolerance — stale scales leave a pair that is internally
+        #     consistent but numerically wrong, so only ground truth
+        #     catches the split
+        kpg = np.asarray(ns.k_pages[0]).astype(np.float32)
+        vpg = np.asarray(ns.v_pages[0]).astype(np.float32)
+        ksc = np.asarray(ns.k_scales[0], np.float32)
+        vsc = np.asarray(ns.v_scales[0], np.float32)
+        for s in range(slots_n):
+            for t in range(qt):
+                if not real[s, t] or int(pids[s, t]) == 0:
+                    continue
+                pid, off = int(pids[s, t]), int(offs[s, t])
+                for nm, bank, scales, true in (("K", kpg, ksc, k_true),
+                                               ("V", vpg, vsc, v_true)):
+                    deq = bank[pid, :, off] * scales[pid, :, off][:, None]
+                    ref = true[s, t]
+                    amax = np.abs(ref).max(axis=-1, keepdims=True)
+                    tol = 0.07 * np.abs(ref) + 0.02 * amax + 1e-6
+                    err = np.abs(deq - ref)
+                    if np.any(err > tol):
+                        violations.append(
+                            f"slot {s} pos {int(pos[s, t])}: the stored "
+                            f"{nm} column dequantizes {float(err.max()):.3g}"
+                            " away from the true projection — the scatter "
+                            "landed the page without its scale (pair "
+                            "split)")
+        return out
+
+    eng_mod.ragged_model_step = checked_step
+    serve_model._copy_pages_jit = checked_copy
+    try:
+        engine = eng_mod.RaggedServeEngine(
+            params, cfg, slots=2, n_pages=12, page=page,
+            max_pages_per_seq=4, prefix_cache=True, chunk=page,
+            quantize="fp8")
+        for wave in range(2):
+            for p in prompts:
+                engine.submit(p, 3)
+            engine.run()
+    except Exception as e:  # noqa: BLE001 — the failure IS the finding
+        findings.append(Finding(
+            rule=_QRULE, file=path, line=line,
+            message="quantized-pool engine schedule crashed before the "
+                    f"pair-atomicity check completed ({type(e).__name__}: "
+                    f"{e})"))
+        return findings
+    finally:
+        eng_mod.ragged_model_step = real_step
+        serve_model._copy_pages_jit = real_copy
+
+    if copies["n"] == 0:
+        # the schedule MUST exercise privatization (the wave-2 full-
+        # prompt hit); zero observed copies means the pair-copy check
+        # proved nothing — surface that instead of passing silently
+        findings.append(Finding(
+            rule=_QRULE, file=path, line=line,
+            message="the quantized drive observed zero CoW copies — the "
+                    "(page, scale) pair-copy check did not run; the "
+                    "schedule no longer exercises privatization"))
+    if violations:
+        findings.append(Finding(
+            rule=_QRULE, file=path, line=line,
+            message=f"{len(violations)} (page, scale) pair violation(s) "
+                    "on the fp8-native pool: " + "; ".join(violations[:3])))
+
+    # the quantized pool must drain by the same algebra
+    engine.cache.evict(engine.pool.n_pages)
+    pool = engine.pool
+    usable = pool.n_pages - 1
+    if pool.available != usable or any(r != 0 for r in pool._refs[1:]):
+        held = [i for i in range(1, pool.n_pages) if pool._refs[i] > 0]
+        findings.append(Finding(
+            rule=_QRULE, file=path, line=line,
+            message="refcount leak on the quantized pool: after retiring "
+                    f"every request and evicting the whole cache, "
+                    f"{usable - pool.available} page(s) never returned "
+                    f"(still-referenced: {held})"))
+    return findings
+
+
+def check_all() -> List[Finding]:
+    return _check_cow() + _check_quant()

@@ -6,7 +6,10 @@ every step — and proves the safety invariants the fleet's docs promise:
 
   proto-transfer-atomic   every KV transfer lands exactly once; a kill
                           or abort at ANY step leaves the receiver pool
-                          without a single leaked page
+                          without a single leaked page.  Checked twice:
+                          full-precision, and quantized — where every
+                          kv_page frame is a (page, scale) PAIR that
+                          must stage/land/abort as one unit
   proto-journal-durable   no token reaches a caller before its journal
                           record is fsynced (delivered ⟹ durable), so a
                           crash never un-happens delivered output
@@ -63,6 +66,10 @@ _SAFETY_RULE = {
 # size bounds when growing a model)
 _GATE = (
     (mc.transfer_model, {}, 40, 100_000),
+    # the quantized-pool variant: kv_page frames carry (page, scale)
+    # pairs; exactly-once landing must hold PER PAIR (a split sidecar —
+    # SCALE_PAIRED mutated off — fires proto-transfer-atomic)
+    (mc.transfer_model, {"quantized": True}, 40, 100_000),
     (mc.journal_model, {}, 24, 50_000),
     (mc.pool_model, {}, 20, 50_000),
 )

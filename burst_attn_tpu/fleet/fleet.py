@@ -228,7 +228,8 @@ def prefill_main(wid: int, model_spec: dict, prefill_spec: dict,
         page = int(ps.get("page", 128))
         state, pool = init_paged_state(
             cfg, slots=1, n_pages=int(ps.get("n_pages", 4)), page=page,
-            max_pages_per_seq=int(ps.get("max_pages_per_seq", 8)))
+            max_pages_per_seq=int(ps.get("max_pages_per_seq", 8)),
+            quantize=ps.get("quantize", False))
         # warm-compile the ring pass on the fleet's prompt shape BEFORE
         # ready: a compile inside the message loop would miss heartbeats
         warm = jnp.zeros((int(ps.get("warm_len", page)),), jnp.int32)
@@ -376,7 +377,8 @@ def decode_main(wid: int, model_spec: dict, decode_spec: dict,
         pool_args = dict(slots=slots, n_pages=int(ds.get("n_pages", 8)),
                          page=page,
                          max_pages_per_seq=int(ds.get("max_pages_per_seq",
-                                                      4)))
+                                                      4)),
+                         quantize=ds.get("quantize", False))
         echo_digests = bool(ds.get("echo_digests"))
         export_every = int(ds.get("export_every", 4))
         ck = dict(ckpt_spec) if ckpt_spec else None
@@ -663,7 +665,8 @@ def fleet_oracle(trace: Trace, model_spec: dict, *, prefill_spec=None,
     mesh = make_mesh({"sp": int(ps.get("sp", 2))})
     pool_args = dict(slots=1, n_pages=int(ds.get("n_pages", 8)),
                      page=int(ds.get("page", 128)),
-                     max_pages_per_seq=int(ds.get("max_pages_per_seq", 4)))
+                     max_pages_per_seq=int(ds.get("max_pages_per_seq", 4)),
+                     quantize=ds.get("quantize", False))
     tokens_by_rid, digests_by_rid = {}, {}
     for req in trace.requests:
         state, pool = init_paged_state(cfg, **pool_args)

@@ -8,7 +8,12 @@ validity is table membership, never ordering) means they move VERBATIM:
 page j of the slot's table row on the prefill side becomes page j of the
 replica's table row, whatever physical pool ids each side assigned.  No
 re-layout, no reordering, byte-identical payloads — the tests compare
-`page_bytes` on both ends.
+`page_bytes` on both ends.  A natively quantized pool (int8/fp8) ships
+its 1 B/elem pages the same way, with the per-token fp32 scale columns
+riding each kv_page frame as sidecars — a (page, scale) pair stages,
+commits, and aborts as one unit, so the transfer machine's exactly-once
+page landing is exactly-once pair landing; both ends must agree on the
+pool dtype (checked before a single page is acquired).
 
 The transfer is TRANSACTIONAL on the receive side:
 
@@ -51,18 +56,27 @@ def export_slot_pages(state: PagedState, slot: int) -> Tuple[dict, List[dict]]:
     layer/head counts, dtype, token length); pages[j] holds table column
     j's per-layer K and V arrays [n_kv, page, d_head] as numpy — page j
     on the wire is position range [j*page, (j+1)*page) in layout order,
-    exactly what the sender's table row j pointed at."""
-    if state.k_scales is not None:
-        raise ValueError("KV plane ships full-precision pools only "
-                         "(quantized transfer is a future lever)")
+    exactly what the sender's table row j pointed at.
+
+    A quantized pool (int8/fp8 native storage) ships its 1 B/elem pages
+    VERBATIM plus fp32 scale sidecars: pages[j]["ks"]/["vs"] carry table
+    column j's per-layer [n_kv, page] dequant columns, and
+    meta["quantized"] is True so the receive side can refuse a
+    cross-precision commit before touching its pool.  A (page, scale)
+    pair always rides in ONE kv_page frame — the transfer machine's
+    exactly-once page landing is exactly-once PAIR landing."""
     length = int(state.lengths[slot])
     if length == 0:
         raise ValueError(f"slot {slot} is not live; nothing to export")
+    quant = state.k_scales is not None
     page = int(state.k_pages[0].shape[2])
     n_pages = -(-length // page)
     row = np.asarray(state.page_table[slot])[:n_pages]
     k_host = [np.asarray(kp) for kp in state.k_pages]
     v_host = [np.asarray(vp) for vp in state.v_pages]
+    if quant:
+        ks_host = [np.asarray(s) for s in state.k_scales]
+        vs_host = [np.asarray(s) for s in state.v_scales]
     meta = {
         "length": length,
         "page": page,
@@ -71,22 +85,39 @@ def export_slot_pages(state: PagedState, slot: int) -> Tuple[dict, List[dict]]:
         "n_kv": int(state.k_pages[0].shape[1]),
         "d_head": int(state.k_pages[0].shape[3]),
         "dtype": str(np.asarray(state.k_pages[0]).dtype),
+        "quantized": quant,
     }
     pages = []
     for j, pid in enumerate(row):
         pg = {"k": [k_host[li][int(pid)] for li in range(meta["n_layers"])],
               "v": [v_host[li][int(pid)] for li in range(meta["n_layers"])]}
+        if quant:
+            pg["ks"] = [ks_host[li][int(pid)]
+                        for li in range(meta["n_layers"])]
+            pg["vs"] = [vs_host[li][int(pid)]
+                        for li in range(meta["n_layers"])]
         pages.append(pg)
         M_KV_PAGES_SHIPPED.inc()
-        M_KV_BYTES_SHIPPED.inc(sum(a.nbytes for a in pg["k"] + pg["v"]))
+        M_KV_BYTES_SHIPPED.inc(sum(a.nbytes for a in _page_arrays(pg)))
     return meta, pages
 
 
+def _page_arrays(pg: dict) -> List[np.ndarray]:
+    """Every array of one page message in canonical order: k, v, then the
+    scale sidecars when the pool is quantized."""
+    arrays = list(pg["k"]) + list(pg["v"])
+    if "ks" in pg:
+        arrays += list(pg["ks"]) + list(pg["vs"])
+    return arrays
+
+
 def page_bytes(pg: dict) -> bytes:
-    """Canonical byte string of one page message (k then v, layer
-    order) — the unit the byte-identity tests and `page_digest` hash."""
+    """Canonical byte string of one page message (k then v then the scale
+    sidecars, layer order) — the unit the byte-identity tests and
+    `page_digest` hash.  A quantized page's digest covers its scales, so
+    a (page, scale) pair that forked anywhere on the wire cannot match."""
     return b"".join(np.ascontiguousarray(a).tobytes()
-                    for a in list(pg["k"]) + list(pg["v"]))
+                    for a in _page_arrays(pg))
 
 
 def page_digest(pg: dict) -> str:
@@ -136,6 +167,26 @@ class KvReceiver:
             if len(pg["k"]) != meta["n_layers"] \
                     or len(pg["v"]) != meta["n_layers"]:
                 raise ValueError(f"page {j} layer count mismatch")
+            if meta.get("quantized"):
+                # quantized streams stage (page, scale) PAIRS: a frame
+                # missing its sidecars (or malformed) is rejected whole,
+                # so staging can never hold a page without its scales
+                if "ks" not in pg or "vs" not in pg:
+                    raise ValueError(
+                        f"page {j}: quantized stream frame is missing "
+                        f"its scale sidecars")
+                want_s = (meta["n_kv"], meta["page"])
+                for a in list(pg["ks"]) + list(pg["vs"]):
+                    if tuple(np.shape(a)) != want_s:
+                        raise ValueError(
+                            f"page {j} scale shape {np.shape(a)} != "
+                            f"{want_s}")
+                if len(pg["ks"]) != meta["n_layers"] \
+                        or len(pg["vs"]) != meta["n_layers"]:
+                    raise ValueError(f"page {j} scale layer count mismatch")
+            elif "ks" in pg or "vs" in pg:
+                raise ValueError(
+                    f"page {j}: scale sidecars on a full-precision stream")
         except ValueError:
             self._proto = prev
             raise
@@ -196,6 +247,17 @@ class KvReceiver:
                              f"page size {page}")
         if len(state.k_pages) != meta["n_layers"]:
             raise ValueError("layer count mismatch")
+        quant = state.k_scales is not None
+        if bool(meta.get("quantized")) != quant:
+            kind = ["full-precision", "quantized"]
+            raise ValueError(
+                f"pool precision mismatch: sender "
+                f"{kind[bool(meta.get('quantized'))]}, receiver "
+                f"{kind[quant]}")
+        pool_dt = str(np.asarray(state.k_pages[0]).dtype)
+        if str(meta["dtype"]) != pool_dt:
+            raise ValueError(f"sender pool dtype {meta['dtype']} != "
+                             f"receiver pool dtype {pool_dt}")
         # the remaining control preconditions + the acquire run the full
         # machine commit on the snapshot; the real pool then replays the
         # acquire and MUST hand out the machine's exact ids
@@ -208,6 +270,8 @@ class KvReceiver:
         try:
             idx = jnp.asarray(ids, jnp.int32)
             k_pages, v_pages = list(state.k_pages), list(state.v_pages)
+            k_scales = list(state.k_scales) if quant else None
+            v_scales = list(state.v_scales) if quant else None
             for li in range(meta["n_layers"]):
                 k_stack = np.stack([st["pages"][j]["k"][li]
                                     for j in range(n)])
@@ -218,9 +282,23 @@ class KvReceiver:
                     jnp.asarray(k_stack, dt))
                 v_pages[li] = v_pages[li].at[idx].set(
                     jnp.asarray(v_stack, dt))
+                if quant:
+                    # the scale sidecar lands in the SAME try block as its
+                    # page: any failure releases every acquired id, so a
+                    # page can never be resident without its scales
+                    ks_stack = np.stack([st["pages"][j]["ks"][li]
+                                         for j in range(n)])
+                    vs_stack = np.stack([st["pages"][j]["vs"][li]
+                                         for j in range(n)])
+                    sdt = k_scales[li].dtype
+                    k_scales[li] = k_scales[li].at[idx].set(
+                        jnp.asarray(ks_stack, sdt))
+                    v_scales[li] = v_scales[li].at[idx].set(
+                        jnp.asarray(vs_stack, sdt))
             state = PagedState(tuple(k_pages), tuple(v_pages),
                                state.page_table, state.lengths,
-                               state.k_scales, state.v_scales)
+                               tuple(k_scales) if quant else None,
+                               tuple(v_scales) if quant else None)
             table = _write_table_row(state, slot, idx)
             lengths = state.lengths.at[slot].set(int(meta["length"]))
             state = PagedState(state.k_pages, state.v_pages, table,

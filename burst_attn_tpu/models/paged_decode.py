@@ -44,8 +44,24 @@ from jax.sharding import PartitionSpec as P
 
 from .transformer import ModelConfig, _attn_out, _mlp, _qkv_proj, _rms_norm
 from .decode import _flash_prompt_attention, sample_logits
-from ..ops.paged_attention import paged_decode_attention, quantize_tokens
+from ..ops.paged_attention import (
+    QUANT_DTYPES, paged_decode_attention, quantize_tokens,
+)
 from ..utils.compat import shard_map
+
+
+def resolve_pool_dtype(quantize, default):
+    """(pool storage dtype, canonical tag) for an init_paged_state-style
+    `quantize` knob: False -> (default, None); True / "int8" -> int8;
+    "fp8" -> float8_e4m3fn.  The tag is the string every downstream
+    surface keys on (obs labels, checkpoint meta, kvplane wire meta)."""
+    if not quantize:
+        return default, None
+    name = "int8" if quantize is True else str(quantize)
+    if name not in QUANT_DTYPES:
+        raise ValueError(f"quantize must be False, True, or one of "
+                         f"{sorted(QUANT_DTYPES)}; got {quantize!r}")
+    return QUANT_DTYPES[name][0], name
 
 
 def _check_tp_mesh(cfg: ModelConfig, mesh):
@@ -125,8 +141,12 @@ def _paged_attention_dispatch(qg, kp, vp, ks, vs, table, lengths,
 
 class PagedState(NamedTuple):
     """Device-side paged cache (one pool per layer, table shared).
-    Quantized serving (init_paged_state(quantize=True)): pools are int8
-    with per-token dequant scales — half the bf16 pool memory."""
+    Quantized serving (init_paged_state(quantize=True | "int8" | "fp8")):
+    pools store 1 B/elem (int8 or fp8 e4m3fn) with per-token fp32 dequant
+    scales beside the pages — half the bf16 pool memory, a quarter of
+    fp32.  The scale banks are pool state exactly like the page bytes:
+    CoW copies, checkpoints, and KV-plane shipments carry both or
+    neither."""
     k_pages: Tuple[jax.Array, ...]  # each [P, Nkv, page, D]
     v_pages: Tuple[jax.Array, ...]
     page_table: jax.Array           # [slots, max_pages_per_seq] int32
@@ -155,12 +175,18 @@ class PagePool:
     directly).
     """
 
-    def __init__(self, n_pages: int):
+    def __init__(self, n_pages: int, dtype: Optional[str] = None):
         # page 0 is RESERVED as the write sink for empty batch slots: the
         # jitted decode step must scatter *something* per slot (static
         # shapes), and routing dead slots' writes to a page no sequence can
         # own keeps live pages clobber-free without per-slot predication.
         self.n_pages = n_pages
+        # the STORAGE dtype tag of the pools this allocator fronts:
+        # None = full precision, "int8"/"fp8" = 1 B pages + scale banks.
+        # Pure metadata here (the allocator never touches device memory),
+        # but it is the single tag obs gauges label by, checkpoints pin,
+        # and the KV plane asserts agreement on before landing pages.
+        self.dtype = dtype
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
         self._refs = [0] * n_pages
 
@@ -251,14 +277,25 @@ class PrefixCache:
         self._nkids: "dict[bytes, int]" = {}
 
     @staticmethod
-    def chain(tokens, page: int) -> List[bytes]:
+    def chain(tokens, page: int, dtype: Optional[str] = None) -> List[bytes]:
         """Rolling hash per FULL page of `tokens` (1-D int array): entry i
-        identifies the whole prefix tokens[:(i+1)*page]."""
+        identifies the whole prefix tokens[:(i+1)*page].
+
+        `dtype` is the pool's STORAGE dtype tag (PagePool.dtype) and is
+        folded into the seed of the chain, making each entry a stable
+        content key for the QUANTIZED page bytes: within one pool dtype
+        the quantized representation is a deterministic function of the
+        token prefix (quantize_tokens is pure), so two prompts share an
+        entry iff their pages hold identical quantized bytes — and an
+        entry minted against an int8 pool can never alias one minted
+        against fp8 or full precision (the requantization hazard across
+        checkpoint restores into a differently-typed pool).  dtype=None
+        (full precision) keeps the pre-quantization chain byte-identical."""
         import hashlib
 
         toks = np.asarray(tokens, np.int32)
         out: List[bytes] = []
-        h = b""
+        h = b"" if dtype is None else f"pool:{dtype}".encode()
         for i in range(len(toks) // page):
             h = hashlib.sha1(h + toks[i * page:(i + 1) * page].tobytes()
                              ).digest()
@@ -440,26 +477,28 @@ def _suffix_attention_dispatch(q, k, v, t_pre, q_hi, kv_hi, cfg, mesh):
 
 def init_paged_state(cfg: ModelConfig, *, slots: int, n_pages: int,
                      page: int = 128, max_pages_per_seq: int = 64,
-                     quantize: bool = False) -> Tuple[PagedState, PagePool]:
+                     quantize=False) -> Tuple[PagedState, PagePool]:
     """Fresh pool + allocator.  `page` must be a multiple of 128 (TPU lane
     tile); total pool capacity is n_pages * page tokens shared by all
-    slots.  `quantize`: INT8 pools with per-token dequant scales."""
+    slots.  `quantize`: False = full-precision pools; True or "int8" =
+    int8 pools; "fp8" = float8_e4m3fn pools — quantized pools store
+    per-token fp32 dequant scales beside the pages."""
     if page % 128:
         raise ValueError(f"page size {page} must be a multiple of 128")
     shape = (n_pages, cfg.n_kv_heads, page, cfg.d_head)
-    dt = jnp.int8 if quantize else cfg.dtype
+    dt, tag = resolve_pool_dtype(quantize, cfg.dtype)
     k_pages = tuple(jnp.zeros(shape, dt) for _ in range(cfg.n_layers))
     v_pages = tuple(jnp.zeros(shape, dt) for _ in range(cfg.n_layers))
     table = jnp.zeros((slots, max_pages_per_seq), jnp.int32)
     lengths = jnp.zeros((slots,), jnp.int32)
     ks = vs = None
-    if quantize:
+    if tag is not None:
         ks = tuple(jnp.ones(shape[:3], jnp.float32)
                    for _ in range(cfg.n_layers))
         vs = tuple(jnp.ones(shape[:3], jnp.float32)
                    for _ in range(cfg.n_layers))
     return (PagedState(k_pages, v_pages, table, lengths, ks, vs),
-            PagePool(n_pages))
+            PagePool(n_pages, dtype=tag))
 
 
 def _gather_dequant_pages(pages, scales, idx, n_kv, d_head):
@@ -477,8 +516,11 @@ def _gather_dequant_pages(pages, scales, idx, n_kv, d_head):
 def _scatter_pages(pages, new, page_ids, scales=None):
     """Write [1, Nkv, T, D] rope'd K/V into pool pages `page_ids` (device
     scatter; T padded to a whole number of pages by the caller).  With
-    int8 pools pass the matching `scales` array: the chunks quantize
-    per token and both arrays scatter; returns (pages, scales)."""
+    quantized pools pass the matching `scales` array: the chunks quantize
+    per token into the pool's own dtype (int8 / fp8) and both arrays
+    scatter TOGETHER in the same jitted program; returns (pages, scales).
+    The page-and-scale atomicity here is what pool-quant-safe lint-proves
+    on a live engine."""
     page = pages.shape[2]
     n = new.shape[2] // page
     # [n, Nkv, page, D] chunks in page order
@@ -487,7 +529,7 @@ def _scatter_pages(pages, new, page_ids, scales=None):
     chunks = jnp.moveaxis(chunks, 2, 1)
     if scales is None:
         return pages.at[page_ids].set(chunks.astype(pages.dtype)), None
-    q8, s = quantize_tokens(chunks)
+    q8, s = quantize_tokens(chunks, dtype=pages.dtype)
     return (pages.at[page_ids].set(q8),
             scales.at[page_ids].set(s))
 
@@ -525,7 +567,7 @@ def paged_prefill(params, tokens, state: PagedState, pool: PagePool,
             f"slot {slot} is still live (len {int(state.lengths[slot])}); "
             "retire_slot first or its pages leak")
     if cache is not None:
-        hashes = PrefixCache.chain(tokens, page)
+        hashes = PrefixCache.chain(tokens, page, dtype=pool.dtype)
         # always leave >= 1 suffix token: the caller needs last-token logits
         hits = cache.lookup(hashes[: (t - 1) // page])
         if hits:
@@ -741,8 +783,8 @@ def paged_decode_step(params, tokens, state: PagedState, cfg: ModelConfig,
         k_row, v_row = k[:, :, 0], v[:, :, 0]
         ks = vs = None
         if quant:
-            k8, k_s = quantize_tokens(k_row)
-            v8, v_s = quantize_tokens(v_row)
+            k8, k_s = quantize_tokens(k_row, dtype=kp.dtype)
+            v8, v_s = quantize_tokens(v_row, dtype=vp.dtype)
             kp = kp.at[page_id, :, offset].set(k8)
             vp = vp.at[page_id, :, offset].set(v8)
             ks = state.k_scales[li].at[page_id, :, offset].set(k_s)
@@ -818,8 +860,8 @@ def paged_multi_step(params, tokens, state: PagedState, cfg: ModelConfig):
         v_rows = jnp.moveaxis(v, 1, 2)
         ks = vs = None
         if quant:
-            k8, k_s = quantize_tokens(k_rows)
-            v8, v_s = quantize_tokens(v_rows)
+            k8, k_s = quantize_tokens(k_rows, dtype=kp.dtype)
+            v8, v_s = quantize_tokens(v_rows, dtype=vp.dtype)
             kp = kp.at[pids, :, offs].set(k8)
             vp = vp.at[pids, :, offs].set(v8)
             ks = state.k_scales[li].at[pids, :, offs].set(k_s)

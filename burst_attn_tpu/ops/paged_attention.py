@@ -87,8 +87,9 @@ def _decode_kernel(
         if quant:
             # per-token dequant folds into a column rescale of the scores:
             # q . (k_t * s_t) = (q . k_t) * s_t.  The matmuls run in bf16
-            # (int8 casts exactly — |v| <= 127); int8 buys MEMORY, not MXU
-            # throughput here.  One [G, page] multiply on the VPU.
+            # (int8 casts exactly — |v| <= 127 — and fp8 e4m3's 4-bit
+            # mantissa embeds in bf16's 8); quantization buys MEMORY, not
+            # MXU throughput here.  One [G, page] multiply on the VPU.
             s = s * ks_ref[0]  # [1, page] broadcast over [G, page]
         # mask the final partial page's tail and (sliding window) the
         # positions below the window's lower edge
@@ -124,15 +125,41 @@ def _decode_kernel(
         o_ref[0, 0, :, :] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
-def quantize_tokens(x):
-    """Per-token symmetric int8 quantization of [..., T, D] K/V rows:
-    returns (int8 values, f32 scales [..., T]).  scale = max|x| / 127 per
-    token; zero rows get scale 1 (they dequantize to exact zeros)."""
+# 1 B/elem pool storage dtypes and the full-range absmax each scale maps
+# onto: int8 rounds into [-127, 127]; fp8 e4m3fn casts into +-448 (the
+# format's largest finite).  Both dequantize as a per-token column rescale
+# inside the kernels, so they share every downstream code path.
+QUANT_DTYPES = {
+    "int8": (jnp.int8, 127.0),
+    "fp8": (jnp.float8_e4m3fn, 448.0),
+}
+
+
+def _quant_range(dtype):
+    """(canonical name, full-scale range) for a 1 B pool dtype."""
+    dt = jnp.dtype(dtype)
+    for name, (cand, rng) in QUANT_DTYPES.items():
+        if dt == jnp.dtype(cand):
+            return name, rng
+    raise ValueError(f"unsupported quantized pool dtype {dt!r} "
+                     f"(one of {sorted(QUANT_DTYPES)})")
+
+
+def quantize_tokens(x, dtype=jnp.int8):
+    """Per-token symmetric quantization of [..., T, D] K/V rows into a
+    1 B/elem pool dtype: returns (quantized values, f32 scales [..., T]).
+    scale = max|x| / range per token (127 for int8, 448 for fp8 e4m3fn);
+    zero rows get scale 1 (they dequantize to exact zeros).  int8 rounds
+    and clips; fp8 casts directly (the cast IS the rounding)."""
+    name, rng = _quant_range(dtype)
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-    s = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q8 = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
-                  -127, 127).astype(jnp.int8)
-    return q8, s
+    s = jnp.where(amax > 0, amax / rng, 1.0)
+    xs = x.astype(jnp.float32) / s[..., None]
+    if name == "int8":
+        q = jnp.clip(jnp.round(xs), -rng, rng).astype(jnp.int8)
+    else:
+        q = xs.astype(jnp.float8_e4m3fn)
+    return q, s
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
@@ -152,9 +179,11 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
                                 `window` positions — pages fully below the
                                 band are skipped, so cost ∝ window
     k_scales / v_scales  [P, Nkv, page] f32: per-token dequant scales for
-                INT8 pools (quantize_tokens) — both or neither.  The
-                dequant rides the matmuls as column rescales; pool memory
-                halves vs bf16 (int8 + 4B scale per 128·2B token).
+                QUANTIZED pools (quantize_tokens: int8 or fp8 e4m3fn —
+                the kernel is dtype-agnostic, both cast exactly to bf16)
+                — both or neither.  The dequant rides the matmuls as
+                column rescales; pool memory halves vs bf16 (1 B + 4 B
+                scale per 128·2B token), quarters vs fp32.
 
     Returns [B, Nkv, G, D] attention output in q's dtype.
     """
@@ -236,7 +265,8 @@ def paged_decode_reference(q, k_pages, v_pages, page_table, lengths,
                            k_scales=None, v_scales=None):
     """jnp oracle for the kernel: gathers each sequence's pages into a
     contiguous cache and runs dense masked attention.  O(B·S·page) memory —
-    tests only.  int8 pools dequantize with the per-token scales first."""
+    tests only.  Quantized pools (int8/fp8) dequantize with the per-token
+    scales first."""
     if k_scales is not None:
         k_pages = k_pages.astype(jnp.float32) * k_scales[..., None]
         v_pages = v_pages.astype(jnp.float32) * v_scales[..., None]

@@ -96,6 +96,9 @@ def _ragged_kernel(
         q = q_ref[0, 0, :, :] * (scale * LOG2E)
         k_tile = k_ref[0, :, :]
         if quant:
+            # int8 and fp8 e4m3 both embed EXACTLY in bf16 (8-bit mantissa
+            # covers int8's 8 bits and e4m3's 4-bit mantissa); the fp32
+            # column rescale below is the whole dequant for either dtype
             k_tile = k_tile.astype(jnp.bfloat16)
         s = jax.lax.dot_general(
             q, k_tile, (((1,), (1,)), ((), ())),
@@ -239,7 +242,10 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, q_lens, kv_lens,
                                 1 = decode; >1 = prefill chunk)
     kv_lens    [S] int32        total live tokens INCLUDING this launch's
     window     static int       sliding-window band per query position
-    k_scales / v_scales         per-token dequant scales for int8 pools
+    k_scales / v_scales         per-token fp32 dequant scales for 1 B/elem
+                                (int8 or fp8-e4m3) pools; either quantized
+                                dtype rides the same bf16-embed + column
+                                rescale, so the kernel never branches on it
     block_q    static int       query tokens per grid block
 
     ctx_lo / emit_partials are the split-k hooks the grouped shared-prefix

@@ -61,6 +61,24 @@ class KvSlotLive(ProtocolError, RuntimeError):
 # ack/credit circular wait.
 PAGE_CREDIT_WINDOW = None
 
+# Pair contract for natively quantized pools (int8/fp8 storage with
+# per-token fp32 scale columns): one kv_page frame carries the page AND
+# its scale sidecar, staged and committed as a unit — kvplane.add_page
+# rejects a frame missing its sidecars whole, and commit scatters both
+# under one release-on-failure block.  The proto-transfer-atomic
+# mutation flips this to False (frames carry the page half only) and the
+# quantized transfer model's pair-landing invariant fires.
+SCALE_PAIRED = True
+
+
+def pair_members(j: int) -> Tuple[Tuple[str, int], ...]:
+    """The staging units one quantized kv_page frame carries: the page
+    column and the scale sidecar ride the SAME frame, so they can only
+    land (or abort, or die) together."""
+    if SCALE_PAIRED:
+        return (("page", int(j)), ("scale", int(j)))
+    return (("page", int(j)),)
+
 
 def sender_plan(n_pages: int) -> Tuple[Tuple[str, int], ...]:
     """The exact (op, seq) frame sequence one transfer ships, in order.

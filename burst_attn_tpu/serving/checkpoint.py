@@ -331,15 +331,37 @@ def _paged_arrays(state) -> Dict[str, np.ndarray]:
     return arrays
 
 
-def _paged_from_arrays(arrays: Dict[str, np.ndarray], n_layers: int):
+def _pool_array(a: np.ndarray, page_dtype: Optional[str]):
+    """One saved page bank back to a jnp array.  np.load hands ml_dtypes
+    extension dtypes (bfloat16 pools, fp8 pools) back as raw void bytes
+    (`|V1`/`|V2`) — the bytes are exact, only the dtype name is lost —
+    so a void bank is re-viewed through the page dtype name recorded in
+    the snapshot's pool meta."""
     import jax.numpy as jnp
 
+    if a.dtype.kind == "V":
+        if not page_dtype:
+            raise ValueError(
+                f"snapshot page bank has an opaque dtype ({a.dtype.str}) "
+                f"and the snapshot records no page dtype to re-view it "
+                f"through")
+        a = a.view(np.dtype(page_dtype))
+    return jnp.asarray(a)
+
+
+def _paged_from_arrays(arrays: Dict[str, np.ndarray], n_layers: int,
+                       pool_meta: Optional[dict] = None):
     from ..models.paged_decode import PagedState
 
+    import jax.numpy as jnp
+
+    pd = (pool_meta or {}).get("page_dtype")
     quant = "k_scales_0" in arrays
     return PagedState(
-        tuple(jnp.asarray(arrays[f"k_pages_{li}"]) for li in range(n_layers)),
-        tuple(jnp.asarray(arrays[f"v_pages_{li}"]) for li in range(n_layers)),
+        tuple(_pool_array(arrays[f"k_pages_{li}"], pd)
+              for li in range(n_layers)),
+        tuple(_pool_array(arrays[f"v_pages_{li}"], pd)
+              for li in range(n_layers)),
         jnp.asarray(arrays["page_table"]),
         jnp.asarray(arrays["lengths"]),
         tuple(jnp.asarray(arrays[f"k_scales_{li}"])
@@ -349,16 +371,29 @@ def _paged_from_arrays(arrays: Dict[str, np.ndarray], n_layers: int):
     )
 
 
-def _pool_meta(pool) -> dict:
-    return {"n_pages": int(pool.n_pages),
+def _pool_meta(pool, state=None) -> dict:
+    meta = {"n_pages": int(pool.n_pages),
+            "dtype": pool.dtype,
             "free": [int(p) for p in pool._free],
             "refs": [int(r) for r in pool._refs]}
+    if state is not None:
+        # the element dtype of the page banks, by NAME: np.load strips
+        # ml_dtypes extension dtypes (bfloat16, fp8) to raw void bytes,
+        # so restore re-views the banks through this
+        meta["page_dtype"] = str(state.k_pages[0].dtype)
+    return meta
 
 
 def _pool_restore(pool, meta: dict) -> None:
     if int(meta["n_pages"]) != int(pool.n_pages):
         raise ValueError(f"snapshot pool has {meta['n_pages']} pages, "
                          f"engine pool has {pool.n_pages}")
+    # dtype agreement: restoring a quantized snapshot into a pool of a
+    # different storage dtype would reinterpret page bytes (old
+    # snapshots carry no tag — treated as full-precision, i.e. None)
+    if meta.get("dtype") != pool.dtype:
+        raise ValueError(f"snapshot pool dtype {meta.get('dtype')!r} != "
+                         f"engine pool dtype {pool.dtype!r}")
     pool._free = [int(p) for p in meta["free"]]
     pool._refs = [int(r) for r in meta["refs"]]
 
@@ -366,7 +401,7 @@ def _pool_restore(pool, meta: dict) -> None:
 def _new_pool(meta: dict):
     from ..models.paged_decode import PagePool
 
-    pool = PagePool(int(meta["n_pages"]))
+    pool = PagePool(int(meta["n_pages"]), dtype=meta.get("dtype"))
     _pool_restore(pool, meta)
     return pool
 
@@ -456,7 +491,7 @@ def snapshot(engine, extra: Optional[dict] = None) -> Tuple[dict, dict]:
         "n_layers": len(engine.state.k_pages),
         "slots_n": len(engine.slots),
         "page": int(engine.page),
-        "pool": _pool_meta(engine.pool),
+        "pool": _pool_meta(engine.pool, engine.state),
         "slots": [None if r is None else _req_to_dict(r, kind)
                   for r in engine.slots],
         "queue": [_req_to_dict(r, kind) for r in engine._queue],
@@ -513,8 +548,9 @@ def restore_into(engine, snap: dict) -> dict:
     if tuple(want) != have:
         raise ValueError(f"snapshot pool geometry {tuple(want)} != engine "
                          f"pool geometry {have}")
-    engine.state = _paged_from_arrays(snap["arrays"], meta["n_layers"])
     _pool_restore(engine.pool, meta["pool"])
+    engine.state = _paged_from_arrays(snap["arrays"], meta["n_layers"],
+                                      meta["pool"])
     engine.slots = [None if d is None else _req_from_dict(d, kind)
                     for d in meta["slots"]]
     engine._queue = [_req_from_dict(d, kind) for d in meta["queue"]]
@@ -555,7 +591,8 @@ def save_paged_snapshot(path: str, state, pool,
     """Snapshot a bare PagedState + PagePool (the ring->pages handoff
     decode loop runs without an engine object).  Same atomic format."""
     meta = {"version": SNAPSHOT_VERSION, "kind": "paged",
-            "n_layers": len(state.k_pages), "pool": _pool_meta(pool),
+            "n_layers": len(state.k_pages),
+            "pool": _pool_meta(pool, state),
             "extra": extra or {}}
     _atomic_savez(path, meta, _paged_arrays(state))
     M_SNAPSHOT_SAVES.inc()
@@ -570,7 +607,8 @@ def load_paged_snapshot(path: str):
     if meta["kind"] != "paged":
         raise ValueError(f"{path!r} is a {meta['kind']!r} snapshot, not a "
                          "bare paged snapshot")
-    state = _paged_from_arrays(snap["arrays"], meta["n_layers"])
+    state = _paged_from_arrays(snap["arrays"], meta["n_layers"],
+                               meta["pool"])
     return state, _new_pool(meta["pool"]), meta.get("extra", {})
 
 

@@ -69,7 +69,8 @@ _M_TOKENS = obs.counter("serve.tokens_generated")
 _M_QUEUE = obs.gauge("serve.queue_depth")
 _M_LIVE = obs.gauge("serve.live_slots")
 _M_POOL = obs.gauge("serve.page_pool_occupancy",
-                    "fraction of usable pool pages currently held")
+                    "fraction of usable pool pages currently held; also "
+                    "published per pool storage dtype under a {dtype} label")
 _M_SPEC_RATE = obs.gauge("serve.spec_acceptance_rate")
 _M_TTFT = obs.histogram("serve.ttft_s")
 _M_TOK_LAT = obs.histogram("serve.token_latency_s")
@@ -104,6 +105,11 @@ _M_POOL_LOG = obs.gauge(
     "serve.page_pool_occupancy_logical",
     "sum of page refcounts over usable pages — may exceed 1.0; the gap to "
     "the physical gauge is the pages saved by prefix sharing")
+_M_POOL_BYTES = obs.gauge(
+    "serve.page_pool_bytes",
+    "HBM bytes physically held by in-use KV pages (k + v + scale banks "
+    "across all layers), by pool storage {dtype} — a quantized pool holds "
+    "~4x the sequences in the same byte budget")
 
 from ..models.decode import sample_logits
 from ..models.paged_decode import (
@@ -174,10 +180,22 @@ class RaggedServeEngine:
         # BEFORE results are returned — crash recovery resumes from here
         self.journal = journal
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # quantize: False keeps the pool at cfg.dtype; True/"int8" or "fp8"
+        # makes that 1 B/elem dtype the pool's NATIVE storage (per-page
+        # scale banks ride beside the pages; resolve_pool_dtype validates)
         self.state, self.pool = init_paged_state(
             cfg, slots=slots, n_pages=n_pages, page=page,
             max_pages_per_seq=max_pages_per_seq, quantize=quantize)
         self.quantize = quantize
+        # obs label + per-page HBM cost for serve.page_pool_bytes: the
+        # pool's storage dtype tag ("int8"/"fp8", else the full-precision
+        # jnp dtype name) and bytes per held page across k/v/scale banks
+        self._pool_dtype = (self.pool.dtype or
+                            jnp.dtype(self.state.k_pages[0].dtype).name)
+        banks = list(self.state.k_pages) + list(self.state.v_pages)
+        if self.state.k_scales is not None:
+            banks += list(self.state.k_scales) + list(self.state.v_scales)
+        self._page_nbytes = sum(a.nbytes // a.shape[0] for a in banks)
         # None: probe per launch width; True/False force a path
         self.use_ragged = use_ragged
         self._attn_cache: Dict[int, str] = {}
@@ -239,9 +257,12 @@ class RaggedServeEngine:
         gap is pages saved by sharing)."""
         occ = self._occupancy()
         _M_POOL.set(occ)
+        _M_POOL.set(occ, dtype=self._pool_dtype)
         _M_POOL_PHYS.set(occ)
         usable = self.pool.n_pages - 1
         _M_POOL_LOG.set(self.pool.logical_refs / usable if usable else 0.0)
+        held = usable - self.pool.available if usable else 0
+        _M_POOL_BYTES.set(held * self._page_nbytes, dtype=self._pool_dtype)
 
     def submit(self, tokens, max_new_tokens: int) -> int:
         """Queue a prompt; returns a request id.  Raises InvalidRequest
@@ -396,7 +417,8 @@ class RaggedServeEngine:
         serialization must not see it)."""
         h = getattr(req, "_prefix_hashes", None)
         if h is None:
-            h = PrefixCache.chain(req.prompt, self.page)
+            h = PrefixCache.chain(req.prompt, self.page,
+                                  dtype=self.pool.dtype)
             req._prefix_hashes = h
         return h
 

@@ -123,8 +123,8 @@ def ragged_model_step(params, tokens, q_lens, state: PagedState,
         v_rows = jnp.moveaxis(v, 1, 2)
         ks = vs = None
         if quant:
-            k8, k_s = quantize_tokens(k_rows)
-            v8, v_s = quantize_tokens(v_rows)
+            k8, k_s = quantize_tokens(k_rows, dtype=kp.dtype)
+            v8, v_s = quantize_tokens(v_rows, dtype=vp.dtype)
             kp = kp.at[pids, :, offs].set(k8)
             vp = vp.at[pids, :, offs].set(v8)
             ks = state.k_scales[li].at[pids, :, offs].set(k_s)
@@ -187,9 +187,11 @@ def assign_pages(state: PagedState, slot: int, ids) -> PagedState:
 @partial(jax.jit, donate_argnums=(0,))
 def _copy_pages_jit(state: PagedState, src, dst):
     """Device-side page duplication for copy-on-write: every layer's K/V
-    (and int8 scales) at pages src[i] is copied to pages dst[i].  src/dst
-    are traced int32 [n] — one program per copy width, and CoW events copy
-    one page at a time, so exactly one program in practice."""
+    (and, on quantized pools, the per-token dequant scales) at pages
+    src[i] is copied to pages dst[i] in ONE program — a privatized page
+    column is never separated from its scale column.  src/dst are traced
+    int32 [n] — one program per copy width, and CoW events copy one page
+    at a time, so exactly one program in practice."""
     k_pages = tuple(kp.at[dst].set(kp[src]) for kp in state.k_pages)
     v_pages = tuple(vp.at[dst].set(vp[src]) for vp in state.v_pages)
     k_scales = v_scales = None

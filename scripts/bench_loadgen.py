@@ -24,6 +24,15 @@ headline records in results/:
                                   (skipped + absorbed-on == absorbed-off),
                                   and token-exactness vs the UNCACHED
                                   oracle
+  headline_loadgen_quant.json     serve.quantized_pool_capacity resident
+                                  requests (direction: higher) — peak
+                                  concurrent residents an int8-NATIVE pool
+                                  holds at the fp32 pool's exact KV byte
+                                  budget (scale sidecars counted; fp8 shares
+                                  the footprint), equal-HBM closed-loop A/B;
+                                  emitted only if both pools serve the
+                                  prompt set token-identically and the
+                                  quantized pool strictly beats fp32
   headline_loadgen_recovery.json  serve.load_recovery_p99 seconds
                                   (direction: lower) — p99 fault-to-last-
                                   recovered-completion span from a 2-worker
@@ -215,6 +224,64 @@ def main(argv=None) -> int:
     slo["shared_ttft_p99_on_s"] = shared_ttft_p99
     slo["shared_ttft_p99_off_s"] = float(s_off["p99"])
     slo["shared_prefill_tokens_skipped"] = int(s_on["skipped"])
+
+    # ---- quantized-pool capacity phase (ISSUE 17): equal-HBM A/B.
+    # Same KV byte budget (measured from the live banks' nbytes, scale
+    # sidecars included), fp32-native vs int8-native pool, closed-loop
+    # one-page requests: the quantized pool holds strictly more
+    # concurrent resident requests.  The headline only lands if BOTH
+    # pools serve the shared prompt set token-identically — a capacity
+    # win from a wrong-answer pool is no win.  int8 carries the A/B
+    # because its noise floor keeps argmax ties intact on this toy
+    # model; fp8 has the IDENTICAL byte footprint (1 B/elem + the same
+    # fp32 scale columns), so the capacity number transfers verbatim —
+    # fp8 numeric parity is pinned separately in tests/test_pool_quant.py.
+    import numpy as np
+
+    qrng = np.random.default_rng(args.seed + 3)
+    qprompts = [[int(t) for t in qrng.integers(1, 97, 120)]
+                for _ in range(24)]
+
+    def _pool_hbm(eng):
+        banks = list(eng.state.k_pages) + list(eng.state.v_pages)
+        if eng.state.k_scales is not None:
+            banks += list(eng.state.k_scales) + list(eng.state.v_scales)
+        return sum(int(np.asarray(a).nbytes) for a in banks)
+
+    def _capacity_run(quantize, n_pages):
+        eng = build_engine(model_spec,
+                           dict(engine_spec, slots=24, n_pages=n_pages,
+                                max_pages_per_seq=2, chunk=64,
+                                max_queue=None, admission=None,
+                                quantize=quantize))
+        hbm = _pool_hbm(eng)
+        rids = [eng.submit(p, 6) for p in qprompts]
+        peak, steps = 0, 0
+        while eng.live or eng.pending:
+            eng.step()
+            peak = max(peak, eng.live)
+            steps += 1
+            assert steps < 10_000
+        res = eng.results()
+        return hbm, peak, [res[r] for r in rids]
+
+    n_pages_fp32 = 5  # 4 usable data pages (page 0 is the null page)
+    hbm_fp32, peak_fp32, toks_fp32 = _capacity_run(False, n_pages_fp32)
+    per_page_q = _pool_hbm(build_engine(
+        model_spec, dict(engine_spec, slots=2, n_pages=1,
+                         max_pages_per_seq=2, quantize="int8")))
+    n_pages_q = int(hbm_fp32 // per_page_q)
+    hbm_q, peak_q, toks_q = _capacity_run("int8", n_pages_q)
+    assert hbm_q <= hbm_fp32, (hbm_q, hbm_fp32)
+    assert toks_q == toks_fp32, (
+        "quantized pool is not token-exact vs fp32 on the capacity "
+        "trace — refusing to emit serve.quantized_pool_capacity")
+    assert peak_q > peak_fp32, (
+        f"equal-HBM quantized pool held no more residents than fp32: "
+        f"{peak_q} vs {peak_fp32}")
+    slo["quant_pool_hbm_bytes"] = int(hbm_fp32)
+    slo["quant_pool_peak_residents_fp32"] = int(peak_fp32)
+    slo["quant_pool_peak_residents_int8"] = int(peak_q)
     platform = jax.devices()[0].platform
 
     os.makedirs(args.out, exist_ok=True)
@@ -248,6 +315,17 @@ def main(argv=None) -> int:
                     f"{s_off['p99']:.6f}s in-run; skipped "
                     f"{int(s_on['skipped'])} prefill tokens; token-exact "
                     "vs uncached oracle)"}),
+        ("headline_loadgen_quant.json", {
+            "metric": "serve.quantized_pool_capacity resident requests @ "
+                      f"equal KV HBM ({hbm_fp32} B) int8 vs fp32 {platform}",
+            "value": int(peak_q), "unit": "requests",
+            "direction": "higher", "timestamp": time.time(),
+            "note": "bench_loadgen.py equal-HBM A/B — peak concurrent "
+                    "resident requests on an int8-native pool at the fp32 "
+                    f"pool's KV byte budget (fp32 held {int(peak_fp32)}; "
+                    f"{n_pages_q} vs {n_pages_fp32} pages, scale sidecars "
+                    "counted; token-exact across both pools; fp8 shares "
+                    "the byte footprint)"}),
         ("headline_loadgen_recovery.json", {
             "metric": "serve.load_recovery_p99 s @ trace "
                       f"seed={args.seed + 1} kill w0 2 workers {platform}",

@@ -20,10 +20,15 @@ is injected on every seed and must be tolerated.
 CACHE serialization (ISSUE 13): a cache-enabled engine runs a shared-
 prefix workload (common template + private suffixes, including an
 exact-template prompt whose full-prompt hit forces a copy-on-write) and
-is killed at the two most state-entangled moments — MID-CoW-COPY
+is killed at the most state-entangled moments — MID-CoW-COPY
 (inside cow_pages: replacement page acquired, shared ref not yet
-dropped) and MID-SHARED-ADMISSION (prefix pages pinned by lookup, not
-yet assigned to the slot).  Recovery restores the snapshot (pool
+dropped), MID-SHARED-ADMISSION (prefix pages pinned by lookup, not
+yet assigned to the slot), and MID-SCALE-SCATTER (an fp8-native pool
+killed during the quantized scatter launch: the (page, scale) pair
+lands atomically inside one jitted tick, so the crashed pool must hold
+both halves of every pair or neither, and recovery — judged against a
+quantized uncached oracle — must come back token-exact with the fp32
+scale banks intact).  Recovery restores the snapshot (pool
 refcounts + hash-chain index + slot->shared-pages map) and must deliver
 token-exact streams vs an UNCACHED uninterrupted oracle, after which
 `verify_pool_integrity` recounts every page's expected refcount from
@@ -68,6 +73,12 @@ KILL_POINTS = {
     # kill after the prefix-cache hit pinned pages (refcounts bumped,
     # slot not yet wired) — the checker's cache-hit admission step
     "mid-admission": "admit B (cache hit: share + acquire 1)",
+    # kill DURING a quantized scatter launch (fp8 pool): the (page,
+    # scale) pair lands inside one jitted tick, so a kill mid-launch
+    # must leave the pool with both halves of every pair or neither —
+    # the write half of the checker's append step.  Recovery must be
+    # token-exact against a quantized oracle, scale banks intact.
+    "mid-scale-scatter": "append B (CoW barrier + write)",
 }
 
 
@@ -252,17 +263,27 @@ def run_cache_seed(seed: int, n_requests: int, out_dir: str) -> dict:
             assert n < 10_000
         return n
 
-    # oracle: UNCACHED uninterrupted run — the exactness bar
+    # oracles: UNCACHED uninterrupted runs — the exactness bar.  The
+    # mid-scale-scatter mode runs an fp8-native pool, so its bar is the
+    # quantized-pool oracle (same numerics, no cache, no kill).
     eng = build_engine(CACHE_MODEL_SPEC, CACHE_ENGINE_SPEC)
     submit_all(eng)
     oracle = {}
     n_total_steps = drive(eng, oracle)
+    quant_spec = dict(CACHE_ENGINE_SPEC, quantize="fp8")
+    eng = build_engine(CACHE_MODEL_SPEC, quant_spec)
+    submit_all(eng)
+    oracle_q = {}
+    drive(eng, oracle_q)
 
     results = {}
     for mode in checker_kill_modes():
+        quant = mode == "mid-scale-scatter"
+        mode_spec = dict(cached_spec, quantize="fp8") if quant else cached_spec
+        want = oracle_q if quant else oracle
         snap_step = 1
         journal = ckpt.TokenJournal(jour, truncate=True)
-        eng = build_engine(CACHE_MODEL_SPEC, cached_spec, journal=journal)
+        eng = build_engine(CACHE_MODEL_SPEC, mode_spec, journal=journal)
         submit_all(eng, journal=journal)
         rid_map = {i: i + 100 for i in range(len(prompts))}
         delivered = {}
@@ -281,6 +302,23 @@ def run_cache_seed(seed: int, n_requests: int, out_dir: str) -> dict:
 
             serve_model._copy_pages_jit = killing_copy
             undo = lambda: setattr(serve_model, "_copy_pages_jit", real_copy)
+        elif mode == "mid-scale-scatter":
+            # kill the engine during a quantized scatter launch: the tick
+            # dies before its returned state replaces the live one, so
+            # the pool must be left holding complete (page, scale) pairs
+            # from the PREVIOUS tick — never a page without its scale
+            from burst_attn_tpu.serving import engine as eng_mod
+
+            real_step = eng_mod.ragged_model_step
+
+            def killing_step(*a, **k):
+                if armed["live"] and not armed["fired"]:
+                    armed["fired"] = True
+                    raise SimKill("mid-scale-scatter")
+                return real_step(*a, **k)
+
+            eng_mod.ragged_model_step = killing_step
+            undo = lambda: setattr(eng_mod, "ragged_model_step", real_step)
         else:
             # kill right after PrefixCache.lookup pinned pages (refcounts
             # bumped) but before assign_pages wires them into the slot
@@ -316,15 +354,22 @@ def run_cache_seed(seed: int, n_requests: int, out_dir: str) -> dict:
         with open(jour, "ab") as f:
             f.write(b'{"kind": "tokens", "rid": 0')  # torn tail
 
-        eng = build_engine(CACHE_MODEL_SPEC, cached_spec)
+        eng = build_engine(CACHE_MODEL_SPEC, mode_spec)
         info = ckpt.recover_engine(eng, snap, jour)
         assert info.n_skipped == 1, info.n_skipped
         verify_pool_integrity(eng)  # restored refcounts internally exact
+        if quant:
+            # scales intact: the restored pool is still fp8-native and
+            # every quantized bank came back with its fp32 scale bank
+            assert eng.pool.dtype == "fp8", eng.pool.dtype
+            assert eng.state.k_scales is not None
+            assert eng.state.k_pages[0].dtype.itemsize == 1
+            assert str(eng.state.k_scales[0].dtype) == "float32"
         eng.journal = ckpt.rewrite_journal(eng, jour2, info.rid_map,
                                            info.resume_prefix)
         out = dict(delivered)
         out.update(ckpt.run_recovered(eng, info))
-        exact = out == oracle
+        exact = out == want
         # drain-down: after every request retires, only the cache holds
         # pages; a full evict must empty the pool with no stragglers
         verify_pool_integrity(eng)
@@ -337,7 +382,7 @@ def run_cache_seed(seed: int, n_requests: int, out_dir: str) -> dict:
         print(f"  cache seed={seed} {mode:>14}: {status} killed={killed} "
               f"exact={exact} leak_free={leak_free}")
         if not exact:
-            print(f"    oracle: {oracle}\n    got:    {out}")
+            print(f"    oracle: {want}\n    got:    {out}")
     return results
 
 
@@ -456,7 +501,8 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=4)
     ap.add_argument("--cache-seeds", type=int, default=2,
                     help="prefix-cache kill-point seeds (mid-CoW-copy + "
-                         "mid-shared-admission per seed); 0 disables")
+                         "mid-shared-admission + mid-scale-scatter on an "
+                         "fp8-native pool, per seed); 0 disables")
     ap.add_argument("--transport-seeds", type=int, default=0,
                     help="also fuzz the fleet frame transport for N seeds "
                          "(truncate / bit-flip / duplicate mutations)")
@@ -498,8 +544,9 @@ def main(argv=None) -> int:
         parts.append(f"{args.seeds} seeds x 2 recovery paths token-exact, "
                      "recomputation bounded by journal lag")
     if args.cache_seeds:
-        parts.append(f"{args.cache_seeds} cache seeds x 2 kill points "
-                     "(mid-CoW, mid-admission) token-exact, zero "
+        parts.append(f"{args.cache_seeds} cache seeds x 3 kill points "
+                     "(mid-CoW, mid-admission, mid-scale-scatter) "
+                     "token-exact, zero "
                      "leaked/double-freed pages")
     if args.transport_seeds:
         parts.append(f"{args.transport_seeds} transport seeds clean "

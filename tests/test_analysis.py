@@ -1012,6 +1012,68 @@ def test_poolcheck_refcount_leak_fires(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# pool-quant-safe drives the SAME sharing schedule on an fp8-native pool
+# (ISSUE 17) and proves (page, scale) pair atomicity at both seams: the
+# CoW copy and the jitted scatter.  Each mutation below splits exactly one
+# seam; the clean run rides check_all via test_clean_run_on_real_package.
+
+
+def test_pool_quant_rule_registered():
+    from burst_attn_tpu.analysis import poolcheck  # noqa: F401
+
+    assert "pool-quant-safe" in RULES
+    assert RULES["pool-quant-safe"].kind == "jaxpr"
+    path, line = poolcheck._quant_anchor()
+    assert path.endswith("model.py") and line > 0
+
+
+def test_pool_quant_cow_scale_split_fires(monkeypatch):
+    """A CoW copy that privatizes the K/V page columns but NOT the scale
+    columns leaves the private page dequantizing with a stranger's (or
+    the init) scales — silent corruption the pair-copy check must see."""
+    from burst_attn_tpu.analysis import poolcheck
+    from burst_attn_tpu.serving import model as serve_model
+
+    def split_copy(state, src, dst):
+        k_pages = tuple(kp.at[dst].set(kp[src]) for kp in state.k_pages)
+        v_pages = tuple(vp.at[dst].set(vp[src]) for vp in state.v_pages)
+        return state._replace(k_pages=k_pages, v_pages=v_pages)
+
+    monkeypatch.setattr(serve_model, "_copy_pages_jit", split_copy)
+    findings = poolcheck._check_quant()
+    assert _rules_of(findings) == {"pool-quant-safe"}
+    assert any("pair split" in f.message and "not carried" in f.message
+               for f in findings), [f.format() for f in findings]
+    assert findings[0].file.endswith("model.py")
+
+
+def test_pool_quant_scatter_scale_split_fires(monkeypatch):
+    """A scatter that lands the quantized page bytes but never updates
+    the scale columns produces a pair that LOOKS self-consistent yet
+    dequantizes up to the quant range away from the true K/V — only the
+    ground-truth recomputation can see it."""
+    from burst_attn_tpu.analysis import poolcheck
+    from burst_attn_tpu.serving import engine as eng_mod
+
+    real = eng_mod.ragged_model_step
+
+    def split_step(params, toks, q_lens, state, cfg, **kw):
+        out = real(params, toks, q_lens, state, cfg, **kw)
+        ns = out[1]
+        if ns.k_scales is not None:
+            ns = ns._replace(
+                k_scales=tuple(jnp.ones_like(s) for s in ns.k_scales),
+                v_scales=tuple(jnp.ones_like(s) for s in ns.v_scales))
+        return (out[0], ns) + tuple(out[2:])
+
+    monkeypatch.setattr(eng_mod, "ragged_model_step", split_step)
+    findings = poolcheck._check_quant()
+    assert _rules_of(findings) == {"pool-quant-safe"}
+    assert any("scatter landed the page without its scale" in f.message
+               for f in findings), [f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
 # proto-* model-checked protocol rules (ISSUE 15): burstcheck BFS-explores
 # every interleaving of the protocol machines (crash injected at every
 # step).  The machines below are the SAME module-level functions production
@@ -1140,6 +1202,22 @@ def test_proto_credit_window_deadlock_fires(monkeypatch):
     assert _rules_of(findings) == {"proto-no-deadlock"}
     msg = findings[0].message
     assert "deadlock" in msg and "counterexample" in msg
+
+
+def test_proto_transfer_scale_pair_split_fires(monkeypatch):
+    """SCALE_PAIRED mutated off: quantized kv_page frames carry the page
+    half only, so scale sidecars stop mirroring the staged page set —
+    the quantized transfer model's pair invariant must fire while the
+    full-precision model stays clean."""
+    from burst_attn_tpu.analysis import protocheck
+    from burst_attn_tpu.protocols import kvtransfer as kvp
+
+    monkeypatch.setattr(kvp, "SCALE_PAIRED", False)
+    findings = protocheck.check_all()
+    assert _rules_of(findings) == {"proto-transfer-atomic"}
+    msg = findings[0].message
+    assert "staging split" in msg and "counterexample" in msg
+    assert findings[0].file.endswith("kvplane.py")
 
 
 # ---------------------------------------------------------------------------
@@ -1413,8 +1491,9 @@ def test_tuning_table_sound_fires_on_fwd_bwd_inversion():
 
 
 def test_cost_json_cli_pinned_schema(capsys):
-    """--cost-json prints the burstcost-v1 table: the machine-readable
-    matrix the autotuner prunes on and fleet/sim.py prices with.  Grow
+    """--cost-json prints the burstcost-v2 table: the machine-readable
+    matrix the autotuner prunes on and fleet/sim.py prices with.  v2
+    adds `ragged_hbm` — per-pool-dtype decode bandwidth pricing.  Grow
     the schema additively or change these asserts with intent."""
     import json
 
@@ -1422,9 +1501,9 @@ def test_cost_json_cli_pinned_schema(capsys):
 
     assert main(["--cost-json"]) == 0
     d = json.loads(capsys.readouterr().out)
-    assert d["schema"] == "burstcost-v1"
+    assert d["schema"] == "burstcost-v2"
     assert set(d) == {"schema", "world", "shape", "hw", "n_rows", "rows",
-                      "ragged"}
+                      "ragged", "ragged_hbm"}
     assert d["world"] == 8
     assert set(d["shape"]) == {"b", "n", "n_kv", "s", "d"}
     # 5 generations (4 named + default) x 3 topologies x 3 wires x 2 passes
@@ -1443,5 +1522,17 @@ def test_cost_json_cli_pinned_schema(capsys):
     assert d["ragged"]
     for row in d["ragged"]:
         assert row["fits"] is True, row
+    # v2: per-pool-dtype decode HBM pricing — 2 d_heads x 3 pool dtypes,
+    # and the 1 B/elem pools must show the analytic bandwidth win
+    assert len(d["ragged_hbm"]) == 6
+    hbm_keys = {"d_head", "n_kv", "kv_len", "pool_dtype", "kv_elem_bytes",
+                "hbm_bytes", "win_vs_fp32"}
+    for row in d["ragged_hbm"]:
+        assert set(row) == hbm_keys
+        assert row["pool_dtype"] in {"fp32", "int8", "fp8"}
+        if row["pool_dtype"] == "fp32":
+            assert row["win_vs_fp32"] == 1.0
+        else:
+            assert row["win_vs_fp32"] > 2.0, row
     for spec in d["hw"].values():
         assert set(spec) == {"peak_flops", "hbm_bw", "ici_bw"}

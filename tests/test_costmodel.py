@@ -335,7 +335,7 @@ def test_ring_overlap_pred_fields_on_smoke_row(tmp_path):
 
 def test_cost_table_covers_matrix_and_fits():
     t = cm.cost_table()
-    assert t["schema"] == "burstcost-v1"
+    assert t["schema"] == "burstcost-v2"
     combos = {(r["generation"], r["topology"], r["wire"], r["pass"])
               for r in t["rows"]}
     expected = {(g, topo, w, p) for g in tuning.generations()

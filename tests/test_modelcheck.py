@@ -127,7 +127,8 @@ def test_event_vocabulary_names_fuzz_kill_points():
             "scripts", "fuzz_checkpoint.py"))
     fuzz = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(fuzz)
-    assert fuzz.checker_kill_modes() == ("mid-cow", "mid-admission")
+    assert fuzz.checker_kill_modes() == (
+        "mid-cow", "mid-admission", "mid-scale-scatter")
     vocab = mc.event_vocabulary(mc.pool_model())
     for label in fuzz.KILL_POINTS.values():
         assert label in vocab

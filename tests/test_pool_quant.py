@@ -1,0 +1,391 @@
+"""Natively quantized paged pool (ISSUE 17): fp8/int8 as the PagePool's
+storage dtype with per-token fp32 scale columns, end to end.
+
+The contract under test:
+
+  parity        int8/fp8 pools change only the RESIDENT BYTES, never the
+                served stream: greedy tokens match the fp32-pool engine
+                across plain ragged, grouped shared-prefix, windowed,
+                and CoW-write schedules, and one-launch logits stay
+                within the pinned tolerances below.
+  bit-identity  quantize=False is the pre-PR pool: no scale banks, and
+                launch outputs bit-identical to a state built without
+                ever mentioning quantize (quant off => zero drift).
+  transport     kvplane ships 1 B/elem pages WITH their scale sidecars:
+                the wire roundtrip is byte/digest-exact through both
+                codecs, a frame missing its sidecars is rejected at
+                staging, and a cross-precision commit is refused with
+                zero pool mutation.
+  durability    quantized snapshots restore token-exact with scales
+                intact (the fp8 banks survive np.load's void-dtype
+                laundering) and ship fewer bytes than full precision.
+
+Tolerances are pinned from measured CPU maxima at ~4-8x headroom
+(int8 prefill-launch max|dlogits| 0.00097, fp8 0.0054 on this model) —
+loosening one is a numerics regression, not a flake.  The full
+scenario x dtype matrices are slow-marked; each keeps a fast canary.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from burst_attn_tpu.fleet import (KvReceiver, export_slot_pages, page_bytes,
+                                  page_digest)
+from burst_attn_tpu.fleet import transport as tp
+from burst_attn_tpu.loadgen.worker import build_engine
+from burst_attn_tpu.models import ModelConfig, init_params
+from burst_attn_tpu.models.paged_decode import (PagedState, PagePool,
+                                                init_paged_state)
+from burst_attn_tpu.ops.paged_attention import QUANT_DTYPES, quantize_tokens
+from burst_attn_tpu.serving import RaggedServeEngine
+from burst_attn_tpu.serving import checkpoint as ckpt
+from burst_attn_tpu.serving.model import assign_pages, ragged_model_step
+
+# pinned one-launch logits deltas vs the fp32 pool (see module docstring)
+TOL_LOGITS = {"int8": 0.008, "fp8": 0.04}
+
+MODEL_SPEC = dict(vocab=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                  d_head=16, d_ff=128, block_q=8, block_kv=8, seed=0)
+ENGINE_SPEC = dict(slots=2, n_pages=12, page=128, max_pages_per_seq=4,
+                   chunk=4)
+
+
+@pytest.fixture(scope="module")
+def model():
+    ms = dict(MODEL_SPEC)
+    seed = ms.pop("seed")
+    cfg = ModelConfig(attn_backend="jnp", remat=False, dtype=jnp.float32,
+                      batch_axis=None, head_axis=None, **ms)
+    return cfg, init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _prompts(cfg, lengths, seed=11):
+    rng = np.random.default_rng(seed)
+    return [np.asarray(rng.integers(1, cfg.vocab, size=n), np.int32)
+            for n in lengths]
+
+
+def _serve(cfg, params, prompts, steps, *, quantize, waves=1, **over):
+    eng = RaggedServeEngine(params, cfg,
+                            **{**ENGINE_SPEC, **over, "quantize": quantize})
+    out = []
+    for _ in range(waves):
+        rids = [eng.submit(p, s) for p, s in zip(prompts, steps)]
+        res = eng.run()
+        out.append([res[r] for r in rids])
+    return out, eng
+
+
+# ---------------------------------------------------------------------------
+# engine token parity — fast canary + slow scenario x dtype matrix
+
+
+def test_pool_parity_canary(model):
+    """Fast-lane canary of the slow matrix: plain ragged schedule, int8
+    pool, tokens identical to the fp32 pool (quantization noise is far
+    below this model's logit margins)."""
+    cfg, params = model
+    prompts = _prompts(cfg, [9, 5, 13, 3])
+    steps = [5, 4, 6, 3]
+    (base,), _ = _serve(cfg, params, prompts, steps, quantize=False)
+    (got,), eng = _serve(cfg, params, prompts, steps, quantize="int8")
+    assert got == base
+    assert eng.pool.dtype == "int8"
+    assert eng.state.k_scales is not None
+
+
+def _scenario(cfg, name):
+    """(cfg, prompts, steps, engine overrides, waves) per schedule."""
+    if name == "plain":
+        return cfg, _prompts(cfg, [9, 5, 13, 3]), [5, 4, 6, 3], {}, 1
+    if name == "windowed":
+        wcfg = dataclasses.replace(cfg, window=96)
+        return (wcfg, _prompts(cfg, [40, 25, 13], seed=13), [6, 5, 4],
+                {}, 1)
+    # shared-prefix schedules: one exactly-page template; wave 2 admits
+    # concurrent partial hits plus the full-prompt hit whose re-absorbed
+    # last token is the organic CoW write into a shared page
+    rng = np.random.default_rng(0x17)
+    tmpl = rng.integers(1, cfg.vocab, size=128)
+    if name == "grouped":
+        prompts = [np.concatenate([tmpl, rng.integers(1, cfg.vocab, size=7)]),
+                   np.concatenate([tmpl, rng.integers(1, cfg.vocab, size=11)])]
+        return (cfg, [p.astype(np.int32) for p in prompts], [4, 4],
+                dict(prefix_cache=True, group_attn=True, chunk=128), 2)
+    if name == "cow":
+        prompts = [np.concatenate([tmpl, rng.integers(1, cfg.vocab, size=7)]),
+                   tmpl.copy()]
+        return (cfg, [p.astype(np.int32) for p in prompts], [4, 4],
+                dict(prefix_cache=True, chunk=128), 2)
+    raise ValueError(name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dtype", ["int8", "fp8"])
+@pytest.mark.parametrize("scenario", ["plain", "windowed", "grouped", "cow"])
+def test_pool_parity_matrix(model, scenario, dtype):
+    """Every schedule the engine can dispatch — plain ragged, windowed,
+    grouped shared-prefix, CoW privatization — serves the fp32 pool's
+    exact tokens from a 1 B/elem pool."""
+    cfg, params = model
+    scfg, prompts, steps, over, waves = _scenario(cfg, scenario)
+    sparams = params if scfg is cfg else init_params(
+        jax.random.PRNGKey(MODEL_SPEC["seed"]), scfg)
+    base, _ = _serve(scfg, sparams, prompts, steps, quantize=False,
+                     waves=waves, **over)
+    got, _ = _serve(scfg, sparams, prompts, steps, quantize=dtype,
+                    waves=waves, **over)
+    assert got == base, (scenario, dtype)
+
+
+# ---------------------------------------------------------------------------
+# pinned one-launch logits parity + the fp32 bit-parity rider
+
+
+def _prefill_logits(cfg, params, prompt, quantize):
+    st, pool = init_paged_state(cfg, slots=2, n_pages=8, page=128,
+                                max_pages_per_seq=3, quantize=quantize)
+    st = assign_pages(st, 0, pool.acquire(1))
+    toks = np.zeros((2, len(prompt)), np.int32)
+    toks[0] = prompt
+    lg, st = ragged_model_step(
+        params, jnp.asarray(toks),
+        jnp.asarray([len(prompt), 0], np.int32), st, cfg)
+    return np.asarray(lg)[0], st
+
+
+@pytest.mark.parametrize("dtype", ["int8", "fp8"])
+def test_launch_logits_parity_pinned(model, dtype):
+    """One prefill launch, quantized pool vs fp32 pool: max|dlogits|
+    within the pinned tolerance (measured maxima in module docstring)."""
+    cfg, params = model
+    prompt = _prompts(cfg, [20], seed=7)[0]
+    base, _ = _prefill_logits(cfg, params, prompt, False)
+    got, st = _prefill_logits(cfg, params, prompt, dtype)
+    err = float(np.abs(got - base).max())
+    assert err < TOL_LOGITS[dtype], (dtype, err)
+    # the pool really stores 1 B/elem + fp32 scale columns
+    jdt, _rng = QUANT_DTYPES[dtype]
+    assert st.k_pages[0].dtype == jdt and st.k_pages[0].dtype.itemsize == 1
+    assert st.k_scales[0].dtype == jnp.float32
+    assert tuple(st.k_scales[0].shape) == tuple(st.k_pages[0].shape[:3])
+
+
+def test_fp32_pool_bit_parity_rider(model):
+    """quantize=False is the pre-PR program: no scale banks anywhere,
+    and the launch logits are BIT-identical to a state built without
+    ever mentioning quantize — quant off means zero numeric drift."""
+    cfg, params = model
+    prompt = _prompts(cfg, [17], seed=9)[0]
+    st_legacy, _ = init_paged_state(cfg, slots=2, n_pages=8, page=128,
+                                    max_pages_per_seq=3)
+    assert st_legacy.k_scales is None
+    st_off, pool_off = init_paged_state(cfg, slots=2, n_pages=8, page=128,
+                                        max_pages_per_seq=3, quantize=False)
+    assert st_off.k_scales is None and pool_off.dtype is None
+    base, _ = _prefill_logits(cfg, params, prompt, False)
+    got, _ = _prefill_logits(cfg, params, prompt, False)
+    assert np.array_equal(base, got)
+    # and the full-precision banks keep the model dtype (no silent cast)
+    assert st_off.k_pages[0].dtype == st_legacy.k_pages[0].dtype
+
+
+# ---------------------------------------------------------------------------
+# kvplane wire roundtrip: 1 B/elem pages + scale sidecars, byte-exact
+
+
+def _raw_quant_state(dtype, *, n_layers=2, n_kv=1, page=128, d_head=8,
+                     n_pool=4, slots=2, max_pages=4, seed=0):
+    """A quantized pool filled with random (page, scale) pairs, no model
+    required — the KV plane moves bytes, not activations."""
+    rng = np.random.default_rng(seed)
+    jdt, _ = QUANT_DTYPES[dtype]
+    k, v, ks, vs = [], [], [], []
+    for _ in range(n_layers):
+        rows_k = rng.standard_normal((n_pool, n_kv, page, d_head))
+        rows_v = rng.standard_normal((n_pool, n_kv, page, d_head))
+        kq, s1 = quantize_tokens(jnp.asarray(rows_k, jnp.float32), dtype=jdt)
+        vq, s2 = quantize_tokens(jnp.asarray(rows_v, jnp.float32), dtype=jdt)
+        k.append(kq)
+        v.append(vq)
+        ks.append(s1)
+        vs.append(s2)
+    table = jnp.zeros((slots, max_pages), jnp.int32)
+    lengths = jnp.zeros((slots,), jnp.int32)
+    return (PagedState(tuple(k), tuple(v), table, lengths,
+                       tuple(ks), tuple(vs)),
+            PagePool(n_pool, dtype=dtype))
+
+
+@pytest.mark.parametrize("dtype", ["fp8", "int8"])
+def test_kvplane_wire_roundtrip_quantized(dtype):
+    """export -> real wire frames (both codecs) -> stage -> commit: the
+    receiving pool's (page, scale) pairs byte/digest-match the sender's,
+    whatever physical ids each side assigned."""
+    src, src_pool = _raw_quant_state(dtype, seed=1)
+    ids = src_pool.acquire(2)
+    src = src._replace(
+        page_table=src.page_table.at[0, :2].set(jnp.asarray(ids)),
+        lengths=src.lengths.at[0].set(256))
+    meta, pages = export_slot_pages(src, 0)
+    assert meta["quantized"] is True
+    for pg in pages:
+        assert "ks" in pg and "vs" in pg
+        assert pg["ks"][0].dtype == np.float32
+
+    recv = KvReceiver()
+    for force_json in (False, True):
+        frame = tp.pack_frame(tp.encode_message(
+            {"op": "kv_begin", "rid": 7, "meta": meta},
+            force_json=force_json))
+        m = tp.decode_message(tp.unpack_frame(frame))
+        recv.begin(m["rid"], m["meta"])
+        for j, pg in enumerate(pages):
+            frame = tp.pack_frame(tp.encode_message(
+                {"op": "kv_page", "rid": 7, "j": j, "pg": pg},
+                force_json=force_json))
+            m = tp.decode_message(tp.unpack_frame(frame))
+            recv.add_page(m["rid"], m["j"], m["pg"])
+    assert recv.complete(7)
+
+    dst, dst_pool = _raw_quant_state(dtype, n_pool=8, seed=2)
+    avail0 = dst_pool.available
+    dst = recv.commit(7, dst, dst_pool, 1)
+    assert dst_pool.available == avail0 - 2
+    assert int(dst.lengths[1]) == 256 and recv.staging_count() == 0
+    meta2, pages2 = export_slot_pages(dst, 1)
+    assert meta2["quantized"] is True
+    for a, b in zip(pages, pages2):
+        assert page_bytes(a) == page_bytes(b)        # covers scales too
+        assert page_digest(a) == page_digest(b)
+
+
+def test_kvplane_sidecar_missing_rejected():
+    """A quantized transfer whose kv_page frame lost its scale sidecars
+    must be rejected AT STAGING (never half-staged) — the (page, scale)
+    pair ships as one unit or not at all."""
+    src, src_pool = _raw_quant_state("fp8", seed=3)
+    ids = src_pool.acquire(1)
+    src = src._replace(
+        page_table=src.page_table.at[0, :1].set(jnp.asarray(ids)),
+        lengths=src.lengths.at[0].set(128))
+    meta, pages = export_slot_pages(src, 0)
+    stripped = {k: v for k, v in pages[0].items() if k not in ("ks", "vs")}
+    recv = KvReceiver()
+    recv.begin(1, meta)
+    with pytest.raises(ValueError, match="scale"):
+        recv.add_page(1, 0, stripped)
+    assert not recv.complete(1)  # nothing half-staged
+
+
+def test_kvplane_cross_precision_commit_refused():
+    """A quantized transfer landing on a full-precision pool (or the
+    reverse) is refused by commit preconditions BEFORE any page is
+    acquired — zero pool mutation."""
+    src, src_pool = _raw_quant_state("fp8", seed=4)
+    ids = src_pool.acquire(1)
+    src = src._replace(
+        page_table=src.page_table.at[0, :1].set(jnp.asarray(ids)),
+        lengths=src.lengths.at[0].set(128))
+    meta, pages = export_slot_pages(src, 0)
+    recv = KvReceiver()
+    recv.begin(1, meta)
+    for j, pg in enumerate(pages):
+        recv.add_page(1, j, pg)
+
+    # full-precision receiver state, same geometry
+    rng = np.random.default_rng(5)
+    shape = (8, 1, 128, 8)
+    full = PagedState(
+        tuple(jnp.asarray(rng.standard_normal(shape), jnp.float32)
+              for _ in range(2)),
+        tuple(jnp.asarray(rng.standard_normal(shape), jnp.float32)
+              for _ in range(2)),
+        jnp.zeros((2, 4), jnp.int32), jnp.zeros((2,), jnp.int32),
+        None, None)
+    full_pool = PagePool(8)
+    avail0 = full_pool.available
+    with pytest.raises(ValueError, match="precision mismatch"):
+        recv.commit(1, full, full_pool, 0)
+    assert full_pool.available == avail0  # not one page acquired
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: quantized snapshots restore token-exact, scales intact
+
+
+def test_checkpoint_roundtrip_quantized_token_exact(tmp_path):
+    """Mid-flight fp8 snapshot -> fresh fp8 engine -> bit-identical
+    remaining streams: the 1 B/elem banks survive np.load's void-dtype
+    laundering and the scale banks ride along."""
+    path = str(tmp_path / "snap_fp8.npz")
+    spec = dict(ENGINE_SPEC, quantize="fp8")
+    eng = build_engine(MODEL_SPEC, spec)
+    prompts = _prompts(type("C", (), {"vocab": MODEL_SPEC["vocab"]}),
+                       [9, 5, 13], seed=21)
+    rids = [eng.try_submit(list(map(int, p)), 6).rid for p in prompts]
+    for _ in range(3):
+        eng.step()
+    ckpt.save_snapshot(eng, path)
+    expect = eng.run()
+
+    eng2 = build_engine(MODEL_SPEC, spec)
+    ckpt.restore_into(eng2, ckpt.load_snapshot(path))
+    assert eng2.pool.dtype == "fp8"
+    assert eng2.state.k_scales is not None
+    assert eng2.state.k_pages[0].dtype == QUANT_DTYPES["fp8"][0]
+    assert eng2.run() == expect
+    assert {r in expect for r in rids} == {True}
+
+
+@pytest.mark.slow
+def test_checkpoint_roundtrip_int8_token_exact(tmp_path):
+    path = str(tmp_path / "snap_int8.npz")
+    spec = dict(ENGINE_SPEC, quantize="int8")
+    eng = build_engine(MODEL_SPEC, spec)
+    prompts = _prompts(type("C", (), {"vocab": MODEL_SPEC["vocab"]}),
+                       [9, 5], seed=22)
+    for p in prompts:
+        eng.try_submit(list(map(int, p)), 5)
+    for _ in range(2):
+        eng.step()
+    ckpt.save_snapshot(eng, path)
+    expect = eng.run()
+    eng2 = build_engine(MODEL_SPEC, spec)
+    ckpt.restore_into(eng2, ckpt.load_snapshot(path))
+    assert eng2.pool.dtype == "int8"
+    assert eng2.run() == expect
+
+
+def test_checkpoint_quantized_snapshot_smaller(tmp_path):
+    """The byte win survives serialization: an fp8 engine's snapshot is
+    strictly smaller than the full-precision engine's (toy d_head keeps
+    the ratio modest; realistic d_head approaches 4x)."""
+    import os
+
+    sizes = {}
+    for q in (False, "fp8"):
+        eng = build_engine(MODEL_SPEC, dict(ENGINE_SPEC, quantize=q))
+        eng.try_submit([1, 2, 3, 4], 4)
+        eng.run()
+        path = str(tmp_path / f"snap_{q}.npz")
+        ckpt.save_snapshot(eng, path)
+        sizes[q] = os.path.getsize(path)
+    assert sizes["fp8"] < sizes[False], sizes
+
+
+def test_checkpoint_cross_dtype_restore_refused(tmp_path):
+    """A quantized snapshot must never silently land in a pool of a
+    different storage dtype — refuse loudly at restore."""
+    path = str(tmp_path / "snap.npz")
+    eng = build_engine(MODEL_SPEC, dict(ENGINE_SPEC, quantize="fp8"))
+    eng.try_submit([1, 2, 3], 3)
+    eng.run()
+    ckpt.save_snapshot(eng, path)
+    eng2 = build_engine(MODEL_SPEC, dict(ENGINE_SPEC, quantize="int8"))
+    with pytest.raises(ValueError, match="dtype"):
+        ckpt.restore_into(eng2, ckpt.load_snapshot(path))
