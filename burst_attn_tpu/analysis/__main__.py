@@ -54,8 +54,8 @@ def main(argv=None) -> int:
     if args.list_rules:
         # force registration of the lazy rule families
         from . import (astlint, costcheck, numerics,  # noqa: F401
-                       obscheck, poolcheck, protocheck, ringcheck,
-                       servecheck)
+                       obscheck, policycheck, poolcheck, protocheck,
+                       ringcheck, servecheck)
 
         for name in sorted(RULES):
             r = RULES[name]

@@ -83,6 +83,7 @@ FAMILY_WATCH = {
     "protocheck": ("protocols/", "fleet/", "serving/", "models/",
                    "analysis/"),
     "costcheck": ("ops/", "parallel/", "analysis/"),
+    "policycheck": ("fleet/", "analysis/"),
 }
 
 
@@ -155,13 +156,13 @@ def run_analysis(root=None, *, disable=(), ast_only=False,
         ast_paths = [p for p in ast_paths if os.path.abspath(p) in keep]
     findings += astlint.lint_paths(ast_paths)
     if not ast_only:
-        from . import (costcheck, ringcheck, numerics, obscheck,
-                       poolcheck, protocheck, servecheck)
+        from . import (costcheck, policycheck, ringcheck, numerics,
+                       obscheck, poolcheck, protocheck, servecheck)
 
         families = (("ringcheck", ringcheck), ("numerics", numerics),
                     ("obscheck", obscheck), ("servecheck", servecheck),
                     ("poolcheck", poolcheck), ("protocheck", protocheck),
-                    ("costcheck", costcheck))
+                    ("costcheck", costcheck), ("policycheck", policycheck))
         for name, mod in families:
             if incremental and not _family_touched(name, changed):
                 continue

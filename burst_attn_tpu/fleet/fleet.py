@@ -71,6 +71,7 @@ from ..loadgen.driver import DONE, Outcome, ReplayReport, RetryBackoff
 from ..loadgen.trace import Trace
 from ..protocols import kvtransfer as kv_proto
 from . import kvplane
+from . import policy as fleet_policy
 from .transport import (
     Dedup, QueueTransport, SocketTransport, TransportError, accept, listen,
     send_with_retry,
@@ -706,11 +707,16 @@ class FleetCluster:
                  hb_interval_s: float = 0.5, hb_timeout_s: float = 60.0,
                  autoscale: bool = False, max_decode: Optional[int] = None,
                  min_decode: int = 1, scale_check_interval_s: float = 0.4,
-                 scale_up_after: int = 3, scale_down_after: int = 12):
+                 scale_up_after: int = 3, scale_down_after: int = 12,
+                 router_policy: str = fleet_policy.DEFAULT_ROUTE_POLICY):
         if n_prefill < 1 or n_decode < 1:
             raise ValueError("need >= 1 worker in each pool")
         if transport not in ("queue", "socket"):
             raise ValueError(f"unknown transport {transport!r}")
+        if router_policy not in fleet_policy.ROUTE_POLICY_FUNCS:
+            raise ValueError(
+                f"unknown router_policy {router_policy!r} (one of "
+                f"{sorted(fleet_policy.ROUTE_POLICY_FUNCS)})")
         self.model_spec = dict(model_spec)
         self.prefill_spec = dict(prefill_spec or {})
         self.decode_spec = dict(decode_spec or {})
@@ -730,6 +736,7 @@ class FleetCluster:
         self.scale_check_interval_s = scale_check_interval_s
         self.scale_up_after = scale_up_after
         self.scale_down_after = scale_down_after
+        self.router_policy = router_policy
         self._ctx = mp.get_context("spawn")
         self._m: Dict[Tuple[str, int], dict] = {}  # (role, wid) -> member
         self._alive = {"prefill": set(), "decode": set()}
@@ -1397,22 +1404,17 @@ class FleetCluster:
             if self.autoscale \
                     and now_w - last_scale >= self.scale_check_interval_s:
                 last_scale = now_w
-                free = sum(
-                    int(self._m[("decode", w)]["stats"].get("slots_free", 0))
-                    for w in self._alive["decode"])
-                wait_for_decode = depth + sum(
-                    1 for tf in transfers.values() if tf["decode"] is None)
-                pressure_ticks = pressure_ticks + 1 \
-                    if (wait_for_decode > 0 and free == 0) else 0
-                # capacity = serving replicas + ones still booting: a
-                # scale-up that hasn't reported ready yet must count, or
-                # sustained pressure during its (slow) boot spawns an
-                # unbounded pile of replicas past max_decode
-                n_decode_cap = len(self._alive["decode"]) + sum(
-                    1 for (role, _w) in restarting if role == "decode")
-                if pressure_ticks >= self.scale_up_after \
-                        and n_decode_cap < self.max_decode:
-                    pressure_ticks = 0
+                # capacity inside the policy = serving replicas + ones
+                # still booting: a scale-up that hasn't reported ready
+                # yet must count, or sustained pressure during its
+                # (slow) boot spawns an unbounded pile past max_decode
+                decision, pressure_ticks, idle_ticks = \
+                    self._autoscale_decide(
+                        depth=depth, outstanding=outstanding,
+                        transfers=transfers, restarting=restarting,
+                        pressure_ticks=pressure_ticks,
+                        idle_ticks=idle_ticks)
+                if decision.up:
                     wid = self._next_decode_wid
                     self._next_decode_wid += 1
                     self._spawn("decode", wid)
@@ -1426,31 +1428,19 @@ class FleetCluster:
                     scale_events.append({"t": t, "action": "up",
                                          "worker": wid})
                     M_SCALE_UPS.inc()
-                for wid in sorted(self._alive["decode"]):
-                    st = self._m[("decode", wid)]["stats"]
-                    quiet = (int(st.get("occ", 1)) == 0
-                             and int(st.get("staged", 1)) == 0
-                             and not outstanding.get(wid)
-                             and not any(tf["decode"] == wid
-                                         for tf in transfers.values()))
-                    idle_ticks[wid] = idle_ticks.get(wid, 0) + 1 \
-                        if quiet else 0
-                    if idle_ticks[wid] >= self.scale_down_after \
-                            and len(self._alive["decode"]) \
-                            > self.min_decode and depth == 0:
-                        idle_ticks.pop(wid)
-                        self._alive["decode"].discard(wid)
-                        try:
-                            self._send("decode", wid, ("stop",))
-                        except TransportError:
-                            pass  # already gone; terminate below anyway
-                        self._m[("decode", wid)]["proc"].join(timeout=30)
-                        if self._m[("decode", wid)]["proc"].is_alive():
-                            self._m[("decode", wid)]["proc"].terminate()
-                        scale_events.append({"t": t, "action": "down",
-                                             "worker": wid})
-                        M_SCALE_DOWNS.inc()
-                        break
+                if decision.down is not None:
+                    wid = decision.down
+                    self._alive["decode"].discard(wid)
+                    try:
+                        self._send("decode", wid, ("stop",))
+                    except TransportError:
+                        pass  # already gone; terminate below anyway
+                    self._m[("decode", wid)]["proc"].join(timeout=30)
+                    if self._m[("decode", wid)]["proc"].is_alive():
+                        self._m[("decode", wid)]["proc"].terminate()
+                    scale_events.append({"t": t, "action": "down",
+                                         "worker": wid})
+                    M_SCALE_DOWNS.inc()
             if idle:
                 time.sleep(0.002)
             if time.perf_counter() - t0 > max_wall_s:
@@ -1472,20 +1462,58 @@ class FleetCluster:
             recovered_tokens_replayed=recov["replayed"],
             recovered_tokens_resumed=recov["resumed"])
 
-    def _pick_decode(self) -> Optional[int]:
-        """Load-aware choice: fewest live+staged sequences, preferring
-        replicas that report a free slot (the admission gauges ride every
-        pong/done/admitted message)."""
-        cands = sorted(self._alive["decode"])
-        if not cands:
-            return None
-
-        def score(w):
+    def _decode_view(self, *, slots_free_default: int = 1,
+                     quiet_for=None) -> fleet_policy.FleetView:
+        """Snapshot the decode pool's admission gauges as the concrete
+        `FleetState` the pure policies read.  `quiet_for` (outstanding
+        map + live transfers) switches on the autoscale observation:
+        missing gauges then default BUSY (occ/staged -> 1, slots_free ->
+        0) exactly like the pre-refactor inline block, so a replica that
+        has never ponged can be neither retired nor counted free."""
+        reps = []
+        for w in sorted(self._alive["decode"]):
             st = self._m[("decode", w)]["stats"]
-            return (int(st.get("slots_free", 1)) <= 0,
-                    int(st.get("occ", 0)) + int(st.get("staged", 0)), w)
+            quiet = False
+            if quiet_for is not None:
+                outstanding, transfers = quiet_for
+                quiet = (int(st.get("occ", 1)) == 0
+                         and int(st.get("staged", 1)) == 0
+                         and not outstanding.get(w)
+                         and not any(tf["decode"] == w
+                                     for tf in transfers.values()))
+            reps.append(fleet_policy.ReplicaView(
+                wid=w, occ=int(st.get("occ", 0)),
+                staged=int(st.get("staged", 0)),
+                slots_free=int(st.get("slots_free", slots_free_default)),
+                quiet=quiet))
+        return fleet_policy.FleetView(replicas=tuple(reps))
 
-        return min(cands, key=score)
+    def _pick_decode(self) -> Optional[int]:
+        """Load-aware choice, delegated to the pure routing policy
+        (fleet/policy.py) the simulator executes too — fewest live+staged
+        sequences, preferring replicas that report a free slot (the
+        admission gauges ride every pong/done/admitted message)."""
+        route = getattr(fleet_policy,
+                        fleet_policy.ROUTE_POLICY_FUNCS[self.router_policy])
+        return route(self._decode_view(), None)
+
+    def _autoscale_decide(self, *, depth: int, outstanding, transfers,
+                          restarting, pressure_ticks: int, idle_ticks):
+        """Observation here, decision in fleet/policy.autoscale — the
+        same pure function the simulator sweeps lead times with."""
+        view = self._decode_view(
+            slots_free_default=0, quiet_for=(outstanding, transfers))
+        view = view._replace(
+            queue_depth=depth,
+            wait_for_decode=depth + sum(
+                1 for tf in transfers.values() if tf["decode"] is None),
+            booting=sum(1 for (role, _w) in restarting if role == "decode"))
+        params = fleet_policy.ScaleParams(
+            scale_up_after=self.scale_up_after,
+            scale_down_after=self.scale_down_after,
+            max_decode=self.max_decode, min_decode=self.min_decode)
+        return fleet_policy.autoscale(view, params, pressure_ticks,
+                                      idle_ticks)
 
     def _forward(self, decode_wid: int, frame) -> None:
         try:

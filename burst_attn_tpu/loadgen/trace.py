@@ -46,6 +46,14 @@ REQUEST_KINDS = ("normal", "poison-empty", "poison-budget",
                  "poison-oversize", "shared_prefix")
 POISON_KINDS = tuple(k for k in REQUEST_KINDS if k.startswith("poison"))
 
+# Trace-level synthesis families.  `bursty` is the original Markov-
+# modulated process (synthesize_trace); `diurnal` rides a sinusoidal
+# arrival intensity (synthesize_diurnal_trace); `heavy_tail` draws a
+# Zipf tenant mix over shared-prefix templates
+# (synthesize_heavy_tail_trace).  load_trace rejects unknown kinds the
+# same way it rejects unknown request kinds — a trace is CI input.
+TRACE_KINDS = ("bursty", "diurnal", "heavy_tail")
+
 
 @dataclass(frozen=True)
 class TraceRequest:
@@ -64,6 +72,10 @@ class TraceRequest:
     # is what the serving prefix cache hits on
     template_seed: int = -1
     overlap_len: int = 0
+    # multi-tenant fields (heavy_tail traces; scheduling policies in
+    # fleet/policy.py key on them) — defaults keep legacy traces loading
+    tenant: int = -1
+    priority: int = 0
 
     @property
     def poison(self) -> bool:
@@ -200,6 +212,7 @@ def synthesize_trace(
             template_seed=template_seed, overlap_len=overlap_len))
     meta = {
         "version": TRACE_VERSION, "label": label, "seed": int(seed),
+        "trace_kind": "bursty",
         "vocab": int(vocab), "n_requests": int(n_requests),
         "mean_interarrival_s": mean_interarrival_s,
         "burst_factor": burst_factor, "p_enter_burst": p_enter_burst,
@@ -213,6 +226,207 @@ def synthesize_trace(
         "shared_fraction": shared_fraction, "n_templates": n_templates,
         "template_len": template_len,
         "duration_s": round(t, 6),
+    }
+    return Trace(meta=meta, requests=requests)
+
+
+def _clipped_lognormal(rng, log_mean, log_sigma, lo, hi, n) -> np.ndarray:
+    v = np.rint(rng.lognormal(log_mean, log_sigma, size=n))
+    return np.clip(v, lo, hi).astype(np.int64)
+
+
+def _clipped_geometric(rng, mean, lo, hi, n) -> np.ndarray:
+    return np.clip(rng.geometric(1.0 / mean, size=n), lo, hi).astype(np.int64)
+
+
+def synthesize_diurnal_trace(
+    n_requests: int,
+    *,
+    seed: int,
+    vocab: int,
+    period_s: float = 3600.0,
+    mean_rate: float = 20.0,
+    peak_to_trough: float = 4.0,
+    prompt_len_log_mean: float = 2.5,
+    prompt_len_log_sigma: float = 0.6,
+    prompt_len_min: int = 1,
+    prompt_len_max: int = 64,
+    max_new_mean: float = 12.0,
+    max_new_min: int = 1,
+    max_new_max: int = 48,
+    priority_fraction: float = 0.0,
+    label: str = "diurnal",
+) -> Trace:
+    """Sinusoidal ("diurnal") arrival intensity: a nonhomogeneous
+    Poisson process at rate(t) = mean_rate * (1 + A sin(2pi t/period)),
+    with A chosen so peak rate / trough rate == peak_to_trough — the
+    daily swell autoscaling policies must ride, compressed to whatever
+    `period_s` the simulation budget affords.
+
+    Arrivals come from exact time-rescaling: unit-exponential gaps
+    accumulate to targets on the integrated intensity, inverted on a
+    dense monotone grid (vectorized — a million requests synthesize in
+    seconds).  Every stream draws from its own child generator
+    `default_rng([seed, i])`, so the synthesis is seeded-deterministic
+    and streams never perturb each other.  `priority_fraction` tags that
+    fraction of requests priority 1 (the preemption class)."""
+    if n_requests < 1:
+        raise ValueError(f"n_requests must be >= 1, got {n_requests}")
+    if peak_to_trough < 1.0:
+        raise ValueError(
+            f"peak_to_trough must be >= 1, got {peak_to_trough}")
+    if not 0.0 <= priority_fraction <= 1.0:
+        raise ValueError(
+            f"priority_fraction must be in [0, 1], got {priority_fraction}")
+    n = int(n_requests)
+    amp = (peak_to_trough - 1.0) / (peak_to_trough + 1.0)
+    rng_arrival = np.random.default_rng([seed, 0])
+    rng_len = np.random.default_rng([seed, 1])
+    rng_budget = np.random.default_rng([seed, 2])
+    rng_seed = np.random.default_rng([seed, 3])
+    rng_prio = np.random.default_rng([seed, 4])
+
+    targets = np.cumsum(rng_arrival.exponential(1.0, size=n))
+
+    def big_lambda(t):  # integrated intensity
+        w = 2.0 * np.pi / period_s
+        return mean_rate * t + mean_rate * amp / w * (1.0 - np.cos(w * t))
+
+    t_max = targets[-1] / mean_rate + period_s
+    while big_lambda(t_max) < targets[-1]:
+        t_max *= 2.0
+    grid = np.linspace(0.0, t_max,
+                       max(4096, int(t_max / period_s * 4096)) + 1)
+    arrivals = np.interp(targets, big_lambda(grid), grid)
+
+    prompt_lens = _clipped_lognormal(
+        rng_len, prompt_len_log_mean, prompt_len_log_sigma,
+        prompt_len_min, prompt_len_max, n)
+    budgets = _clipped_geometric(
+        rng_budget, max_new_mean, max_new_min, max_new_max, n)
+    prompt_seeds = rng_seed.integers(0, 2**31 - 1, size=n)
+    priorities = (rng_prio.random(n) < priority_fraction).astype(np.int64)
+
+    requests = [TraceRequest(
+        rid=rid, t_arrival=round(float(arrivals[rid]), 6),
+        prompt_len=int(prompt_lens[rid]),
+        prompt_seed=int(prompt_seeds[rid]),
+        max_new_tokens=int(budgets[rid]),
+        priority=int(priorities[rid])) for rid in range(n)]
+    meta = {
+        "version": TRACE_VERSION, "label": label, "seed": int(seed),
+        "trace_kind": "diurnal",
+        "vocab": int(vocab), "n_requests": n,
+        "period_s": period_s, "mean_rate": mean_rate,
+        "peak_to_trough": peak_to_trough,
+        "prompt_len_log_mean": prompt_len_log_mean,
+        "prompt_len_log_sigma": prompt_len_log_sigma,
+        "prompt_len_min": prompt_len_min, "prompt_len_max": prompt_len_max,
+        "max_new_mean": max_new_mean, "max_new_min": max_new_min,
+        "max_new_max": max_new_max,
+        "priority_fraction": priority_fraction,
+        "duration_s": round(float(arrivals[-1]), 6),
+    }
+    return Trace(meta=meta, requests=requests)
+
+
+def synthesize_heavy_tail_trace(
+    n_requests: int,
+    *,
+    seed: int,
+    vocab: int,
+    n_tenants: int = 64,
+    zipf_a: float = 1.2,
+    mean_interarrival_s: float = 0.05,
+    template_len: int = 256,
+    shared_fraction: float = 1.0,
+    tail_log_mean: float = 2.5,
+    tail_log_sigma: float = 0.6,
+    tail_min: int = 1,
+    tail_max: int = 64,
+    max_new_mean: float = 12.0,
+    max_new_min: int = 1,
+    max_new_max: int = 48,
+    priority_tenants: int = 0,
+    label: str = "heavy_tail",
+) -> Trace:
+    """Zipf tenant mix over shared-prefix templates: tenant k (rank
+    order) arrives with probability proportional to (k+1)^-zipf_a, and
+    every tenant owns ONE seeded template — the head tenants dominate
+    traffic AND share prefixes, which is exactly the workload where the
+    prefix cache's rich-get-richer routing bias fights tenant fairness.
+
+    Arrivals are plain exponential (the tenant mix is the stressor
+    here, not burstiness); `shared_fraction` of each tenant's requests
+    carry its template as a `shared_prefix` overlap, the rest are
+    private (`shared_fraction=0` produces a trace with no
+    shared_prefix requests at all).  The first `priority_tenants`
+    head tenants are tagged priority 1.  Child streams via
+    `default_rng([seed, i])`, same determinism contract as the diurnal
+    kind."""
+    if n_requests < 1:
+        raise ValueError(f"n_requests must be >= 1, got {n_requests}")
+    if n_tenants < 1:
+        raise ValueError(f"n_tenants must be >= 1, got {n_tenants}")
+    if not 0.0 <= shared_fraction <= 1.0:
+        raise ValueError(
+            f"shared_fraction must be in [0, 1], got {shared_fraction}")
+    if template_len < 1:
+        raise ValueError(f"template_len must be >= 1, got {template_len}")
+    n = int(n_requests)
+    rng_arrival = np.random.default_rng([seed, 0])
+    rng_tenant = np.random.default_rng([seed, 1])
+    rng_len = np.random.default_rng([seed, 2])
+    rng_budget = np.random.default_rng([seed, 3])
+    rng_seed = np.random.default_rng([seed, 4])
+    rng_template = np.random.default_rng([seed, 5])
+    rng_shared = np.random.default_rng([seed, 6])
+
+    arrivals = np.cumsum(rng_arrival.exponential(mean_interarrival_s, size=n))
+    weights = (np.arange(1, n_tenants + 1, dtype=np.float64)) ** (-zipf_a)
+    cdf = np.cumsum(weights / weights.sum())
+    tenants = np.searchsorted(cdf, rng_tenant.random(n), side="right")
+    tenants = np.minimum(tenants, n_tenants - 1)
+    tails = _clipped_lognormal(rng_len, tail_log_mean, tail_log_sigma,
+                               tail_min, tail_max, n)
+    budgets = _clipped_geometric(rng_budget, max_new_mean, max_new_min,
+                                 max_new_max, n)
+    prompt_seeds = rng_seed.integers(0, 2**31 - 1, size=n)
+    template_seeds = rng_template.integers(0, 2**31 - 1, size=n_tenants)
+    shared = rng_shared.random(n) < shared_fraction
+
+    requests = []
+    for rid in range(n):
+        tenant = int(tenants[rid])
+        if shared[rid]:
+            kind = "shared_prefix"
+            template_seed = int(template_seeds[tenant])
+            overlap_len = template_len
+            prompt_len = template_len + int(tails[rid])
+        else:
+            kind, template_seed, overlap_len = "normal", -1, 0
+            prompt_len = int(tails[rid])
+        requests.append(TraceRequest(
+            rid=rid, t_arrival=round(float(arrivals[rid]), 6),
+            prompt_len=prompt_len, prompt_seed=int(prompt_seeds[rid]),
+            max_new_tokens=int(budgets[rid]), kind=kind,
+            template_seed=template_seed, overlap_len=overlap_len,
+            tenant=tenant,
+            priority=1 if tenant < priority_tenants else 0))
+    meta = {
+        "version": TRACE_VERSION, "label": label, "seed": int(seed),
+        "trace_kind": "heavy_tail",
+        "vocab": int(vocab), "n_requests": n,
+        "n_tenants": int(n_tenants), "zipf_a": zipf_a,
+        "mean_interarrival_s": mean_interarrival_s,
+        "template_len": int(template_len),
+        "shared_fraction": shared_fraction,
+        "tail_log_mean": tail_log_mean, "tail_log_sigma": tail_log_sigma,
+        "tail_min": tail_min, "tail_max": tail_max,
+        "max_new_mean": max_new_mean, "max_new_min": max_new_min,
+        "max_new_max": max_new_max,
+        "priority_tenants": int(priority_tenants),
+        "duration_s": round(float(arrivals[-1]), 6),
     }
     return Trace(meta=meta, requests=requests)
 
@@ -257,6 +471,10 @@ def load_trace(path: str) -> Trace:
                     raise ValueError(
                         f"{path}:{i}: trace version {rec.get('version')!r} "
                         f"!= supported {TRACE_VERSION}")
+                if rec.get("trace_kind", "bursty") not in TRACE_KINDS:
+                    raise ValueError(
+                        f"{path}:{i}: unknown trace kind "
+                        f"{rec.get('trace_kind')!r} (one of {TRACE_KINDS})")
                 meta = rec
             elif tag == "trace-request":
                 if rec.get("kind", "normal") not in REQUEST_KINDS:

@@ -8,7 +8,7 @@ cd "$(dirname "$0")/.."
 args=("$@")
 filtered=()
 fast=0; tpu=0; fused=0; obs=0; schedule=0; serve=0; loadgen=0; fleet=0
-quant=0
+quant=0; sim=0
 for a in "${args[@]}"; do
   case "$a" in
     --fast) fast=1 ;;
@@ -20,6 +20,7 @@ for a in "${args[@]}"; do
     --loadgen) loadgen=1 ;;
     --fleet) fleet=1 ;;
     --quant) quant=1 ;;
+    --sim) sim=1 ;;
     *) filtered+=("$a") ;;
   esac
 done
@@ -125,6 +126,26 @@ elif [[ $fleet == 1 ]]; then
   python scripts/check_regression.py \
     --headline 'results/headline_fleet_*.json' \
     --strict-cache --summary-json results/fleet_gate.json
+elif [[ $sim == 1 ]]; then
+  # burstsim lane (fleet/sim.py + fleet/policy.py): fast canaries first —
+  # engine determinism (bit-identical event digests), the spy-asserted
+  # FleetCluster->policy delegation, policy bit-identity vs the
+  # pre-refactor inline router, the policy-pure lint mutations, and the
+  # fidelity gate on a toy trace — then the slow-marked 1000-replica /
+  # 1M-request diurnal sweep (<60s wall, digest-pinned) and the real
+  # process-backed --fleet fidelity replay
+  python -m pytest tests/test_fleet_sim.py -q -m "not slow" \
+    ${filtered[@]+"${filtered[@]}"}
+  python -m pytest tests/test_fleet_sim.py -q -m slow \
+    ${filtered[@]+"${filtered[@]}"}
+  # policy-space sweep bench + perf gate: best simulated goodput over
+  # POLICIES becomes serve.sim_policy_goodput (higher); virtual-time and
+  # seeded, so the gate compares real numbers, not scheduler noise.
+  # --strict-cache: this lane must run the bench fresh, never a stale replay.
+  python scripts/bench_fleet_sim.py
+  python scripts/check_regression.py \
+    --headline 'results/headline_sim_*.json' \
+    --strict-cache --summary-json results/sim_gate.json
 elif [[ $schedule == 1 ]]; then
   # focused lane for the ring-schedule IR + compiler (parallel/schedule.py):
   # compiler/oracle unit tests, interpret-mode parity of the bidi and

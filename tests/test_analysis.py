@@ -1536,3 +1536,93 @@ def test_cost_json_cli_pinned_schema(capsys):
             assert row["win_vs_fp32"] > 2.0, row
     for spec in d["hw"].values():
         assert set(spec) == {"peak_flops", "hbm_bw", "ici_bw"}
+
+
+# -- policy-pure (burstlint rule 28, analysis/policycheck.py) ----------------
+
+
+def _policy_src():
+    import os
+
+    import burst_attn_tpu.fleet.policy as pol
+
+    with open(os.path.abspath(pol.__file__), encoding="utf-8") as f:
+        return f.read()
+
+
+def test_policy_pure_rule_registered_at_28_rules():
+    from burst_attn_tpu.analysis import (astlint, costcheck,  # noqa: F401
+                                         numerics, obscheck, policycheck,
+                                         poolcheck, protocheck, ringcheck,
+                                         servecheck)
+
+    assert "policy-pure" in RULES
+    assert RULES["policy-pure"].kind == "ast"
+    assert len(RULES) >= 28
+
+
+def test_policy_pure_clean_on_real_module():
+    from burst_attn_tpu.analysis import policycheck
+
+    assert policycheck.check_all() == []
+    # zero suppressions anywhere in the policy module
+    assert "burstlint:" not in _policy_src()
+
+
+def test_policy_pure_smuggled_wall_clock_fires():
+    from burst_attn_tpu.analysis import policycheck
+
+    src = _policy_src().replace(
+        "best = None\n    best_score = None",
+        "best = None\n    import time\n"
+        "    _now = time.time()\n    best_score = None", 1)
+    assert src != _policy_src()
+    findings = policycheck.check_policy_source(src)
+    msgs = " | ".join(f.message for f in findings)
+    assert "time" in msgs and findings, msgs
+
+
+def test_policy_pure_module_level_counter_fires():
+    from burst_attn_tpu.analysis import policycheck
+
+    src = _policy_src() + (
+        "\n_CALLS = 0\n\n\ndef counting_route(state, req=None):\n"
+        "    global _CALLS\n    _CALLS += 1\n"
+        "    return route_least_loaded(state, req)\n")
+    findings = policycheck.check_policy_source(src)
+    assert any("global" in f.message for f in findings), findings
+
+
+def test_policy_pure_module_state_mutation_fires():
+    from burst_attn_tpu.analysis import policycheck
+
+    src = _policy_src() + (
+        "\n\ndef sneaky(state):\n"
+        "    POLICIES.update({})\n"
+        "    ROUTE_POLICY_FUNCS[\"x\"] = \"y\"\n    return None\n")
+    findings = policycheck.check_policy_source(src)
+    assert sum("POLICIES" in f.message
+               or "ROUTE_POLICY_FUNCS" in f.message
+               for f in findings) >= 2, findings
+
+
+def test_policy_pure_transport_import_fires():
+    from burst_attn_tpu.analysis import policycheck
+
+    for stmt in ("import socket\n",
+                 "from burst_attn_tpu.fleet import transport\n",
+                 "import numpy as np\n"):
+        src = stmt + _policy_src()
+        findings = policycheck.check_policy_source(src)
+        assert any("import" in f.message for f in findings), stmt
+
+
+def test_policy_pure_rng_call_fires():
+    from burst_attn_tpu.analysis import policycheck
+
+    src = _policy_src().replace(
+        "best = None\n    best_score = None",
+        "best = None\n    _r = random.random()\n    best_score = None", 1)
+    findings = policycheck.check_policy_source(src)
+    assert any("RNG" in f.message or "random" in f.message
+               for f in findings), findings

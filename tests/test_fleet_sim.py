@@ -1,0 +1,481 @@
+"""burstsim: the simulator/policy protocol and its honesty gates.
+
+Fast canaries prove the structural claims: the pure policies in
+fleet/policy.py make bit-identical decisions to the pre-refactor inline
+router (hand-ported here as the pin), BOTH executors delegate to them
+(spy-asserted, the protocols/ pattern), the event engine is seeded-
+deterministic (bit-identical event-log digests), and the fidelity
+machinery round-trips on synthetic outcomes.  The slow tests are the
+acceptance criteria themselves: a real process-backed `--fleet` replay
+whose trace the sim reproduces within the pinned tolerance, and the
+1000-replica / 1M-request diurnal sweep under 60 s wall with a
+digest-pinned event log."""
+
+import json
+import math
+import types
+
+import pytest
+
+from burst_attn_tpu.fleet import fleet as fleet_mod
+from burst_attn_tpu.fleet import policy as fleet_policy
+from burst_attn_tpu.fleet import sim
+from burst_attn_tpu.fleet.fleet import FleetCluster
+from burst_attn_tpu.fleet.policy import (FleetView, PolicySpec, ReplicaView,
+                                         ReqView, RunView, ScaleParams)
+from burst_attn_tpu.loadgen.trace import (Trace, TraceRequest,
+                                          synthesize_diurnal_trace,
+                                          synthesize_heavy_tail_trace)
+
+TOY_RATES = sim.SimRates(prefill_tokens_per_s=50_000.0,
+                         decode_steps_per_s=20.0,
+                         ship_bytes_per_s=1e9,
+                         kv_bytes_per_token=1024.0,
+                         boot_s=2.0)
+
+
+def _view(gauges, **kw):
+    """FleetView from {wid: (occ, staged, slots_free)} triples."""
+    reps = tuple(ReplicaView(wid=w, occ=o, staged=st, slots_free=fr,
+                             quiet=kw.pop(f"quiet{w}", False))
+                 for w, (o, st, fr) in sorted(gauges.items()))
+    return FleetView(replicas=reps, **kw)
+
+
+# -- policy bit-identity vs the pre-refactor inline router -------------------
+
+
+def _inline_pick_decode(stats_by_wid):
+    """The OLD FleetCluster._pick_decode body, verbatim semantics: this
+    is the pin the pure function must match on every input."""
+    best, best_score = None, None
+    for w in sorted(stats_by_wid):
+        st = stats_by_wid[w]
+        score = (int(st.get("slots_free", 1)) <= 0,
+                 int(st.get("occ", 0)) + int(st.get("staged", 0)), w)
+        if best_score is None or score < best_score:
+            best, best_score = w, score
+    return best
+
+
+def test_route_least_loaded_bit_identical_to_inline_router():
+    cases = [
+        {0: {"occ": 2, "staged": 0, "slots_free": 0},
+         1: {"occ": 1, "staged": 0, "slots_free": 1}},
+        {0: {}, 1: {}},                       # gauge-less: defaults
+        {0: {"occ": 0, "staged": 3, "slots_free": 2},
+         1: {"occ": 2, "staged": 0, "slots_free": 2},
+         2: {"occ": 1, "staged": 1, "slots_free": 0}},
+        {3: {"occ": 5, "slots_free": 0}},     # all full: still routes
+        {},                                   # no replicas: None
+    ]
+    for stats in cases:
+        view = _view({w: (int(st.get("occ", 0)), int(st.get("staged", 0)),
+                          int(st.get("slots_free", 1)))
+                      for w, st in stats.items()})
+        assert fleet_policy.route_least_loaded(view) \
+            == _inline_pick_decode(stats), stats
+
+
+def _inline_autoscale(view, params, pressure_ticks, idle_ticks):
+    """The OLD inline autoscale block from FleetCluster.replay, ported
+    statement for statement (pressure reset, boot-aware capacity, one
+    retirement per tick with the loop break)."""
+    free = sum(r.slots_free for r in view.replicas)
+    pressure_ticks = pressure_ticks + 1 \
+        if (view.wait_for_decode > 0 and free == 0) else 0
+    up = False
+    if pressure_ticks >= params.scale_up_after \
+            and len(view.replicas) + view.booting < params.max_decode:
+        pressure_ticks, up = 0, True
+    ticks = dict(idle_ticks)
+    down = None
+    for r in view.replicas:
+        ticks[r.wid] = ticks.get(r.wid, 0) + 1 if r.quiet else 0
+        if ticks[r.wid] >= params.scale_down_after \
+                and len(view.replicas) > params.min_decode \
+                and view.queue_depth == 0:
+            ticks.pop(r.wid)
+            down = r.wid
+            break
+    return up, down, pressure_ticks, ticks
+
+
+def test_autoscale_bit_identical_to_inline_block():
+    params = ScaleParams(scale_up_after=2, scale_down_after=3,
+                         max_decode=4, min_decode=1)
+    views = [
+        _view({0: (2, 0, 0), 1: (2, 0, 0)}, wait_for_decode=3),
+        _view({0: (0, 0, 1), 1: (0, 0, 1)}, quiet0=True, quiet1=True),
+        _view({0: (2, 0, 0)}, wait_for_decode=1, booting=3),
+        _view({0: (0, 0, 0), 1: (1, 0, 0)}, wait_for_decode=2,
+              quiet0=True),
+        _view({0: (0, 0, 1)}, quiet0=True, queue_depth=2),
+    ]
+    # run a multi-tick trajectory through BOTH implementations, carrying
+    # the threaded state — every decision and every counter must agree
+    p_new, t_new = 0, {}
+    p_old, t_old = 0, {}
+    for tick in range(12):
+        view = views[tick % len(views)]
+        decision, p_new, t_new = fleet_policy.autoscale(
+            view, params, p_new, t_new)
+        up, down, p_old, t_old = _inline_autoscale(
+            view, params, p_old, t_old)
+        assert (decision.up, decision.down) == (up, down), tick
+        assert (p_new, t_new) == (p_old, t_old), tick
+
+
+def test_autoscale_can_fire_up_and_down_in_one_tick():
+    # pressure from unassigned transfers while the prefill queue is
+    # empty and some replica idled past threshold — both fire
+    params = ScaleParams(1, 1, 8, 1)
+    view = _view({0: (0, 0, 0), 1: (0, 0, 0)}, wait_for_decode=1,
+                 quiet1=True)
+    decision, _, _ = fleet_policy.autoscale(view, params, 1, {1: 1})
+    assert decision.up and decision.down == 1
+
+
+def test_preempt_victim_cheapest_strictly_lower_priority():
+    runs = (RunView(rid=5, priority=1, kv_tokens=100),
+            RunView(rid=7, priority=0, kv_tokens=900),
+            RunView(rid=9, priority=0, kv_tokens=40))
+    assert fleet_policy.preempt_victim(runs, priority=1) == 9
+    assert fleet_policy.preempt_victim(runs, priority=2) == 9
+    assert fleet_policy.preempt_victim(runs, priority=0) is None
+    assert fleet_policy.preempt_victim((), priority=3) is None
+
+
+def test_fair_tenant_dequeue_counters_rich_get_richer():
+    waiting = [ReqView(rid=1, tenant=0), ReqView(rid=2, tenant=0),
+               ReqView(rid=3, tenant=5)]
+    assert fleet_policy.next_waiting_fcfs(waiting, {0: 99}) == 0
+    assert fleet_policy.next_waiting_fair_tenant(waiting, {0: 99}) == 2
+    assert fleet_policy.next_waiting_fair_tenant(waiting, {}) == 0
+
+
+# -- spy-asserted delegation: FleetCluster executes fleet/policy.py ----------
+
+
+def _hollow_cluster(stats_by_wid, router_policy="least_loaded"):
+    """A FleetCluster with only the router's observed state — no
+    processes, no transport — so the delegation seam is the ONLY thing
+    under test (same hollow-instance pattern the protocol spies use)."""
+    fc = object.__new__(FleetCluster)
+    fc._alive = {"decode": sorted(stats_by_wid)}
+    fc._m = {("decode", w): {"stats": dict(st)}
+             for w, st in stats_by_wid.items()}
+    fc.router_policy = router_policy
+    fc.scale_up_after = 2
+    fc.scale_down_after = 3
+    fc.max_decode = 4
+    fc.min_decode = 1
+    return fc
+
+
+def test_pick_decode_delegates_to_policy_module(monkeypatch):
+    fc = _hollow_cluster({0: {"occ": 2, "slots_free": 1},
+                          1: {"occ": 0, "slots_free": 2}})
+    seen = {}
+
+    def spy(state, req=None):
+        seen["replicas"] = state.replicas
+        return 1  # the spy's answer must be the router's answer
+    monkeypatch.setattr(fleet_policy, "route_least_loaded", spy)
+    assert fc._pick_decode() == 1
+    assert [r.wid for r in seen["replicas"]] == [0, 1]
+    assert seen["replicas"][0].occ == 2  # real gauges reached the policy
+
+
+def test_pick_decode_delegates_through_named_policy(monkeypatch):
+    fc = _hollow_cluster({0: {"occ": 0, "slots_free": 1}},
+                         router_policy="ttft_tpot")
+    called = []
+    monkeypatch.setattr(fleet_policy, "route_ttft_tpot",
+                        lambda state, req=None: called.append(True) or 0)
+    assert fc._pick_decode() == 0
+    assert called, "ttft_tpot router did not delegate to policy module"
+
+
+def test_autoscale_decide_delegates_to_policy_module(monkeypatch):
+    fc = _hollow_cluster({0: {"occ": 1, "staged": 0, "slots_free": 0}})
+    seen = {}
+
+    def spy(state, params, pressure_ticks, idle_ticks):
+        seen.update(view=state, params=params, p=pressure_ticks)
+        return fleet_policy.ScaleDecision(up=True), 0, {}
+    monkeypatch.setattr(fleet_policy, "autoscale", spy)
+    decision, _, _ = fc._autoscale_decide(
+        depth=2, outstanding={}, transfers={0: {"decode": None}},
+        restarting={("decode", 9)}, pressure_ticks=1, idle_ticks={})
+    assert decision.up
+    assert seen["p"] == 1
+    assert seen["params"] == ScaleParams(2, 3, 4, 1)
+    # the observation half: queue + unassigned transfers + booting
+    assert seen["view"].queue_depth == 2
+    assert seen["view"].wait_for_decode == 3
+    assert seen["view"].booting == 1
+
+
+def test_unknown_router_policy_rejected(tmp_path):
+    with pytest.raises(ValueError, match="router_policy"):
+        FleetCluster({"vocab": 97}, out_dir=str(tmp_path),
+                     router_policy="nope")
+
+
+def test_simulator_executes_same_policy_functions(monkeypatch):
+    """The other half of the shared-surface claim: the SIM's admission
+    path calls the same module functions the fleet does."""
+    calls = []
+    real = fleet_policy.route_least_loaded
+
+    def spy(state, req=None):
+        calls.append(req)
+        return real(state, req)
+    monkeypatch.setattr(fleet_policy, "route_least_loaded", spy)
+    tr = _toy_trace(4)
+    rep = sim.simulate(tr, fleet_policy.POLICIES["least_loaded"],
+                       n_replicas=2, slots=2, n_prefill=1,
+                       rates=TOY_RATES)
+    assert rep.n_done == 4
+    assert len(calls) >= 4  # one route per admission attempt
+
+
+# -- the engine: determinism, contention paths -------------------------------
+
+
+def _toy_trace(n, *, dt=0.01, prompt_len=64, max_new=8, priority_every=0):
+    reqs = []
+    for i in range(n):
+        prio = 1 if priority_every and i % priority_every == 0 else 0
+        reqs.append(TraceRequest(rid=i, t_arrival=round(dt * i, 6),
+                                 prompt_len=prompt_len, prompt_seed=100 + i,
+                                 max_new_tokens=max_new, priority=prio))
+    return Trace(meta={"vocab": 97}, requests=reqs)
+
+
+def test_sim_same_seed_bit_identical_event_log(tmp_path):
+    tr = synthesize_heavy_tail_trace(500, seed=5, vocab=97,
+                                     mean_interarrival_s=0.002)
+    logs = []
+    for i in range(2):
+        path = str(tmp_path / f"events_{i}.log")
+        rep = sim.simulate(tr, fleet_policy.POLICIES["affinity"],
+                           n_replicas=3, slots=4, n_prefill=2,
+                           rates=TOY_RATES, log_path=path)
+        logs.append((rep.event_log_sha256, open(path).read()))
+    assert logs[0][0] == logs[1][0]
+    assert logs[0][1] == logs[1][1] and logs[0][1]
+    # and the digest really is over the log contents
+    import hashlib
+    assert hashlib.sha256(logs[0][1].encode()).hexdigest() == logs[0][0]
+
+
+def test_sim_different_policies_diverge_under_contention():
+    tr = synthesize_heavy_tail_trace(800, seed=3, vocab=97,
+                                     mean_interarrival_s=0.001,
+                                     priority_tenants=4)
+    fcfs = sim.simulate(tr, fleet_policy.POLICIES["least_loaded"],
+                        n_replicas=2, slots=4, n_prefill=1,
+                        rates=TOY_RATES)
+    pre = sim.simulate(tr, fleet_policy.POLICIES["priority_preempt"],
+                       n_replicas=2, slots=4, n_prefill=1,
+                       rates=TOY_RATES)
+    assert fcfs.event_log_sha256 != pre.event_log_sha256
+    assert sum(pre.preemptions.values()) > 0
+    assert not fcfs.preemptions
+    assert fcfs.n_done == pre.n_done == 800  # preemption loses no work
+
+
+def test_sim_preemptions_counted_per_class():
+    tr = _toy_trace(200, dt=0.001, max_new=20, priority_every=5)
+    rep = sim.simulate(tr, fleet_policy.POLICIES["priority_preempt"],
+                       n_replicas=1, slots=2, n_prefill=1,
+                       rates=TOY_RATES)
+    assert rep.n_done == 200
+    assert set(rep.preemptions) == {"0"}  # only best-effort evicted
+    assert rep.preemptions["0"] > 0
+
+
+def test_sim_shed_policy_drops_best_effort_only():
+    tr = _toy_trace(300, dt=0.001, max_new=20, priority_every=3)
+    spec = PolicySpec("shed", max_pending=4)
+    rep = sim.simulate(tr, spec, n_replicas=1, slots=2, n_prefill=1,
+                       rates=TOY_RATES)
+    assert rep.n_shed > 0
+    assert rep.n_done + rep.n_shed == 300
+
+
+def test_sim_autoscale_spawns_under_pressure_and_boots_late():
+    tr = _toy_trace(400, dt=0.001, max_new=20)
+    rep = sim.simulate(tr, fleet_policy.POLICIES["least_loaded"],
+                       n_replicas=1, slots=2, n_prefill=1,
+                       rates=TOY_RATES,
+                       autoscale=ScaleParams(2, 50, 6, 1),
+                       scale_interval_s=0.5)
+    assert rep.scale_ups > 0
+    assert rep.n_done == 400
+    # boot latency is real: a fleet with capacity from t=0 finishes sooner
+    big = sim.simulate(tr, fleet_policy.POLICIES["least_loaded"],
+                       n_replicas=1 + rep.scale_ups, slots=2, n_prefill=1,
+                       rates=TOY_RATES)
+    assert big.sim_duration_s < rep.sim_duration_s
+
+
+def test_sim_report_jsonl_well_formed(tmp_path):
+    tr = _toy_trace(50)
+    reports = sim.sweep(tr, [fleet_policy.POLICIES[n]
+                             for n in sorted(fleet_policy.POLICIES)],
+                        n_replicas=2, slots=2, n_prefill=1,
+                        rates=TOY_RATES, seed=9)
+    path = sim.write_report_jsonl(reports, str(tmp_path / "sweep.jsonl"))
+    recs = [json.loads(line) for line in open(path)]
+    assert len(recs) == len(fleet_policy.POLICIES)
+    for rec in recs:
+        assert rec["record"] == "sim-policy-report"
+        assert rec["seed"] == 9
+        assert rec["n_requests"] == 50
+        assert rec["event_log_sha256"]
+        assert rec["goodput_tokens_per_s"] > 0
+
+
+def test_sim_rates_from_cost_table_sane():
+    rates = sim.rates_from_cost_table()
+    assert rates.prefill_tokens_per_s > 0
+    assert rates.decode_steps_per_s > 0
+    assert rates.ship_bytes_per_s > 0
+    assert rates.kv_bytes_per_token > 0
+    with pytest.raises(ValueError, match="schema"):
+        sim.rates_from_cost_table({"schema": "nope"})
+
+
+def test_sim_obs_export_merges(tmp_path):
+    from burst_attn_tpu import obs
+    tr = _toy_trace(20)
+    sim.simulate(tr, fleet_policy.POLICIES["least_loaded"], n_replicas=2,
+                 slots=2, n_prefill=1, rates=TOY_RATES)
+    path = str(tmp_path / "sim_obs.jsonl")
+    obs.export_jsonl(path)
+    names = {json.loads(line).get("name") for line in open(path)}
+    assert {"sim.events_processed", "sim.policy_goodput"} <= names
+    from burst_attn_tpu.obs.aggregate import merge_files
+    merged = merge_files([path])
+    assert merged  # obs --merge accepts the export
+
+
+# -- fidelity + promotion gates ----------------------------------------------
+
+
+def _outcome(rid, t_arrival, t_submit, t_done, n_tokens):
+    return types.SimpleNamespace(rid=rid, status="done",
+                                 t_arrival=t_arrival, t_submit=t_submit,
+                                 t_done=t_done,
+                                 tokens=list(range(n_tokens)))
+
+
+def test_fidelity_gate_passes_on_self_consistent_outcomes():
+    """Synthetic canary: outcomes generated BY the sim's own service
+    model must calibrate back to rates that reproduce goodput almost
+    exactly — well inside the pinned tolerance."""
+    tr = _toy_trace(40, dt=0.05, prompt_len=100, max_new=10)
+    step_s, prefill_s = 0.01, 100 / 5000.0
+    outcomes = {}
+    for r in tr.requests:
+        t_submit = r.t_arrival + prefill_s
+        outcomes[r.rid] = _outcome(r.rid, r.t_arrival, t_submit,
+                                   t_submit + 10 * step_s, 10)
+    verdict = sim.fidelity_check(tr, outcomes, n_replicas=2, slots=2,
+                                 n_prefill=1)
+    assert verdict["ok"], verdict
+    assert abs(verdict["ratio"] - 1.0) < 0.10, verdict
+    assert verdict["rtol"] == sim.SIM_FIDELITY_RTOL == 0.35
+
+
+def test_fidelity_gate_fails_on_wrong_world():
+    """Outcomes from a world the sim's model CANNOT reproduce: decode
+    fully serialized (each request waits for the previous — a broken
+    single-slot deployment) while the checker simulates 2 replicas x 2
+    slots.  The per-request averages calibrate fine, but the queueing
+    dynamics diverge and the gate must fail."""
+    tr = _toy_trace(40, dt=0.001, prompt_len=100, max_new=10)
+    outcomes = {}
+    for i, r in enumerate(tr.requests):
+        t_submit = r.t_arrival + 0.02
+        outcomes[r.rid] = _outcome(r.rid, r.t_arrival, t_submit,
+                                   0.02 + (i + 1) * 0.1, 10)
+    verdict = sim.fidelity_check(tr, outcomes, n_replicas=2, slots=2,
+                                 n_prefill=1)
+    assert not verdict["ok"], verdict
+
+
+def test_promote_policy_requires_real_fleet_win():
+    simg = {"least_loaded": 100.0, "affinity": 130.0}
+    # no real measurement for the candidate: no promotion
+    assert sim.promote_policy("least_loaded", simg,
+                              {"least_loaded": 11.0}) == "least_loaded"
+    # real measurement worse: no promotion
+    assert sim.promote_policy("least_loaded", simg,
+                              {"least_loaded": 11.0, "affinity": 10.0}) \
+        == "least_loaded"
+    # tie is not a strict win
+    assert sim.promote_policy("least_loaded", simg,
+                              {"least_loaded": 11.0, "affinity": 11.0}) \
+        == "least_loaded"
+    # strict measured win: promoted
+    assert sim.promote_policy("least_loaded", simg,
+                              {"least_loaded": 11.0, "affinity": 12.0}) \
+        == "affinity"
+    # sim winner already the default: nothing to do
+    assert sim.promote_policy("affinity", simg, {}) == "affinity"
+
+
+# -- slow acceptance tests ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sim_fidelity_vs_real_fleet_replay(tmp_path):
+    """THE fidelity gate: run a real process-backed fleet on a small
+    trace, calibrate the sim from its measured outcome timeline, replay
+    the same trace, and pin simulated goodput within
+    SIM_FIDELITY_RTOL of measured."""
+    MODEL_SPEC = dict(vocab=97, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_head=16, d_ff=64, block_q=8,
+                      block_kv=8, seed=0)
+    PSPEC = dict(sp=2, page=128, n_pages=4, max_pages_per_seq=8)
+    DSPEC = dict(sp=2, slots=2, page=128, n_pages=8, max_pages_per_seq=4)
+    reqs = [TraceRequest(rid=i, t_arrival=round(0.05 * i, 6),
+                         prompt_len=128, prompt_seed=100 + i,
+                         max_new_tokens=4) for i in range(6)]
+    tr = Trace(meta={"vocab": 97}, requests=reqs)
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=DSPEC,
+                      n_prefill=1, n_decode=2, out_dir=str(tmp_path),
+                      transport="queue") as fc:
+        rep = fc.replay(tr, speed=25.0, max_wall_s=420.0)
+    assert all(o.status == "done" for o in rep.outcomes.values())
+    verdict = sim.fidelity_check(tr, rep.outcomes, n_replicas=2,
+                                 slots=DSPEC["slots"], n_prefill=1)
+    assert verdict["ok"], verdict
+    assert verdict["measured_goodput"] > 0
+
+
+@pytest.mark.slow
+def test_sim_1000_replicas_1m_requests_under_60s_deterministic():
+    """The scale acceptance criterion: a 1000-replica sweep over a
+    >=1M-request diurnal trace in < 60 s wall-clock, event log
+    bit-identical across two same-seed runs."""
+    import time as _time
+    tr = synthesize_diurnal_trace(1_000_000, seed=7, vocab=97,
+                                  period_s=3600.0, mean_rate=400.0,
+                                  priority_fraction=0.05)
+    rates = sim.rates_from_cost_table()
+    digests = []
+    for _ in range(2):
+        t0 = _time.perf_counter()
+        rep = sim.simulate(tr, fleet_policy.POLICIES["least_loaded"],
+                           n_replicas=1000, slots=8, rates=rates, seed=7)
+        wall = _time.perf_counter() - t0
+        assert wall < 60.0, f"1M-request sim took {wall:.1f}s"
+        assert rep.n_done == 1_000_000
+        assert rep.events >= 3_000_000
+        digests.append(rep.event_log_sha256)
+    assert digests[0] == digests[1]

