@@ -316,11 +316,16 @@ def _obs_bound_names(tree) -> Set[str]:
             else:  # `from .. import obs` / `from burst_attn_tpu import obs`
                 bound.update(a.asname or a.name for a in node.names
                              if a.name == "obs")
-    for node in tree.body:  # module level only: metric singletons
-        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-            if _deep_root(node.value) in bound:
-                bound.update(t.id for t in node.targets
-                             if isinstance(t, ast.Name))
+    for node in tree.body:  # module level only: metric singletons + aliases
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value,
+                               (ast.Call, ast.Name, ast.Attribute)) \
+                and _deep_root(node.value) in bound:
+            # `_C = obs.counter("c")` (call result), `T = tracing` /
+            # `rec = trace.record_span` (plain aliases) — all route obs
+            # API through a new name that must stay jit-unreachable too
+            bound.update(t.id for t in node.targets
+                         if isinstance(t, ast.Name))
     return bound
 
 

@@ -67,6 +67,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import obs
+from ..obs import trace as tracing
 from ..loadgen.driver import DONE, Outcome, ReplayReport, RetryBackoff
 from ..loadgen.trace import Trace
 from ..protocols import kvtransfer as kv_proto
@@ -180,9 +181,26 @@ def _child_transport(conn):
 
 def _export(obs_path: str, wid: int) -> None:
     from ..obs import spans as _spans
+    from ..obs import trace as _trace
 
+    extra = (_spans.span_records() + _trace.trace_records()
+             + _trace.exemplar_records())
     obs.default_registry().export_jsonl(
-        obs_path, extra_records=_spans.span_records(), process_index=wid)
+        obs_path, extra_records=extra, process_index=wid)
+
+
+def _dispatch_msg(rid: int, prompt, max_new: int, resume=None,
+                  trace_wire=None):
+    """Router -> prefill work tuple.  `resume` and `trace_wire` are
+    OPTIONAL trailing elements, appended only when non-empty — a
+    tracing-off run's frames encode byte-identical to a build without
+    tracing (the zero-wire-cost-when-off bar)."""
+    msg = ("prefill", int(rid), prompt, int(max_new))
+    if resume or trace_wire:
+        msg = msg + ([int(t) for t in (resume or [])],)
+    if trace_wire:
+        msg = msg + (list(trace_wire),)
+    return msg
 
 
 def _op(msg):
@@ -225,6 +243,8 @@ def prefill_main(wid: int, model_spec: dict, prefill_spec: dict,
 
         params, cfg = _build_model(model_spec)
         ps = dict(prefill_spec)
+        if ps.get("trace"):
+            tracing.enable()
         mesh = make_mesh({"sp": int(ps.get("sp", 2))})
         page = int(ps.get("page", 128))
         state, pool = init_paged_state(
@@ -296,6 +316,11 @@ def prefill_main(wid: int, model_spec: dict, prefill_spec: dict,
                 rid, prompt, max_new = int(msg[1]), msg[2], int(msg[3])
                 resume = [int(t) for t in (msg[4] if len(msg) > 4 and msg[4]
                                            else [])]
+                # optional element 5: the router's trace context — spans
+                # recorded here join the router's tree on merge
+                tc = tracing.TraceContext.from_wire(
+                    msg[5] if len(msg) > 5 else None)
+                t_p0 = time.perf_counter()
                 try:
                     logits, state = ring_prefill_to_pages(
                         params,
@@ -310,11 +335,19 @@ def prefill_main(wid: int, model_spec: dict, prefill_spec: dict,
                     continue
                 first = resume[0] if resume \
                     else int(np.asarray(logits).argmax())
+                tracing.record_span(tc, "fleet.prefill", t_p0,
+                                    time.perf_counter(),
+                                    prompt_len=len(prompt))
                 meta, pages = kvplane.export_slot_pages(state, 0)
                 meta.update(
                     rid=rid, max_new=max_new, first_token=first,
                     resume_toks=resume, prompt_len=len(prompt),
                     digests=[kvplane.page_digest(pg) for pg in pages])
+                if tc is not None and tracing.enabled():
+                    # ride the context to the decode side in the transfer's
+                    # meta — absent entirely when tracing is off
+                    meta["trace"] = tc.to_wire()
+                t_s0 = time.perf_counter()
                 # the frame sequence (ops + seq numbers) comes from the
                 # transfer machine's sender_plan — the same tuple the
                 # burstcheck sender model walks, so the shipped protocol
@@ -335,6 +368,9 @@ def prefill_main(wid: int, model_spec: dict, prefill_spec: dict,
                     else:  # kv_end
                         frame = {"op": op, "rid": rid, "seq": seq}
                     send_with_retry(tr, frame, rid=rid)
+                tracing.record_span(tc, "fleet.ship", t_s0,
+                                    time.perf_counter(),
+                                    n_pages=len(pages))
                 pending[rid] = int(meta["n_pages"])
             elif stopping and not backlog and not pending:
                 _export(obs_path, wid)
@@ -372,6 +408,8 @@ def decode_main(wid: int, model_spec: dict, decode_spec: dict,
 
         params, cfg = _build_model(model_spec)
         ds = dict(decode_spec)
+        if ds.get("trace"):
+            tracing.enable()
         mesh = make_mesh({"sp": int(ds.get("sp", 2))})
         slots = int(ds.get("slots", 2))
         page = int(ds.get("page", 128))
@@ -385,6 +423,7 @@ def decode_main(wid: int, model_spec: dict, decode_spec: dict,
         ck = dict(ckpt_spec) if ckpt_spec else None
 
         live: Dict[int, dict] = {}   # slot -> {rid, max_new, tokens, fed}
+        t_kv0: Dict[int, float] = {}  # rid -> first kv_begin recv time
         boot_dones: List[Tuple[int, List[int]]] = []
         restored_info = None
         if ck and ck.get("restore") and os.path.exists(ck["snapshot"]):
@@ -458,6 +497,12 @@ def decode_main(wid: int, model_spec: dict, decode_spec: dict,
                     "slots_free": slots - len(live)}
 
         def _finish(s: int, st, info: dict):
+            tc = info.get("_tc")  # absent on snapshot-restored slots
+            if tc is not None:
+                tracing.record_span(tc, "fleet.decode",
+                                    info.get("_t_admit", 0.0),
+                                    time.perf_counter(),
+                                    tokens=len(info["tokens"]))
             if journal is not None:
                 journal.done(info["rid"])
                 journal.sync()
@@ -488,6 +533,7 @@ def decode_main(wid: int, model_spec: dict, decode_spec: dict,
                     if op == "kv_begin":
                         dedup.forget_rid(rid)  # new attempt, new seq space
                         if dedup.accept(rid, 0):
+                            t_kv0[rid] = time.perf_counter()
                             receiver.begin(rid, msg["meta"])
                     elif op == "kv_page":
                         if die_mid_recv is not None:
@@ -510,8 +556,11 @@ def decode_main(wid: int, model_spec: dict, decode_spec: dict,
                             continue
                         st = receiver.staged(rid)
                         meta = st["meta"] if st else {}
+                        tc = tracing.TraceContext.from_wire(
+                            meta.get("trace"))
                         s = next((x for x in range(slots) if x not in live),
                                  None)
+                        t_c0 = time.perf_counter()
                         try:
                             if st is None or not receiver.complete(rid):
                                 raise RuntimeError(
@@ -529,16 +578,24 @@ def decode_main(wid: int, model_spec: dict, decode_spec: dict,
                             if s is not None and int(state.lengths[s]) != 0:
                                 state = retire_slot(state, pool, s)
                             receiver.abort(rid)
+                            t_kv0.pop(rid, None)
                             tr.send({"op": "admit_reject", "rid": rid,
                                      "retryable": True,
                                      "message": f"{type(e).__name__}: {e}",
                                      "stats": _stats()})
                             continue
+                        now = time.perf_counter()
+                        # transfer = first kv frame received -> commit
+                        # start; commit = the staged-pages -> pool copy
+                        tracing.record_span(tc, "fleet.transfer",
+                                            t_kv0.pop(rid, t_c0), t_c0)
+                        tracing.record_span(tc, "fleet.commit", t_c0, now)
                         toks = [int(t) for t in
                                 (meta.get("resume_toks") or [])] \
                             or [int(meta["first_token"])]
                         info = {"rid": rid, "max_new": int(meta["max_new"]),
-                                "tokens": toks, "fed": 0}
+                                "tokens": toks, "fed": 0,
+                                "_tc": tc, "_t_admit": now}
                         live[s] = info
                         if journal is not None:
                             journal.submit(rid, rid, [], info["max_new"])
@@ -558,6 +615,7 @@ def decode_main(wid: int, model_spec: dict, decode_spec: dict,
                             n_since_ckpt += 1
                     elif op == "kv_abort":
                         receiver.abort(rid)
+                        t_kv0.pop(rid, None)
                         tr.send({"op": "abort_ok", "rid": rid,
                                  "stats": _stats()})
                     else:
@@ -708,7 +766,8 @@ class FleetCluster:
                  autoscale: bool = False, max_decode: Optional[int] = None,
                  min_decode: int = 1, scale_check_interval_s: float = 0.4,
                  scale_up_after: int = 3, scale_down_after: int = 12,
-                 router_policy: str = fleet_policy.DEFAULT_ROUTE_POLICY):
+                 router_policy: str = fleet_policy.DEFAULT_ROUTE_POLICY,
+                 trace: bool = False):
         if n_prefill < 1 or n_decode < 1:
             raise ValueError("need >= 1 worker in each pool")
         if transport not in ("queue", "socket"):
@@ -720,6 +779,13 @@ class FleetCluster:
         self.model_spec = dict(model_spec)
         self.prefill_spec = dict(prefill_spec or {})
         self.decode_spec = dict(decode_spec or {})
+        # request tracing rides the worker specs so restarts and
+        # scale-ups inherit it; the key is absent when off, keeping
+        # tracing-off spec dicts (and their pickled spawn args) unchanged
+        self.trace_enabled = bool(trace)
+        if self.trace_enabled:
+            self.prefill_spec["trace"] = True
+            self.decode_spec["trace"] = True
         self.n_prefill = n_prefill
         self.n_decode = n_decode
         self.out_dir = out_dir
@@ -974,6 +1040,9 @@ class FleetCluster:
         reship: List[tuple] = []           # (t_due_v, rid)
         retryq: List[tuple] = []           # (t_due_v, rid, resume_toks)
         outstanding = {w: set() for w in self._alive["decode"]}
+        if self.trace_enabled:
+            tracing.enable()
+        trace_ctx: Dict[int, tuple] = {}   # rid -> (ctx, t_dispatch_pc)
         kills: List[dict] = []
         ledger = {"committed": 0, "aborted": 0, "reshipped": 0,
                   "digest_checked": 0, "digest_mismatch": 0, "aborts": []}
@@ -1076,6 +1145,18 @@ class FleetCluster:
                 outstanding.setdefault(wid, set()).add(rid)
                 if rid not in terminal:
                     outcomes[rid].t_submit = now_v()
+                    if rid in trace_ctx:
+                        # admission IS first token for the fleet: the
+                        # decode replica was seeded with first_token
+                        ctx, t_disp = trace_ctx[rid]
+                        now_pc = time.perf_counter()
+                        tracing.marker(ctx, "fleet.first_token", now_pc)
+                        obs.histogram(
+                            "fleet.ttft_s",
+                            "router dispatch -> admitted latency"
+                        ).observe(now_pc - t_disp)
+                        tracing.note_ttft(ctx, now_pc - t_disp,
+                                          metric="fleet.ttft_s")
             elif op == "admit_reject":
                 rid = int(msg["rid"])
                 st = msg.get("stats") or {}
@@ -1114,6 +1195,12 @@ class FleetCluster:
                 out.tokens = [int(t) for t in msg["tokens"]]
                 out.t_done = now_v()
                 terminal.add(rid)
+                tci = trace_ctx.pop(rid, None)
+                if tci is not None:
+                    ctx, t_disp = tci
+                    tracing.record_span(ctx, "fleet.request", t_disp,
+                                        time.perf_counter(), root=True,
+                                        rid=rid)
             # "ready"/"restored"/"stopped" are lifecycle chatter handled
             # by start()/restart/scale paths
 
@@ -1354,11 +1441,19 @@ class FleetCluster:
                     if rid in terminal:
                         continue
                     req = by_rid[rid]
-                    msg = ("prefill", rid,
-                           [int(x) for x in req.prompt(vocab)],
-                           req.max_new_tokens)
-                    if toks:
-                        msg = msg + ([int(x) for x in toks],)
+                    ctx = None
+                    if tracing.enabled():
+                        # retries reuse the first dispatch's context, so a
+                        # rerouted request stays one tree
+                        if rid in trace_ctx:
+                            ctx = trace_ctx[rid][0]
+                        else:
+                            ctx = tracing.start_request(rid, prefix="fleet")
+                            trace_ctx[rid] = (ctx, time.perf_counter())
+                    msg = _dispatch_msg(
+                        rid, [int(x) for x in req.prompt(vocab)],
+                        req.max_new_tokens, resume=toks,
+                        trace_wire=ctx.to_wire() if ctx else None)
                     try:
                         self._send("prefill", wid, msg)
                         busy[wid] = rid
@@ -1455,6 +1550,14 @@ class FleetCluster:
             poll_restarting(now_v())
             if restarting:
                 time.sleep(0.01)
+        if self.trace_enabled:
+            # the router's own spans (fleet.request roots, first_token
+            # markers) join the workers' in --merge; exported only when
+            # tracing so untraced runs keep their historical process set
+            rpath = os.path.join(self.out_dir, "obs_router.jsonl")
+            _export(rpath, 1000)
+            if rpath not in self._obs_files:
+                self._obs_files.append(rpath)
         return FleetReport(
             outcomes=outcomes, wall_s=time.perf_counter() - t0, speed=speed,
             kills=kills, transfers=ledger, scale_events=scale_events,

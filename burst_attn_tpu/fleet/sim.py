@@ -70,6 +70,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from .. import obs
+from ..obs import trace as tracing
 from ..loadgen import trace as trace_mod
 from ..loadgen.trace import Trace
 from . import policy as fleet_policy
@@ -338,6 +339,16 @@ def simulate(trace: Trace, spec: PolicySpec, *, n_replicas: int,
     tokens_done = 0
     last_done_t = 0.0
 
+    # virtual-clock request tracing: when obs.trace is enabled the sim
+    # emits the SAME record schema the live engines do, with clock
+    # "virtual" and deterministic trace ids (no RNG, no wall reads — the
+    # event-log digest is untouched)
+    trc = tracing.enabled()
+
+    def _tc(rid: int) -> tracing.TraceContext:
+        return tracing.TraceContext(trace_id=f"sim{seed}-r{rid}",
+                                    clock="virtual")
+
     hasher = hashlib.sha256()
     log_fh = open(log_path, "w", encoding="utf-8") if log_path else None
 
@@ -427,6 +438,12 @@ def simulate(trace: Trace, spec: PolicySpec, *, n_replicas: int,
         push(t0 + steps * step_s, _DECODE_DONE, rid, epoch[rid])
         if not resume:
             ttfts.append(t0 + step_s - arrival_t[rid])
+            if trc:
+                tc = _tc(rid)
+                tracing.record_span(tc, "sim.ship", t, t0, wid=wid)
+                tracing.marker(tc, "sim.first_token", t0 + step_s)
+                tracing.note_ttft(tc, t0 + step_s - arrival_t[rid],
+                                  metric="sim.ttft_s")
         return True
 
     def drain(t: float) -> None:
@@ -461,6 +478,10 @@ def simulate(trace: Trace, spec: PolicySpec, *, n_replicas: int,
             heapq.heappush(pf_free, (done, pid))
             in_prefill += 1
             push(done, _PREFILL_DONE, req.rid)
+            if trc:
+                tc = _tc(req.rid)
+                tracing.record_span(tc, "sim.queued", req.t_arrival, start)
+                tracing.record_span(tc, "sim.prefill", start, done, pid=pid)
             log(t, code, req.rid, pid)
         elif code == _PREFILL_DONE:
             in_prefill -= 1
@@ -489,6 +510,12 @@ def simulate(trace: Trace, spec: PolicySpec, *, n_replicas: int,
             n_done += 1
             tokens_done += views[rid].max_new_tokens
             last_done_t = t
+            if trc:
+                tc = _tc(rid)
+                tracing.record_span(tc, "sim.decode", run_t0[rid], t,
+                                    wid=wid)
+                tracing.record_span(tc, "sim.request", arrival_t[rid], t,
+                                    root=True, rid=rid)
             log(t, code, rid, wid)
             state.wait_for_decode = len(pending)
             drain(t)
@@ -688,6 +715,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--generation", default="v5e")
     ap.add_argument("--autoscale", action="store_true")
+    ap.add_argument("--trace", action="store_true",
+                    help="emit virtual-clock request trace records "
+                         "(ride the --json export; render with "
+                         "`python -m burst_attn_tpu.obs --trace`)")
     ap.add_argument("--report", metavar="PATH",
                     help="write per-policy JSONL report")
     ap.add_argument("--json", metavar="PATH",
@@ -706,6 +737,8 @@ def main(argv=None) -> int:
             args.requests, seed=args.seed, vocab=97,
             mean_interarrival_s=min(0.05, 200.0 / args.requests),
             priority_tenants=2)
+    if args.trace:
+        tracing.enable()
     rates = rates_from_cost_table(generation=args.generation)
     names = sorted(fleet_policy.POLICIES) if args.policy == "all" \
         else [args.policy]

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..obs import trace as tracing
 from ..admission import (
     AdmissionPolicy, InvalidRequest, LoadShed, RejectReason, SubmitRejected,
     SubmitResult,
@@ -71,6 +72,13 @@ _M_POOL = obs.gauge("serve.page_pool_occupancy",
 _M_SPEC_RATE = obs.gauge("serve.spec_acceptance_rate")
 _M_TTFT = obs.histogram("serve.ttft_s")
 _M_TOK_LAT = obs.histogram("serve.token_latency_s")
+# host time the tick spent OUTSIDE the device launch+sample window, as a
+# fraction of launch-tick wall time (cumulative).  Upper bound on the gap
+# async pipelining (ROADMAP item 3) could hide: admission bookkeeping and
+# retirement count as host, the main launch through its sample sync counts
+# as device.  Always on — host clock reads never touch the jaxpr.
+_M_HOST_GAP = obs.gauge("serve.host_gap_fraction",
+                        "host gap seconds / launch-tick wall seconds")
 
 from .decode import sample_logits
 from .paged_decode import (
@@ -223,8 +231,12 @@ class ServeEngine:
                              f"pool_occupancy={occ:.3f}")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append(_Request(rid, tokens, max_new_tokens,
-                                    t_submit=time.perf_counter()))
+        req = _Request(rid, tokens, max_new_tokens,
+                       t_submit=time.perf_counter())
+        # the trace context rides as an attribute, not a dataclass field —
+        # checkpoint serialization must not see it (same as _prefix_hashes)
+        req._tc = tracing.start_request(rid)
+        self._queue.append(req)
         _M_SUBMITTED.inc()
         _M_QUEUE.set(len(self._queue))
         return rid
@@ -331,6 +343,7 @@ class ServeEngine:
                 # slot (target live, request lost)
                 break
             slack = self.spec_k + 1 if self.draft is not None else 0
+            t_adm = time.perf_counter()  # queued ends / prefill starts here
             try:
                 logits, self.state = paged_prefill(
                     self.params, jnp.asarray(req.prompt), self.state,
@@ -403,10 +416,29 @@ class ServeEngine:
                 self.journal.tokens(req.rid, [int(tok)])
             self.slots[slot] = req
             self._next_tok[slot] = int(tok)
+            now = time.perf_counter()
+            # the prefill+sample block was device-bound: credit it to the
+            # tick's device window so host_gap_fraction stays honest on
+            # admission-heavy ticks
+            self._tick_dev_s = getattr(self, "_tick_dev_s", 0.0) \
+                + (now - t_adm)
             _M_ADMITTED.inc()
             _M_TOKENS.inc()  # the prefill-sampled first token
-            _M_TTFT.observe(time.perf_counter() - req.t_submit)
+            _M_TTFT.observe(now - req.t_submit)
             _M_QUEUE.set(len(self._queue))
+            tc = getattr(req, "_tc", None)
+            if tc is not None:
+                # lifecycle phases are CONTIGUOUS on one clock (queued ends
+                # where prefill starts, prefill ends at the first-token
+                # instant), so the critical-path breakdown sums to the
+                # observed TTFT by construction
+                req._t_first = now
+                tracing.record_span(tc, "serve.queued", req.t_submit, t_adm)
+                tracing.record_span(tc, "serve.prefill", t_adm, now)
+                tracing.marker(tc, "serve.first_token", now)
+                tracing.note_ttft(tc, now - req.t_submit)
+                tracing.publish_breakdown({"queued": t_adm - req.t_submit,
+                                           "prefill": now - t_adm})
 
     def _sample(self, logits):
         self._rng, key = jax.random.split(self._rng)
@@ -433,13 +465,30 @@ class ServeEngine:
                 if self.journal is not None:
                     self.journal.done(req.rid)
                 _M_RETIRED.inc(cause="eos" if hit_eos else "budget")
+                tc = getattr(req, "_tc", None)
+                if tc is not None:
+                    now = time.perf_counter()
+                    tracing.record_span(
+                        tc, "serve.decode",
+                        getattr(req, "_t_first", req.t_submit), now,
+                        tokens=len(req.tokens))
+                    tracing.record_span(tc, "serve.request", req.t_submit,
+                                        now, root=True, rid=req.rid)
         return done
 
-    def _note_tick(self, dt: float, added: int) -> None:
+    def _note_tick(self, dt: float, added: int,
+                   dev_s: Optional[float] = None) -> None:
         """Per-tick obs update: queue/slot/pool gauges and, when tokens were
         produced, the amortized per-token latency (tick seconds per token
         per stream: live streams advance concurrently, so each stream's
-        tokens arrived `dt / (added / live)` apart)."""
+        tokens arrived `dt / (added / live)` apart).  `dev_s` is the tick's
+        device launch+sample window; when known, the remainder feeds the
+        cumulative `serve.host_gap_fraction` gauge."""
+        if dev_s is not None:
+            self._host_gap_s = getattr(self, "_host_gap_s", 0.0) \
+                + max(0.0, dt - dev_s)
+            self._launch_wall_s = getattr(self, "_launch_wall_s", 0.0) + dt
+            _M_HOST_GAP.set(self._host_gap_s / self._launch_wall_s)
         _M_STEPS.inc()
         _M_QUEUE.set(len(self._queue))
         live = self.live
@@ -480,6 +529,7 @@ class ServeEngine:
         a token past its budget / past EOS and break parity with
         generate()."""
         t0 = time.perf_counter()
+        self._tick_dev_s = 0.0  # _admit credits its prefill windows here
         done = self._retire_finished()
         while True:
             before = self.pending
@@ -488,16 +538,24 @@ class ServeEngine:
             if self.pending == before:
                 break
         if self.live == 0:
-            self._note_tick(time.perf_counter() - t0, 0)
+            self._note_tick(time.perf_counter() - t0, 0,
+                            self._tick_dev_s or None)
             return done
         if self.draft is not None:
+            td0 = time.perf_counter()
             added = self._spec_round()
-            self._note_tick(time.perf_counter() - t0, added)
+            # the whole round counts as device window (its launches are
+            # back-to-back; the python glue between them is noise here)
+            self._tick_dev_s += time.perf_counter() - td0
+            self._note_tick(time.perf_counter() - t0, added,
+                            self._tick_dev_s)
             return done
+        td0 = time.perf_counter()
         logits, self.state = paged_decode_step(
             self.params, jnp.asarray(self._next_tok), self.state, self.cfg,
             mesh=self.mesh)
-        toks = self._sample(logits)
+        toks = self._sample(logits)  # host sync: the device window ends here
+        self._tick_dev_s += time.perf_counter() - td0
         added = 0
         for slot, req in enumerate(self.slots):
             if req is None:
@@ -511,7 +569,7 @@ class ServeEngine:
                 self.journal.tokens(req.rid, [int(toks[slot])])
             self._next_tok[slot] = int(toks[slot])
             added += 1
-        self._note_tick(time.perf_counter() - t0, added)
+        self._note_tick(time.perf_counter() - t0, added, self._tick_dev_s)
         return done
 
     def _spec_round(self) -> int:

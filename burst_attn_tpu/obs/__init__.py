@@ -36,6 +36,13 @@ from .spans import (
     span, span_records, traced,
 )
 from .logs import dropped_messages, get_logger, safe_warn
+# request tracing: per-request causal timelines (TraceContext propagation,
+# tail-sampled trees, TTFT critical-path analyzer).  OFF by default; the
+# submodule import keeps span-vs-trace naming explicit at call sites
+# (`trace.record_span`), so only the submodule and its context type are
+# re-exported here.
+from . import trace
+from .trace import TraceContext
 # devstats is the deliberately IN-JIT half of obs: a purely functional
 # telemetry pytree the ring accumulates in-graph (collect_stats=True) and
 # publishes host-side afterwards.  burstlint's obs-jit-safe AST rule
@@ -82,22 +89,25 @@ def export_jsonl(path: str) -> str:
     fsynced, tagged with this process's `process_index` so per-process
     files merge cleanly (`python -m burst_attn_tpu.obs --merge`).  This is
     the artifact `python -m burst_attn_tpu.obs` reads."""
+    extra = (span_records() + trace.trace_records()
+             + trace.exemplar_records())
     return default_registry().export_jsonl(path,
-                                           extra_records=span_records(),
+                                           extra_records=extra,
                                            process_index=_process_index())
 
 
 def reset() -> None:
-    """Clear the default registry and span buffer (tests only)."""
+    """Clear the default registry, span and trace buffers (tests only)."""
     default_registry().reset()
     reset_spans()
+    trace.reset_traces()
 
 
 __all__ = [
     "Counter", "DevStats", "Gauge", "Histogram", "Registry", "Span",
-    "StepTimer", "LATENCY_BUCKETS_S", "annotate", "completed_spans",
-    "counter", "current_span", "default_registry", "devstats",
-    "dropped_messages", "export_jsonl", "gauge", "get_logger", "histogram",
-    "reset", "reset_spans", "safe_warn", "snapshot", "span", "span_records",
-    "to_prometheus", "traced",
+    "StepTimer", "LATENCY_BUCKETS_S", "TraceContext", "annotate",
+    "completed_spans", "counter", "current_span", "default_registry",
+    "devstats", "dropped_messages", "export_jsonl", "gauge", "get_logger",
+    "histogram", "reset", "reset_spans", "safe_warn", "snapshot", "span",
+    "span_records", "to_prometheus", "trace", "traced",
 ]

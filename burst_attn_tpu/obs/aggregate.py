@@ -13,6 +13,10 @@ north star needs — with Prometheus-style semantics per metric kind:
               same-binary job); edge-mismatched children fall back to
               per-process children with a `process_index` label
   spans       concatenated, each tagged `process_index`
+  traces      joined by trace_id across processes (deterministic span ids
+              dedup re-exports); `build_trace_trees` folds them into
+              per-request trees flagged for completeness/truncation
+  exemplars   worst-value-wins per (metric, bucket)
 
 `--by-process` skips the cross-process arithmetic entirely: every metric
 child keeps its own `process_index` label (the per-process drill-down view).
@@ -122,15 +126,30 @@ def merge_processes(states, by_process: bool = False):
     renderers consume.  See the module docstring for per-kind semantics."""
     metrics: Dict[tuple, dict] = {}
     spans: List[dict] = []
+    traces: Dict[tuple, dict] = {}
+    exemplars: Dict[tuple, dict] = {}
+    truncated_procs: List[str] = []
     n_snapshots = 0
     n_truncated = 0
     last_ts = ""
     for proc, proc_metrics, proc_spans, proc_meta in states:
         n_snapshots += proc_meta.get("snapshots", 0)
         n_truncated += proc_meta.get("truncated_lines", 0)
+        if proc_meta.get("truncated_lines"):
+            truncated_procs.append(proc)
         last_ts = max(last_ts, proc_meta.get("last_ts_utc", ""))
         for rec in proc_spans:
             spans.append(dict(rec, process_index=proc))
+        for rec in proc_meta.get("traces", ()):
+            # trace spans join ACROSS processes by trace_id; span ids are
+            # deterministic per tree, so cross-export re-reads dedup here
+            key = (rec.get("trace_id"), rec.get("span_id"))
+            traces.setdefault(key, dict(rec, process_index=proc))
+        for rec in proc_meta.get("exemplars", ()):
+            key = (rec.get("metric"), rec.get("le"))
+            have = exemplars.get(key)
+            if have is None or rec.get("value", 0) >= have.get("value", 0):
+                exemplars[key] = rec
         for rec in proc_metrics:
             kind = rec["kind"]
             if by_process or kind == "gauge":
@@ -171,9 +190,43 @@ def merge_processes(states, by_process: bool = False):
         "process_labels": [s[0] for s in states],
         "n_metrics": len(metrics),
         "n_spans": len(spans),
+        "n_traces": len({t.get("trace_id") for t in traces.values()}),
         "truncated_lines": n_truncated,
+        "truncated_processes": truncated_procs,
+        "traces": list(traces.values()),
+        "exemplars": list(exemplars.values()),
     }
     return list(metrics.values()), spans, meta
+
+
+def build_trace_trees(traces, truncated_processes=()):
+    """Group merged trace records into per-request trees, joined by
+    trace_id.  Each tree is
+    {"trace_id", "spans" (by start time), "complete", "truncated"}:
+
+      complete   the tree has a root (parent_id None) and every span's
+                 parent resolves within the tree — the cross-process join
+                 actually closed.
+      truncated  some contributing process's export lost its final line
+                 (the SIGKILL signature `load_records_tolerant` skips) —
+                 the tree is read as partial-but-flagged, never silently
+                 whole.
+    """
+    truncated = {str(p) for p in truncated_processes}
+    by_trace: Dict[str, List[dict]] = {}
+    for rec in traces:
+        by_trace.setdefault(rec.get("trace_id"), []).append(rec)
+    trees = []
+    for trace_id in sorted(by_trace, key=str):
+        spans = sorted(by_trace[trace_id], key=lambda s: s.get("start_s", 0))
+        ids = {s.get("span_id") for s in spans}
+        complete = (any(s.get("parent_id") is None for s in spans)
+                    and all(s.get("parent_id") in ids for s in spans
+                            if s.get("parent_id") is not None))
+        torn = any(str(s.get("process_index")) in truncated for s in spans)
+        trees.append({"trace_id": trace_id, "spans": spans,
+                      "complete": complete, "truncated": torn})
+    return trees
 
 
 def merge_files(patterns: Sequence[str], by_process: bool = False):

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..obs import trace as tracing
 from ..admission import (
     AdmissionPolicy, InvalidRequest, LoadShed, RejectReason, SubmitRejected,
     SubmitResult,
@@ -74,6 +75,12 @@ _M_POOL = obs.gauge("serve.page_pool_occupancy",
 _M_SPEC_RATE = obs.gauge("serve.spec_acceptance_rate")
 _M_TTFT = obs.histogram("serve.ttft_s")
 _M_TOK_LAT = obs.histogram("serve.token_latency_s")
+# host time the tick spent OUTSIDE the device launch+sample window, as a
+# fraction of launch-tick wall time (cumulative) — the gap ROADMAP item 3's
+# async pipelining is gated against.  Always on: host clock reads never
+# touch the jaxpr, so the tick's trace stays bit-identical.
+_M_HOST_GAP = obs.gauge("serve.host_gap_fraction",
+                        "host gap seconds / launch-tick wall seconds")
 # ragged-batch family: what each one-launch batch carried
 _M_RB_LAUNCH = obs.counter("serve.ragged_batch_launches",
                            "one-kernel ragged launches, by batch kind")
@@ -315,8 +322,12 @@ class RaggedServeEngine:
                              f"pool_occupancy={occ:.3f}")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append(_Request(rid, tokens, max_new_tokens,
-                                    t_submit=time.perf_counter()))
+        req = _Request(rid, tokens, max_new_tokens,
+                       t_submit=time.perf_counter())
+        # attribute, not a dataclass field — checkpoint serialization must
+        # not see the trace context (same contract as _prefix_hashes)
+        req._tc = tracing.start_request(rid)
+        self._queue.append(req)
         _M_SUBMITTED.inc()
         _M_QUEUE.set(len(self._queue))
         return rid
@@ -519,6 +530,11 @@ class RaggedServeEngine:
             self.slots[slot] = req
             _M_ADMITTED.inc()
             _M_QUEUE.set(len(self._queue))
+            tc = getattr(req, "_tc", None)
+            if tc is not None:
+                req._t_admit = time.perf_counter()
+                tracing.record_span(tc, "serve.queued", req.t_submit,
+                                    req._t_admit)
 
     def _cow_barrier(self, q_lens) -> None:
         """Privatize every page the imminent launch will scatter into
@@ -604,6 +620,15 @@ class RaggedServeEngine:
                 if self.journal is not None:
                     self.journal.done(req.rid)
                 _M_RETIRED.inc(cause="eos" if hit_eos else "budget")
+                tc = getattr(req, "_tc", None)
+                if tc is not None:
+                    now = time.perf_counter()
+                    tracing.record_span(
+                        tc, "serve.decode",
+                        getattr(req, "_t_first", req.t_submit), now,
+                        tokens=len(req.tokens))
+                    tracing.record_span(tc, "serve.request", req.t_submit,
+                                        now, root=True, rid=req.rid)
         if done:
             # retirement frees pages AFTER the tick's _note_tick ran; keep
             # the gauges honest so a drained engine reads occupancy 0
@@ -611,7 +636,15 @@ class RaggedServeEngine:
             self._set_pool_gauges()
         return done
 
-    def _note_tick(self, dt: float, added: int) -> None:
+    def _note_tick(self, dt: float, added: int,
+                   dev_s: Optional[float] = None) -> None:
+        # dev_s = the tick's device launch+sample window; the remainder is
+        # host gap, folded into the cumulative serve.host_gap_fraction gauge
+        if dev_s is not None:
+            self._host_gap_s = getattr(self, "_host_gap_s", 0.0) \
+                + max(0.0, dt - dev_s)
+            self._launch_wall_s = getattr(self, "_launch_wall_s", 0.0) + dt
+            _M_HOST_GAP.set(self._host_gap_s / self._launch_wall_s)
         _M_STEPS.inc()
         _M_QUEUE.set(len(self._queue))
         live = self.live
@@ -656,8 +689,12 @@ class RaggedServeEngine:
         prefilling = [s for s, r in enumerate(self.slots)
                       if r is not None and r.n_prefilled < len(r.prompt)]
         if self.draft is not None and not prefilling:
+            td0 = time.perf_counter()
             added = self._spec_round()
-            self._note_tick(time.perf_counter() - t0, added)
+            # the whole round counts as device window (its launches are
+            # back-to-back; the python glue between them is noise here)
+            self._note_tick(time.perf_counter() - t0, added,
+                            time.perf_counter() - td0)
             done += self._retire_finished()
             return done
 
@@ -678,6 +715,7 @@ class RaggedServeEngine:
                 toks[slot, 0] = self._next_tok[slot]
                 q_lens[slot] = 1
         self._cow_barrier(q_lens)
+        td0 = time.perf_counter()  # device window: launch through sample sync
         attn = self._attn_for(qt)
         groups = (self._build_groups()
                   if self.group_attn and self._shared and attn == "ragged"
@@ -693,6 +731,7 @@ class RaggedServeEngine:
                 self.params, jnp.asarray(toks), jnp.asarray(q_lens),
                 self.state, self.cfg, attn=attn)
         choice = self._sample(logits)
+        dev_s = time.perf_counter() - td0
 
         kind = ("mixed" if prefilling and len(prefilling) < self.live
                 else "prefill" if prefilling else "decode")
@@ -724,7 +763,22 @@ class RaggedServeEngine:
                         self.journal.tokens(req.rid, [tok])
                     self._next_tok[slot] = tok
                     added += 1
-                    _M_TTFT.observe(time.perf_counter() - req.t_submit)
+                    now = time.perf_counter()
+                    _M_TTFT.observe(now - req.t_submit)
+                    tc = getattr(req, "_tc", None)
+                    if tc is not None:
+                        # contiguous phases on one clock: queued ends where
+                        # prefill starts, prefill ends at the first-token
+                        # instant — the breakdown sums to TTFT exactly
+                        t_adm = getattr(req, "_t_admit", req.t_submit)
+                        req._t_first = now
+                        tracing.record_span(tc, "serve.prefill", t_adm, now,
+                                            prompt_len=len(req.prompt))
+                        tracing.marker(tc, "serve.first_token", now)
+                        tracing.note_ttft(tc, now - req.t_submit)
+                        tracing.publish_breakdown(
+                            {"queued": t_adm - req.t_submit,
+                             "prefill": now - t_adm})
             else:
                 tok = int(choice[slot])
                 req.tokens.append(tok)
@@ -742,7 +796,7 @@ class RaggedServeEngine:
             _, self.dstate = ragged_model_step(
                 dp, jnp.asarray(dtoks[:, None]), jnp.asarray(dlens),
                 self.dstate, dc, attn="dense")
-        self._note_tick(time.perf_counter() - t0, added)
+        self._note_tick(time.perf_counter() - t0, added, dev_s)
         done += self._retire_finished()
         return done
 

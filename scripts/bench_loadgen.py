@@ -129,6 +129,14 @@ def main(argv=None) -> int:
         _registry_records(), duration_s=report.duration_v,
         completed_tokens=report.completed_tokens, n_done=report.n_done,
         n_rejected=report.n_rejected)
+    # the host-gap gauge (host seconds the serve loop spent OUTSIDE the
+    # device launch window, as a fraction of tick wall time) must ride
+    # every bench export — it is the steering signal for launch-overhead
+    # regressions
+    assert any(r.get("name") == "serve.host_gap_fraction"
+               for r in _registry_records()), \
+        "serve.host_gap_fraction missing from the registry export"
+    slo["host_gap_fraction"] = obs.gauge("serve.host_gap_fraction").get()
 
     oracle = oracle_replay(
         trace, lambda: build_engine(model_spec,
@@ -390,8 +398,12 @@ def _fleet_phase(args) -> int:
     with FleetCluster(model_spec, prefill_spec=pspec, decode_spec=dspec,
                       n_prefill=1, n_decode=2,
                       out_dir=os.path.join(args.out, "fleet_bench"),
-                      transport="queue", checkpoint_every=1) as fc:
+                      transport="queue", checkpoint_every=1,
+                      trace=True) as fc:
         rep = fc.replay(trace, speed=args.speed, max_wall_s=600.0)
+    # after __exit__: the workers' final obs exports (which carry their
+    # trace spans) have flushed, so the cross-process join can close
+    _assert_fleet_trace_tree(fc)
     check(rep)
     tokens = sum(len(o.tokens) for o in rep.outcomes.values())
     goodput = tokens / rep.wall_s if rep.wall_s > 0 else 0.0
@@ -444,6 +456,37 @@ def _fleet_phase(args) -> int:
           f"resumed={krep.recovered_tokens_resumed} "
           f"replayed={krep.recovered_tokens_replayed}")
     return 0
+
+
+def _assert_fleet_trace_tree(fc) -> None:
+    """The tracing acceptance bar: the clean --fleet replay must yield at
+    least one COMPLETE cross-process trace tree (router dispatch ->
+    prefill -> KV transfer -> decode) whose phase decomposition sums to
+    the analyzer's TTFT within 1%."""
+    from burst_attn_tpu.obs.aggregate import build_trace_trees
+    from burst_attn_tpu.obs.trace import ttft_breakdown
+
+    _metrics, _spans, meta = fc.merged()
+    trees = build_trace_trees(meta.get("traces", ()),
+                              meta.get("truncated_processes", ()))
+    need = {"fleet.request", "fleet.prefill", "fleet.ship",
+            "fleet.transfer", "fleet.commit", "fleet.decode"}
+    ok = 0
+    for t in trees:
+        names = {s["name"] for s in t["spans"]}
+        procs = {str(s.get("process_index")) for s in t["spans"]}
+        bd = ttft_breakdown(t["spans"])
+        if not (t["complete"] and need <= names and len(procs) >= 2
+                and bd and bd["ttft_s"] > 0):
+            continue
+        drift = abs(sum(bd["phases"].values()) - bd["ttft_s"])
+        assert drift <= 0.01 * bd["ttft_s"], (t["trace_id"], drift, bd)
+        ok += 1
+    assert ok >= 1, (
+        f"no complete cross-process fleet trace tree among {len(trees)} "
+        f"(need spans {sorted(need)} over >=2 processes)")
+    print(f"bench_loadgen: fleet tracing — {ok}/{len(trees)} complete "
+          "cross-process trees, breakdown sums within 1% of TTFT")
 
 
 def _registry_records():

@@ -59,6 +59,74 @@ for p in range(2):
     r.export_jsonl(f"{tmp}/obs_{p}.jsonl", process_index=p)
 PY
   python -m burst_attn_tpu.obs --merge "$obs_tmp/obs*.jsonl" > /dev/null
+  # request-tracing smoke (ISSUE 19): a tiny traced fleet burst must yield
+  # >= 1 COMPLETE cross-stage trace tree (router -> prefill -> KV transfer
+  # -> decode, spanning >= 2 processes) whose phase breakdown sums to the
+  # TTFT within tolerance; then the CLI renders the trees and one
+  # waterfall from the merged per-process exports.  Written to a real file
+  # (not stdin) so multiprocessing spawn can re-import __main__.
+  cat > "$obs_tmp/trace_smoke.py" <<'PY'
+import os
+import sys
+
+# the script lives in a tmp dir: put the invoking repo root (cwd) on the
+# path; spawn children inherit sys.path, so the workers resolve it too
+sys.path.insert(0, os.getcwd())
+
+
+def main():
+    tmp = sys.argv[1]
+    from burst_attn_tpu.fleet import FleetCluster
+    from burst_attn_tpu.loadgen.trace import Trace, TraceRequest
+    from burst_attn_tpu.obs.aggregate import build_trace_trees
+    from burst_attn_tpu.obs.trace import ttft_breakdown
+
+    model = dict(vocab=97, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                 d_head=16, d_ff=64, block_q=8, block_kv=8, seed=0)
+    reqs = [TraceRequest(rid=i, t_arrival=0.05 * i, prompt_len=128,
+                         prompt_seed=100 + i, max_new_tokens=4)
+            for i in range(2)]
+    trace = Trace(meta={"vocab": 97}, requests=reqs)
+    with FleetCluster(model,
+                      prefill_spec=dict(sp=2, page=128, n_pages=4,
+                                        max_pages_per_seq=8),
+                      decode_spec=dict(sp=2, slots=2, page=128, n_pages=8,
+                                       max_pages_per_seq=4),
+                      n_prefill=1, n_decode=1, out_dir=tmp,
+                      transport="queue", trace=True) as fc:
+        rep = fc.replay(trace, speed=25.0, max_wall_s=420.0)
+    assert all(o.status == "done" for o in rep.outcomes.values()), rep.outcomes
+    # workers flush their final export at shutdown: merge AFTER the exit
+    _metrics, _spans, meta = fc.merged()
+    trees = build_trace_trees(meta["traces"],
+                              meta.get("truncated_processes", ()))
+    need = {"fleet.request", "fleet.prefill", "fleet.ship", "fleet.transfer",
+            "fleet.commit", "fleet.decode"}
+    ok = []
+    for t in trees:
+        bd = ttft_breakdown(t["spans"])
+        procs = {str(s.get("process_index")) for s in t["spans"]}
+        if (t["complete"] and need <= {s["name"] for s in t["spans"]}
+                and len(procs) >= 2 and bd
+                and abs(sum(bd["phases"].values()) - bd["ttft_s"])
+                <= 0.01 * bd["ttft_s"]):
+            ok.append(t["trace_id"])
+    assert ok, [(t["trace_id"], t["complete"],
+                 sorted({s["name"] for s in t["spans"]})) for t in trees]
+    print(f"obs --trace smoke: {len(ok)}/{len(trees)} complete "
+          f"cross-stage tree(s)")
+    with open(f"{tmp}/trace_id", "w") as f:
+        f.write(ok[0])
+
+
+if __name__ == "__main__":
+    main()
+PY
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python "$obs_tmp/trace_smoke.py" "$obs_tmp"
+  python -m burst_attn_tpu.obs --trace --merge "$obs_tmp/obs_*.jsonl"
+  python -m burst_attn_tpu.obs --waterfall "$(cat "$obs_tmp/trace_id")" \
+    --merge "$obs_tmp/obs_*.jsonl"
   python scripts/check_regression.py --dry-run
 elif [[ $serve == 1 ]]; then
   # focused lane for the ragged paged serving subsystem: the one-launch

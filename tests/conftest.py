@@ -146,6 +146,7 @@ _SLOW = {
     ("test_fleet.py", "test_fleet_hang_heartbeat_both_pools"),
     ("test_fleet.py", "test_fleet_prefill_kill_reruns_on_sibling"),
     ("test_fleet.py", "test_fleet_autoscale_up_on_pressure_down_on_idle"),
+    ("test_fleet.py", "test_fleet_trace_tree_cross_process_breakdown"),
     ("test_window.py", "test_burst_ring_contig_window"),
     ("test_window.py", "test_dist_decode_window_matches_single_chip"),
     ("test_window.py", "test_burst_ring_window_grad"),

@@ -475,6 +475,54 @@ def test_obs_devstats_exempt_from_ast_rule(tmp_path):
     assert got == [("obs-jit-safe", 10)], [f.format() for f in findings]
 
 
+def test_obs_trace_api_in_jit_fires(tmp_path):
+    """The obs.trace request-tracing API is under the same jit-safety
+    contract as registry/spans: direct submodule calls AND module-level
+    aliases of the submodule or its functions must fire under jit."""
+    findings = _lint_fixture(tmp_path, """\
+        import jax
+        from burst_attn_tpu.obs import trace as tracing
+
+        T = tracing
+        _rec = tracing.record_span
+
+        @jax.jit
+        def f(x, tc):
+            tracing.record_span(tc, "p", 0.0, 1.0)
+            T.marker(tc, "m", 0.0)
+            _rec(tc, "q", 0.0, 1.0)
+            return x
+    """)
+    got = sorted((f.rule, f.line) for f in findings
+                 if f.rule == "obs-jit-safe")
+    assert got == [("obs-jit-safe", 9), ("obs-jit-safe", 10),
+                   ("obs-jit-safe", 11)], [f.format() for f in findings]
+
+
+def test_obs_trace_host_boundary_is_quiet(tmp_path):
+    """The sanctioned pattern — trace calls at the host dispatch
+    boundary around a jit-compiled step — stays clean, and an alias of
+    a NON-obs module does not poison the binding set."""
+    findings = _lint_fixture(tmp_path, """\
+        import json
+        import jax
+        from burst_attn_tpu.obs import trace as tracing
+
+        J = json
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def dispatch(x, tc):
+            with tracing.span(tc, "dispatch"):
+                y = step(x)
+            J.dumps({})
+            return y
+    """)
+    assert [f for f in findings if f.rule == "obs-jit-safe"] == []
+
+
 # ---------------------------------------------------------------------------
 # devstats-pure mutations (jaxpr)
 

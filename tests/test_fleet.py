@@ -342,3 +342,82 @@ def test_fleet_autoscale_up_on_pressure_down_on_idle(tmp_path):
     assert ups and downs, rep.scale_events
     # boot-time pressure must not overshoot the cap
     assert len(ups) - len(downs) <= 1, rep.scale_events
+
+
+# -- request tracing (ISSUE 19) ---------------------------------------------
+
+
+def test_fleet_canary_dispatch_wire_zero_cost_when_untraced():
+    """The trace context rides the dispatch tuple as an OPTIONAL trailing
+    element: with tracing off the tuple — and therefore every frame —
+    encodes byte-identical to the pre-tracing wire format."""
+    from burst_attn_tpu.fleet.fleet import _dispatch_msg
+    from burst_attn_tpu.obs.trace import TraceContext
+
+    prompt = [3, 1, 4, 1, 5]
+    for force_json in (False, True):
+        # untraced, no resume: the historical 4-tuple, byte-for-byte
+        assert tp.encode_message(_dispatch_msg(7, prompt, 4),
+                                 force_json=force_json) \
+            == tp.encode_message(("prefill", 7, prompt, 4),
+                                 force_json=force_json)
+        # untraced resume: the historical 5-tuple
+        assert tp.encode_message(_dispatch_msg(7, prompt, 4,
+                                               resume=[9, 9]),
+                                 force_json=force_json) \
+            == tp.encode_message(("prefill", 7, prompt, 4, [9, 9]),
+                                 force_json=force_json)
+    # traced: context appended LAST, after an (empty) resume placeholder,
+    # and survives the codec + framing round trip
+    tc = TraceContext("fleet-1-r7-1")
+    msg = _dispatch_msg(7, prompt, 4, trace_wire=tc.to_wire())
+    assert len(msg) == 6 and msg[4] == []
+    back = tp.decode_message(tp.unpack_frame(tp.pack_frame(
+        tp.encode_message(msg))))
+    got = TraceContext.from_wire(back[5])
+    assert got.trace_id == "fleet-1-r7-1" and got.span_id == "request"
+    # traced resume keeps both
+    msg = _dispatch_msg(7, prompt, 4, resume=[9], trace_wire=tc.to_wire())
+    assert msg[4] == [9] and msg[5] == tc.to_wire()
+
+
+def test_fleet_trace_tree_cross_process_breakdown(tmp_path):
+    """ISSUE 19 acceptance: a traced fleet replay yields complete trace
+    trees spanning router -> prefill -> KV transfer -> decode across
+    processes, with the phase decomposition summing to the measured TTFT
+    within 1% — and the run stays token-exact against the oracle."""
+    from burst_attn_tpu.obs.aggregate import build_trace_trees
+    from burst_attn_tpu.obs.trace import ttft_breakdown
+
+    trace = _trace(3, seed0=500, max_new=4)
+    oracle_toks, _ = fleet_oracle(trace, MODEL_SPEC, prefill_spec=PSPEC,
+                                  decode_spec=DSPEC)
+    with FleetCluster(MODEL_SPEC, prefill_spec=PSPEC, decode_spec=DSPEC,
+                      n_prefill=1, n_decode=1, out_dir=str(tmp_path),
+                      transport="queue", trace=True) as fc:
+        rep = fc.replay(trace, speed=25.0, max_wall_s=420.0)
+    _assert_token_exact(rep, oracle_toks)
+    # workers flush their final obs export at shutdown: merge AFTER exit
+    _metrics, _spans, meta = fc.merged()
+    trees = build_trace_trees(meta.get("traces", ()),
+                              meta.get("truncated_processes", ()))
+    need = {"fleet.request", "fleet.first_token", "fleet.prefill",
+            "fleet.ship", "fleet.transfer", "fleet.commit", "fleet.decode"}
+    ok = 0
+    for tree in trees:
+        names = {s["name"] for s in tree["spans"]}
+        procs = {str(s.get("process_index")) for s in tree["spans"]}
+        bd = ttft_breakdown(tree["spans"])
+        if not (tree["complete"] and need <= names and len(procs) >= 2
+                and bd and bd["ttft_s"] > 0):
+            continue
+        assert abs(sum(bd["phases"].values()) - bd["ttft_s"]) \
+            <= 0.01 * bd["ttft_s"], (tree["trace_id"], bd)
+        ok += 1
+    assert ok >= 1, [(t["trace_id"], t["complete"],
+                      sorted({s["name"] for s in t["spans"]}))
+                     for t in trees]
+    # the router's TTFT exemplars deep-link real trees
+    tree_ids = {t["trace_id"] for t in trees}
+    assert any(e["metric"] == "fleet.ttft_s" and e["trace_id"] in tree_ids
+               for e in meta.get("exemplars", ()))

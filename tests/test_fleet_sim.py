@@ -479,3 +479,53 @@ def test_sim_1000_replicas_1m_requests_under_60s_deterministic():
         assert rep.events >= 3_000_000
         digests.append(rep.event_log_sha256)
     assert digests[0] == digests[1]
+
+
+def test_sim_trace_records_deterministic_and_digest_untouched():
+    """ISSUE 19: the simulator emits the same trace-record schema as the
+    live engines from its VIRTUAL clock — runs are bit-deterministic,
+    trace ids are seed-derived (no RNG, no pids), breakdowns sum to the
+    virtual TTFT exactly, and the event-log digest is identical with
+    tracing on or off (tracing observes the simulation, never perturbs
+    it)."""
+    from burst_attn_tpu.obs import trace as tracing
+    from burst_attn_tpu.obs.aggregate import build_trace_trees
+    from burst_attn_tpu.obs.trace import ttft_breakdown
+
+    tr = _toy_trace(40, dt=0.001, max_new=4)
+
+    def run():
+        return sim.simulate(tr, fleet_policy.POLICIES["least_loaded"],
+                            n_replicas=2, slots=4, n_prefill=1,
+                            rates=TOY_RATES)
+
+    tracing.reset_traces()
+    base = run()
+    assert tracing.trace_records() == []     # off by default: zero records
+
+    runs = []
+    for _ in range(2):
+        tracing.enable()
+        try:
+            rep = run()
+            runs.append((rep.event_log_sha256, tracing.trace_records(),
+                         tracing.exemplar_records()))
+        finally:
+            tracing.reset_traces()
+    assert runs[0][0] == runs[1][0] == base.event_log_sha256
+    assert runs[0][1] == runs[1][1] and runs[0][1]
+
+    trees = build_trace_trees(runs[0][1])
+    by_id = {t["trace_id"]: t for t in trees}
+    assert "sim0-r0" in by_id               # deterministic seed-derived ids
+    need = {"sim.queued", "sim.prefill", "sim.ship", "sim.first_token",
+            "sim.decode", "sim.request"}
+    for tree in trees:
+        assert tree["complete"]
+        assert need <= {s["name"] for s in tree["spans"]}
+        assert all(s["clock"] == "virtual" for s in tree["spans"])
+        bd = ttft_breakdown(tree["spans"])
+        assert bd["clock"] == "virtual"
+        assert sum(bd["phases"].values()) == pytest.approx(bd["ttft_s"],
+                                                           abs=1e-9)
+    assert any(e["metric"] == "sim.ttft_s" for e in runs[0][2])

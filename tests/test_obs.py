@@ -596,3 +596,268 @@ def test_merge_tolerates_truncated_final_line_only(tmp_path):
     only_bad.write_text("garbage\n")
     with pytest.raises(ValueError):
         load_records_tolerant(str(only_bad))
+
+
+def test_torn_final_line_trace_tree_partial_but_flagged(tmp_path):
+    """ISSUE 19 satellite: a process SIGKILLed mid-export leaves a torn
+    final JSONL line — its trace spans that DID land still join the
+    cross-process tree, but every tree touching the torn process reads
+    as partial-but-flagged (`truncated`), never silently whole; a tree
+    whose joining span was ON the lost line additionally drops
+    `complete`."""
+    from burst_attn_tpu.obs.aggregate import build_trace_trees, merge_files
+    from burst_attn_tpu.obs.registry import Registry
+
+    def write(path, proc, spans):
+        recs = [dict(kind="trace", trace_id=t, span_id=s, parent_id=par,
+                     name=s, start_s=a, duration_s=b - a, clock="wall",
+                     attrs={})
+                for (t, s, par, a, b) in spans]
+        Registry().export_jsonl(str(path), extra_records=recs,
+                                process_index=proc)
+
+    # router (proc 0): roots + first-token markers for two requests
+    write(tmp_path / "obs_r.jsonl", 0,
+          [("t1", "request", None, 0.0, 1.0),
+           ("t1", "fleet.first_token", "request", 0.9, 0.9),
+           ("t2", "request", None, 0.0, 1.0)])
+    # worker (proc 1): t1's phase span lands whole; t2's decode span
+    # hangs off a span the torn final line would have carried
+    write(tmp_path / "obs_w.jsonl", 1,
+          [("t1", "fleet.prefill", "request", 0.1, 0.5),
+           ("t2", "fleet.decode", "fleet.transfer", 0.2, 0.8)])
+    with open(tmp_path / "obs_w.jsonl", "a", encoding="utf-8") as f:
+        f.write('{"kind": "trace", "trace_id": "t2", "span_id": "fleet.tr')
+    _metrics, _spans, meta = merge_files([str(tmp_path / "obs_*.jsonl")])
+    assert meta["truncated_lines"] == 1
+    assert meta["truncated_processes"] == ["1"]
+    trees = {t["trace_id"]: t
+             for t in build_trace_trees(meta["traces"],
+                                        meta["truncated_processes"])}
+    # t1: every span landed, but a contributing process lost its tail
+    assert trees["t1"]["complete"] and trees["t1"]["truncated"]
+    # t2: the lost line held the joining span — partial AND flagged
+    assert not trees["t2"]["complete"] and trees["t2"]["truncated"]
+    # and the span that did land is still in the partial tree
+    assert [s["name"] for s in trees["t2"]["spans"]] \
+        == ["request", "fleet.decode"]
+
+
+# ---------------------------------------------------------------------------
+# request tracing (obs/trace.py)
+
+
+def test_trace_off_by_default_records_nothing():
+    from burst_attn_tpu.obs import trace as tracing
+
+    tracing.reset_traces()
+    assert not tracing.enabled()
+    assert tracing.start_request(1) is None
+    tc = tracing.TraceContext("t-off")
+    tracing.record_span(tc, "serve.prefill", 0.0, 1.0)
+    tracing.marker(tc, "serve.first_token", 0.5)
+    tracing.note_ttft(tc, 0.5)
+    with tracing.span(tc, "serve.decode"):
+        pass
+    assert tracing.trace_records() == []
+    assert tracing.exemplar_records() == []
+
+
+def test_trace_context_wire_roundtrip_and_garbage():
+    from burst_attn_tpu.obs import trace as tracing
+
+    tracing.enable()
+    try:
+        tc = tracing.start_request(7, prefix="fleet")
+        assert tc.trace_id.startswith("fleet-") and "-r7-" in tc.trace_id
+        assert tc.span_id == "request" and tc.parent_id is None
+        back = tracing.TraceContext.from_wire(tc.to_wire())
+        assert (back.trace_id, back.span_id) == (tc.trace_id, tc.span_id)
+        # a peer without tracing never attaches a context; a garbled one
+        # must degrade to "no trace", never to an exception
+        for garbage in (None, [], ["half"], "a-string", 7, {"t": 1}):
+            assert tracing.TraceContext.from_wire(garbage) is None
+        # concurrent requests never share a trace_id
+        assert tracing.start_request(7).trace_id != tc.trace_id
+    finally:
+        tracing.reset_traces()
+
+
+def test_trace_record_span_ids_and_jit_guard():
+    from burst_attn_tpu.obs import trace as tracing
+
+    tracing.enable()
+    try:
+        tc = tracing.start_request(3)
+        tracing.record_span(tc, "serve.queued", 1.0, 2.0)
+        tracing.record_span(tc, "serve.request", 0.5, 3.0, root=True, rid=3)
+        tracing.record_span(tc, "serve.clip", 2.0, 1.0)  # end < start clips
+
+        @jax.jit
+        def step(x):
+            # runtime belt to burstlint's AST brace: a trace-record call
+            # reached from inside a jax trace is a no-op, never a leak
+            tracing.record_span(tc, "bad.span", 0.0, 1.0)
+            tracing.note_ttft(tc, 99.0)
+            return x + 1
+
+        step(jnp.ones(2))
+        recs = tracing.trace_records()
+        assert [r["name"] for r in recs] \
+            == ["serve.queued", "serve.request", "serve.clip"]
+        child, root, clip = recs
+        # child spans get deterministic name-based ids under the context
+        assert (child["span_id"], child["parent_id"]) \
+            == ("serve.queued", "request")
+        assert (root["span_id"], root["parent_id"]) == ("request", None)
+        assert root["attrs"] == {"rid": 3}
+        assert clip["duration_s"] == 0.0
+        assert all(ex["value"] != 99.0 for ex in tracing.exemplar_records())
+    finally:
+        tracing.reset_traces()
+
+
+def test_ttft_breakdown_gap_and_exact_sum():
+    from burst_attn_tpu.obs.trace import ttft_breakdown
+
+    def rec(span_id, parent, name, a, b):
+        return dict(trace_id="t", span_id=span_id, parent_id=parent,
+                    name=name, start_s=a, duration_s=b - a, clock="wall")
+
+    spans = [
+        rec("request", None, "serve.request", 10.0, 15.0),
+        rec("serve.queued", "request", "serve.queued", 10.0, 11.0),
+        rec("serve.prefill", "request", "serve.prefill", 11.5, 12.5),
+        rec("serve.first_token", "request", "serve.first_token", 12.5, 12.5),
+        # decode starts AT first token: clipped out of the breakdown
+        rec("serve.decode", "request", "serve.decode", 12.5, 15.0),
+        # grandchild: not a direct child of the root, never a phase
+        rec("detail", "serve.prefill", "serve.detail", 11.6, 12.0),
+    ]
+    bd = ttft_breakdown(spans)
+    assert bd["ttft_s"] == pytest.approx(2.5)
+    assert bd["clock"] == "wall"
+    assert bd["phases"]["queued"] == pytest.approx(1.0)
+    assert bd["phases"]["prefill"] == pytest.approx(1.0)
+    assert bd["phases"]["gap"] == pytest.approx(0.5)   # 11.0 .. 11.5
+    assert "decode" not in bd["phases"] and "detail" not in bd["phases"]
+    # phases sum to the TTFT by construction, not within a tolerance
+    assert sum(bd["phases"].values()) == pytest.approx(bd["ttft_s"],
+                                                       abs=1e-12)
+    # no first-token marker: TTFT falls back to the root span's end
+    no_ft = [s for s in spans if not s["name"].endswith("first_token")]
+    assert ttft_breakdown(no_ft)["ttft_s"] == pytest.approx(5.0)
+    # rootless tree (torn merge) yields None, not a crash
+    assert ttft_breakdown([s for s in spans if s["parent_id"]]) is None
+
+
+def test_note_ttft_exemplar_worst_wins_and_bucket_edges():
+    from burst_attn_tpu.obs import trace as tracing
+
+    # bucket edges come from the registered histogram when one exists
+    obs.histogram("test.trace.ttft_s", buckets=(0.1, 1.0))
+    tracing.enable()
+    try:
+        tracing.note_ttft("trace-a", 0.4, metric="test.trace.ttft_s")
+        tracing.note_ttft("trace-b", 0.6, metric="test.trace.ttft_s")
+        tracing.note_ttft("trace-c", 0.5, metric="test.trace.ttft_s")
+        tracing.note_ttft("trace-d", 7.0, metric="test.trace.ttft_s")
+        ex = {(e["metric"], e["le"]): e for e in tracing.exemplar_records()}
+        # worst value wins the bucket; a later-but-faster trace does not
+        assert ex[("test.trace.ttft_s", "1.0")]["trace_id"] == "trace-b"
+        assert ex[("test.trace.ttft_s", "1.0")]["value"] == 0.6
+        # beyond the last edge lands on +Inf
+        assert ex[("test.trace.ttft_s", "+Inf")]["trace_id"] == "trace-d"
+        # unregistered metric falls back to the default latency edges
+        tracing.note_ttft("trace-e", 0.6, metric="test.trace.other")
+        ex = {(e["metric"], e["le"]): e for e in tracing.exemplar_records()}
+        assert ("test.trace.other", "1.0") in ex
+    finally:
+        tracing.reset_traces()
+
+
+def test_trace_tail_sampling_keeps_worst_and_unnoted():
+    from burst_attn_tpu.obs import trace as tracing
+
+    tracing.enable()
+    try:
+        n = tracing.TAIL_KEEP + 40
+        for i in range(n):
+            tc = tracing.TraceContext(f"samp-{i}")
+            tracing.record_span(tc, "serve.request", 0.0, 1.0, root=True)
+            # trace i has TTFT i seconds: the top TAIL_KEEP are the tail
+            tracing.note_ttft(tc, float(i), metric="test.samp.ttft_s")
+        # one more trace whose TTFT was never noted (e.g. recorded by a
+        # worker process that never sees first-token): always kept
+        orphan = tracing.TraceContext("samp-orphan")
+        tracing.record_span(orphan, "fleet.prefill", 0.0, 1.0, root=True)
+        kept = {r["trace_id"] for r in tracing.trace_records()}
+        assert "samp-orphan" in kept
+        tail = {f"samp-{i}" for i in range(n - tracing.TAIL_KEEP, n)}
+        assert tail <= kept
+        # the fast half is dropped except the deterministic head sample
+        import zlib as _z
+        for i in range(20):
+            tid = f"samp-{i}"
+            head = _z.crc32(tid.encode()) % tracing.HEAD_SAMPLE_N == 0
+            assert (tid in kept) == head
+    finally:
+        tracing.reset_traces()
+
+
+def test_render_prometheus_exemplar_lines():
+    """ISSUE 19 satellite: `obs --prom` emits OpenMetrics-style exemplar
+    suffixes on histogram buckets that have a sampled trace."""
+    r = Registry()
+    h = r.histogram("ttft", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.6)
+    exemplars = [dict(kind="exemplar", metric="ttft", le="1.0",
+                      trace_id="fleet-1-r0-1", value=0.6)]
+    text = render_prometheus(r.snapshot(), exemplars)
+    by_le = {}
+    for line in text.splitlines():
+        if line.startswith("burst_ttft_bucket"):
+            by_le[line.split('le="')[1].split('"')[0]] = line
+    assert by_le["1.0"].endswith('# {trace_id="fleet-1-r0-1"} 0.6')
+    # buckets without a sampled trace carry no suffix
+    assert "#" not in by_le["0.1"] and "#" not in by_le["+Inf"]
+    # and no exemplars at all degrades to plain prometheus text
+    assert "trace_id" not in render_prometheus(r.snapshot())
+
+
+def test_cli_trace_and_waterfall_subprocess(tmp_path):
+    from burst_attn_tpu.obs import trace as tracing
+
+    tracing.enable()
+    try:
+        tc = tracing.TraceContext("cli-t1")
+        tracing.record_span(tc, "serve.request", 0.0, 2.0, root=True)
+        tracing.record_span(tc, "serve.prefill", 0.0, 1.0)
+        tracing.marker(tc, "serve.first_token", 1.0)
+        path = str(tmp_path / "obs.jsonl")
+        Registry().export_jsonl(path,
+                                extra_records=tracing.trace_records(),
+                                process_index=0)
+    finally:
+        tracing.reset_traces()
+    r = subprocess.run(
+        [sys.executable, "-m", "burst_attn_tpu.obs", "--trace",
+         "--file", path],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "cli-t1" in r.stdout and "[complete]" in r.stdout
+    assert "prefill=" in r.stdout and "gap=" in r.stdout
+    r = subprocess.run(
+        [sys.executable, "-m", "burst_attn_tpu.obs",
+         "--waterfall", "cli-t1", "--file", path],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.startswith("waterfall cli-t1")
+    assert "serve.first_token" in r.stdout
+    # unknown trace id: loud exit 1, like --file on a missing path
+    r = subprocess.run(
+        [sys.executable, "-m", "burst_attn_tpu.obs",
+         "--waterfall", "nope", "--file", path],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1

@@ -362,3 +362,105 @@ def test_engine_drain_requeues_inflight_token_exact(setup, engine_cls):
     for rid, ref, s in zip(rids, refs[:3], steps[:3]):
         assert res[rid] == ref[:s]
     assert eng.pool.available == eng.pool.n_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# request tracing (ISSUE 19 tentpole): lifecycle spans submission ->
+# retirement, breakdown summing to TTFT, and the zero-cost-off contract
+
+
+def _breakdown_observations() -> int:
+    return sum(sum(r["bucket_counts"]) + r.get("overflow", 0)
+               for r in obs.snapshot()
+               if r["name"] == "serve.ttft_breakdown")
+
+
+@pytest.mark.parametrize("engine_cls", ["ragged", "legacy"])
+def test_engine_lifecycle_trace_tree(setup, engine_cls):
+    """Tracing on: every request yields one COMPLETE span tree
+    (queued -> prefill -> first_token -> decode under a serve.request
+    root) whose phase decomposition sums to the TTFT exactly, tokens
+    stay identical to the untraced run, and serve.ttft_breakdown /
+    serve.host_gap_fraction get fed."""
+    from burst_attn_tpu.obs import trace as tracing
+    from burst_attn_tpu.obs.aggregate import build_trace_trees
+    from burst_attn_tpu.obs.trace import ttft_breakdown
+
+    cfg, params, prompts, steps, refs = setup
+
+    def make():
+        if engine_cls == "ragged":
+            return RaggedServeEngine(params, cfg, slots=2, n_pages=10,
+                                     page=128, max_pages_per_seq=4, chunk=4)
+        return ServeEngine(params, cfg, slots=2, n_pages=10, page=128,
+                           max_pages_per_seq=4)
+
+    # tracing off (the default): serving records nothing at all
+    tracing.reset_traces()
+    eng = make()
+    rids = [eng.submit(p, s) for p, s in zip(prompts[:2], steps[:2])]
+    res_off = eng.run()
+    assert tracing.trace_records() == []
+    assert tracing.exemplar_records() == []
+
+    tracing.enable()
+    bd_before = _breakdown_observations()
+    try:
+        eng = make()
+        rids = [eng.submit(p, s) for p, s in zip(prompts[:2], steps[:2])]
+        res_on = eng.run()
+        # instrumentation reads host clocks only: tokens are identical
+        for rid, rid0 in zip(rids, rids):
+            assert res_on[rid] == res_off[rid0]
+        trees = {t["trace_id"]: t
+                 for t in build_trace_trees(tracing.trace_records())}
+        assert len(trees) == 2
+        need = {"serve.queued", "serve.prefill", "serve.first_token",
+                "serve.decode", "serve.request"}
+        for tree in trees.values():
+            assert tree["complete"] and not tree["truncated"]
+            assert need <= {s["name"] for s in tree["spans"]}
+            bd = ttft_breakdown(tree["spans"])
+            assert bd is not None and bd["ttft_s"] > 0
+            assert set(bd["phases"]) == {"queued", "prefill", "gap"}
+            assert sum(bd["phases"].values()) \
+                == pytest.approx(bd["ttft_s"], rel=1e-9)
+        # TTFT exemplars pin worst traces into serve.ttft_s buckets
+        ex = tracing.exemplar_records()
+        assert any(e["metric"] == "serve.ttft_s"
+                   and e["trace_id"] in trees for e in ex)
+        # aggregate views fed: breakdown histogram + host-gap gauge
+        assert _breakdown_observations() >= bd_before + 4  # 2 phases x 2 reqs
+        assert getattr(eng, "_launch_wall_s", 0.0) > 0
+        assert 0.0 <= obs.gauge("serve.host_gap_fraction").get() <= 1.0
+    finally:
+        tracing.reset_traces()
+
+
+def test_tracing_leaves_serve_tick_jaxpr_untouched(setup):
+    """Zero-cost-off bar: flipping tracing on changes NOTHING inside the
+    jitted tick — the ragged step's jaxpr is string-identical, because
+    every trace call sits on the host side of the boundary."""
+    from burst_attn_tpu.obs import trace as tracing
+
+    cfg, params, prompts, steps, refs = setup
+    st, pool = init_paged_state(cfg, slots=2, n_pages=10, page=128,
+                                max_pages_per_seq=4)
+    for s_ in range(2):
+        st = assign_pages(st, s_, pool.acquire(1))
+    toks = jnp.zeros((2, 4), jnp.int32)
+    qls = jnp.asarray([4, 0], jnp.int32)
+
+    def jaxpr():
+        return str(jax.make_jaxpr(
+            lambda t, q, s: ragged_model_step(params, t, q, s, cfg,
+                                              attn="dense")[0])(toks, qls, st))
+
+    tracing.reset_traces()
+    off = jaxpr()
+    tracing.enable()
+    try:
+        on = jaxpr()
+    finally:
+        tracing.reset_traces()
+    assert on == off
