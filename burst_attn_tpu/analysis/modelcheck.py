@@ -407,12 +407,21 @@ def transfer_model(n_pages: int = 2, pool_pages: int = 4,
 # `n_tokens` tokens; the engine's step boundary syncs then delivers;
 # crash drops the file buffer and restarts generation from the durable
 # fold — exactly rewrite_journal + run_recovered's contract.
+#
+# The pipelined engine (ISSUE 20) adds a one-step DELIVERY LAG: a launch
+# samples its token on device ("pipelined launch"), and only the NEXT
+# step boundary reads it back, journals it, fsyncs, and delivers —
+# modeled by the `inflight` field.  A crash while a token is in flight
+# simply drops it (it was never journaled; recovery regenerates it), so
+# the delivered ⟹ durable invariant must hold over every interleaving of
+# both the synchronous and the pipelined transitions.
 # ---------------------------------------------------------------------------
 
 
 class JournalModelState(NamedTuple):
     j: journal_proto.JournalState
-    gen: int   # tokens the engine has produced (appended) so far
+    gen: int        # tokens the engine has produced (appended) so far
+    inflight: int = 0  # tokens sampled on device, not yet read back
 
 
 def journal_model(n_tokens: int = 3) -> Model:
@@ -426,7 +435,7 @@ def journal_model(n_tokens: int = 3) -> Model:
                 lambda s=s: JournalModelState(
                     journal_proto.step(
                         s.j, ("append", "tokens", _RID, 1))[0],
-                    s.gen + 1)))
+                    s.gen + 1, s.inflight)))
         out.append(guarded(
             "sync (fsync barrier)",
             lambda s=s: s._replace(
@@ -442,12 +451,38 @@ def journal_model(n_tokens: int = 3) -> Model:
             out.append(guarded(
                 f"engine step boundary (sync + deliver {s.gen})",
                 step_boundary))
+        if s.inflight == 0 and s.gen + 1 <= n_tokens:
+            # the pipelined engine dispatches a launch and returns WITHOUT
+            # reading the sampled token back: it exists on device only —
+            # nothing is journaled yet, nothing may be delivered from it
+            out.append(guarded(
+                "pipelined launch (defer readback)",
+                lambda s=s: s._replace(inflight=1)))
+        if s.inflight:
+            def pipe_boundary(s=s):
+                # the NEXT step(): deferred readback journals the
+                # in-flight token, fsync, THEN the stream (now at gen+1)
+                # is delivered — the fsync stays before delivery even
+                # though delivery lags the launch by one step.  A mutation
+                # that reorders deliver before sync trips
+                # DurabilityViolation on this transition.
+                j1, _ = journal_proto.step(
+                    s.j, ("append", "tokens", _RID, 1))
+                j2, _ = journal_proto.step(j1, ("sync",))
+                j3, _ = journal_proto.step(
+                    j2, ("deliver", _RID, s.gen + 1))
+                return JournalModelState(j3, s.gen + 1, 0)
+            out.append(guarded(
+                "pipelined step boundary (readback + sync + deliver)",
+                pipe_boundary))
         def crash(s=s):
             j1, _ = journal_proto.step(s.j, ("crash",))
             # restart: rewrite_journal folds the durable view; the
-            # resumed engine regenerates from the durable token count
+            # resumed engine regenerates from the durable token count.
+            # An in-flight (never-journaled) device token vanishes with
+            # the process — recovery regenerates it too.
             return JournalModelState(j1, journal_proto.durable_tokens(
-                j1, _RID))
+                j1, _RID), 0)
         out.append(guarded("crash engine (restart from journal)", crash))
         return tuple(out)
 

@@ -44,6 +44,16 @@ rule("ckpt-jit-safe", "jaxpr",
      "carry zero host-callback primitives — checkpoint/journal writes "
      "stay at the host dispatch boundary")(None)
 
+rule("pipe-fused-pure", "jaxpr",
+     "the fused multi-step decode scan (pipelined engine) traces with zero "
+     "host-callback primitives and zero remote-DMA/collective primitives — "
+     "K device steps per host dispatch, no hidden host or wire hops")(None)
+
+rule("pipe-tick-identity", "jaxpr",
+     "the K=1 pipelined tick traces string-identical to the synchronous "
+     "engine tick (model step + sample) — pipelining moves WHEN readback "
+     "happens, never WHAT is computed")(None)
+
 _LEGACY_CALLBACK_PRIMS = ("outside_call",)
 
 
@@ -72,6 +82,33 @@ def check_trace(closed_jaxpr, *, where: str, anchor,
                         "the traced program — a synchronous device<->host "
                         "round trip per executed step; obs instrumentation "
                         "must stay at the host dispatch boundary"))
+    return findings
+
+
+# Substrings that mark a cross-device primitive: collectives (ppermute /
+# psum / all_gather / all_to_all / pbroadcast), plus anything spelled as
+# an explicit remote copy or DMA across jax versions.  A single-host
+# decode scan must bind none of them — the fused launch's whole point is
+# K steps with zero host AND zero wire traffic per dispatch.
+_REMOTE_PRIM_MARKERS = ("ppermute", "psum", "pmax", "pmin", "pbroadcast",
+                        "all_gather", "all_to_all", "collective",
+                        "remote", "dma")
+
+
+def check_remote_free(closed_jaxpr, *, where: str, anchor,
+                      rule_name: str = "pipe-fused-pure") -> List[Finding]:
+    """Flag every remote-DMA/collective primitive in one traced program."""
+    findings: List[Finding] = []
+    path, line = anchor
+    for eqn in iter_eqns(closed_jaxpr):
+        name = eqn.primitive.name
+        if any(m in name for m in _REMOTE_PRIM_MARKERS):
+            findings.append(Finding(
+                rule=rule_name, file=path, line=line,
+                message=f"{where}: remote/collective primitive `{name}` "
+                        "inside the traced decode program — the fused "
+                        "launch must be a purely local device program "
+                        "(no wire traffic hidden inside the scan)"))
     return findings
 
 
@@ -216,4 +253,48 @@ def check_all() -> List[Finding]:
         )(params, jnp.zeros((2,), jnp.int32), state),
         where="paged_decode_step", anchor=_anchor(paged_decode_step),
         rule_name="ckpt-jit-safe")
+
+    # ---- pipe-fused-pure: the pipelined engine's fused multi-step scan.
+    # K decode steps execute per host dispatch; a callback primitive in
+    # the scan body would fire K times per launch, and a collective/DMA
+    # would put wire traffic inside what must be a purely local program.
+    rng = jax.random.PRNGKey(0)
+    first = jnp.zeros((2,), jnp.int32)
+    anchor_ms = _anchor(serving_model.multi_step_decode)
+    for attn in ("dense", "ragged"):
+        jx = jax.make_jaxpr(
+            lambda p, t, ql, st, r, attn=attn: serving_model.multi_step_decode(
+                p, t, ql, st, r, cfg_s, k=4, attn=attn)
+        )(params, first, qlens, state, rng)
+        where = f"multi_step_decode (k=4, attn={attn})"
+        findings += check_trace(jx, where=where, anchor=anchor_ms,
+                                rule_name="pipe-fused-pure")
+        findings += check_remote_free(jx, where=where, anchor=anchor_ms)
+
+    # ---- pipe-tick-identity: the K=1 pipelined launch is the SAME
+    # program as the synchronous engine's tick (model step + greedy
+    # sample), proven at the jaxpr-string level — the token-exactness
+    # argument for the pipelined engine rests on this identity.
+    def _sync_tick(p, t, ql, st, key):
+        logits, st2 = serving_model.ragged_model_step(p, t, ql, st, cfg_s,
+                                                      attn="ragged")
+        choice = serving_model.sample_logits(logits, key, temperature=0.0,
+                                             top_k=None, top_p=None,
+                                             nan_sentinel=True)
+        return choice, st2
+
+    toks1 = jnp.zeros((2, 1), jnp.int32)
+    jx_pipe = jax.make_jaxpr(
+        lambda p, t, ql, st, key: serving_model.pipelined_tick(
+            p, t, ql, st, key, cfg_s, attn="ragged")
+    )(params, toks1, qlens, state, rng)
+    jx_sync = jax.make_jaxpr(_sync_tick)(params, toks1, qlens, state, rng)
+    if _canon_jaxpr(jx_pipe) != _canon_jaxpr(jx_sync):
+        path, line = _anchor(serving_model.pipelined_tick)
+        findings.append(Finding(
+            rule="pipe-tick-identity", file=path, line=line,
+            message="K=1 pipelined tick trace diverged from the synchronous "
+                    "engine tick — the pipelined engine is no longer "
+                    "launching the same compiled program, so its "
+                    "token-exactness guarantee is void"))
     return findings

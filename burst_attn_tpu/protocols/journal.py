@@ -36,6 +36,18 @@ Events:
                              `delivered` survives too — the caller
                              already saw those tokens, which is exactly
                              why the invariant matters after recovery
+
+Pipelined delivery lag (ISSUE 20): the pipelined RaggedServeEngine
+samples each launch's tokens ON DEVICE and reads them back one step
+late, so a token exists for one tick in neither `buffered` nor
+`durable` — it is not yet a journal event at all.  The machine needs no
+new event kind for this: the deferred readback appends, syncs, and only
+then delivers (analysis/modelcheck.journal_model's "pipelined launch" /
+"pipelined step boundary" transitions), and a crash mid-flight simply
+means the token was never journaled and recovery regenerates it.  What
+the lag changes is WHEN deliver runs — one step after generation — and
+the checker re-proves delivered ⟹ durable over every interleaving of
+the lagged and synchronous boundaries.
 """
 
 from typing import NamedTuple, Tuple

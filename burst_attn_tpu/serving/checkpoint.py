@@ -485,6 +485,12 @@ def snapshot(engine, extra: Optional[dict] = None) -> Tuple[dict, dict]:
     its engine-rid -> router-rid map and resume prefixes here)."""
     kind = _engine_kind(engine)
     _check_snapshotable(engine, kind)
+    # a pipelined engine must quiesce first: an in-flight deferred launch
+    # holds sampled-but-unaccounted tokens on device that no snapshot
+    # field can represent (legacy engines have no pipeline to flush)
+    flush = getattr(engine, "flush_pipeline", None)
+    if flush is not None:
+        flush()
     meta = {
         "version": SNAPSHOT_VERSION,
         "kind": kind,

@@ -77,10 +77,21 @@ _M_TTFT = obs.histogram("serve.ttft_s")
 _M_TOK_LAT = obs.histogram("serve.token_latency_s")
 # host time the tick spent OUTSIDE the device launch+sample window, as a
 # fraction of launch-tick wall time (cumulative) — the gap ROADMAP item 3's
-# async pipelining is gated against.  Always on: host clock reads never
-# touch the jaxpr, so the tick's trace stays bit-identical.
+# async pipelining is gated against (bench_loadgen emits it as the
+# headline_loadgen_hostgap headline).  Always on: host clock reads never
+# touch the jaxpr, so the tick's trace stays bit-identical.  On a
+# pipelined engine the device window is instead estimated from launch
+# dispatch to deferred-readback completion (host work overlapped with a
+# busy device is NOT a gap), so the same gauge compares both engines.
 _M_HOST_GAP = obs.gauge("serve.host_gap_fraction",
                         "host gap seconds / launch-tick wall seconds")
+# pipelined-engine family: speculative schedule divergences and fusion
+_M_RECONCILE = obs.counter(
+    "serve.pipeline_reconciles",
+    "speculatively scheduled pipelined work discarded, by divergence cause")
+_M_MULTI = obs.counter(
+    "serve.multi_step_launches",
+    "fused multi-step decode launches, by static scan depth {k}")
 # ragged-batch family: what each one-launch batch carried
 _M_RB_LAUNCH = obs.counter("serve.ragged_batch_launches",
                            "one-kernel ragged launches, by batch kind")
@@ -125,7 +136,11 @@ from ..models.paged_decode import (
 )
 from ..models.transformer import ModelConfig
 from ..ops.ragged_paged import ragged_supported
-from .model import assign_pages, cow_pages, free_slot, ragged_model_step
+from .model import (
+    assign_pages, cow_pages, free_slot, free_slots, multi_step_decode,
+    pipelined_tick,
+    ragged_model_step,
+)
 
 # reason-string prefix -> bounded counter label, mirroring
 # parallel/burst.py's _FALLBACK_LABELS contract (probe reasons embed
@@ -157,6 +172,39 @@ class _Request:
     n_prefilled: int = 0        # prompt tokens absorbed so far
 
 
+def _readback_choices(choices) -> np.ndarray:
+    """THE pipeline sync point: block on an in-flight launch's sampled
+    choices.  Module-level so the recovery fuzzer can kill the process
+    exactly here — after the launch was dispatched, before any of its
+    tokens were read back, journaled, or delivered."""
+    return np.asarray(choices)
+
+
+@dataclass
+class _Pending:
+    """An in-flight pipelined launch whose sampled choices are still on
+    device: everything the deferred readback needs to replay the
+    synchronous engine's post-sample host accounting one step late."""
+    choices: object              # [k, slots] int32 device array
+    k: int                       # fused decode depth (1 = plain tick)
+    q_lens: np.ndarray           # [slots] per-step token counts
+    advance: np.ndarray          # [slots] device length advance (q_lens * k)
+    prefill_advance: np.ndarray  # [slots] prompt tokens consumed (k == 1)
+    tok_delta: np.ndarray        # [slots] tokens appended at readback
+                                 # assuming no EOS fires inside the launch
+    rng_before: object           # engine rng before this launch's split(s)
+    table_rows: Dict[int, np.ndarray]  # slot -> pre-captured table row for
+                                 # prefix registration at readback
+    n_prefill_toks: int
+    kind: str
+    t_dispatch: float
+    feed_next: object = None     # [slots] last choice row, sliced at
+                                 # dispatch time (enqueued behind the
+                                 # launch) so a speculative follow-up
+                                 # pays no jnp dispatch in its critical
+                                 # pre-dispatch window
+
+
 class RaggedServeEngine:
     """Host-side continuous-batching loop over ragged_model_step.  Not
     thread-safe; drive it from one thread."""
@@ -170,7 +218,7 @@ class RaggedServeEngine:
                  draft_params=None, draft_cfg: Optional[ModelConfig] = None,
                  spec_k: int = 4, use_ragged: Optional[bool] = None,
                  prefix_cache: bool = False, group_attn: bool = True,
-                 journal=None):
+                 journal=None, pipeline: bool = False, multi_step: int = 1):
         self.params = params
         self.cfg = cfg
         self.eos_id = eos_id
@@ -186,6 +234,22 @@ class RaggedServeEngine:
         # appends / done / reset records per tick, fsynced once per step()
         # BEFORE results are returned — crash recovery resumes from here
         self.journal = journal
+        # pipeline: defer each tick's sampling readback one step so host
+        # scheduling for tick N+1 overlaps device execution of tick N;
+        # multi_step additionally fuses up to K pure-decode ticks into one
+        # jitted lax.scan launch when no admission/retire event can land
+        # inside the window.  Token-exact vs the synchronous engine by
+        # construction (docs/serving.md "Pipelined engine"); with a draft
+        # model attached the speculative-decoding scheduler policy stays
+        # on the synchronous path (its rounds are already fused).
+        self.pipeline = bool(pipeline)
+        self.multi_step = int(multi_step)
+        if self.multi_step < 1:
+            raise ValueError(f"multi_step must be >= 1, got {multi_step}")
+        if self.multi_step > 1 and not self.pipeline:
+            raise ValueError("multi_step > 1 requires pipeline=True")
+        self._pending: Optional[_Pending] = None
+        self._flushed_done: List[Tuple[int, List[int]]] = []
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         # quantize: False keeps the pool at cfg.dtype; True/"int8" or "fp8"
         # makes that 1 B/elem dtype the pool's NATIVE storage (per-page
@@ -373,6 +437,10 @@ class RaggedServeEngine:
         occupancy=0.  Returns the requeued rids in their new queue order.
         The engine stays usable — run() after drain() serves everything,
         requeued work first, to completion."""
+        # quiesce the pipeline first: an in-flight launch's tokens are
+        # accounted (and its finishers retired through the journal) before
+        # the survivors are reset and requeued
+        self.flush_pipeline()
         inflight = [req for req in self.slots if req is not None]
         for slot, req in enumerate(self.slots):
             if req is None:
@@ -433,18 +501,25 @@ class RaggedServeEngine:
             req._prefix_hashes = h
         return h
 
-    def _register_prefix(self, slot: int, req: _Request) -> None:
+    def _register_prefix(self, slot: int, req: _Request,
+                         row: Optional[np.ndarray] = None) -> None:
         """Register a just-prefilled prompt's full pages in the prefix
         cache.  Runs AFTER the prompt-completing chunk, so any CoW the
         re-absorbed last token forced has already rewritten the table —
         the registered page ids are the post-CoW (content-correct) ones;
-        insert() is touch-only for hashes already cached."""
+        insert() is touch-only for hashes already cached.  The pipelined
+        engine registers at deferred-readback time and passes the table
+        `row` it captured at launch, so a later speculative launch's CoW
+        can never shift the registered ids (and reading the row never
+        forces a device sync on an in-flight state)."""
         if self.cache is None:
             return
         hashes = self._hashes(req)
         if not hashes:
             return
-        row = np.asarray(self.state.page_table[slot])[:len(hashes)]
+        if row is None:
+            row = np.asarray(self.state.page_table[slot])
+        row = row[:len(hashes)]
         self.cache.insert(hashes, [int(p) for p in row])
 
     def _admit(self) -> None:
@@ -604,13 +679,14 @@ class RaggedServeEngine:
 
     def _retire_finished(self) -> List[Tuple[int, List[int]]]:
         done = []
+        retiring: List[int] = []
         for slot, req in enumerate(self.slots):
             if req is None:
                 continue
             hit_eos = self.eos_id is not None and req.tokens \
                 and req.tokens[-1] == self.eos_id
             if hit_eos or len(req.tokens) >= req.max_new_tokens:
-                self.state = free_slot(self.state, self.pool, slot)
+                retiring.append(slot)
                 if self.draft is not None:
                     self.dstate = retire_slot(self.dstate, self.dpool, slot)
                 self.slots[slot] = None
@@ -629,6 +705,10 @@ class RaggedServeEngine:
                         tokens=len(req.tokens))
                     tracing.record_span(tc, "serve.request", req.t_submit,
                                         now, root=True, rid=req.rid)
+        if retiring:
+            # one batched table edit for the whole wave (pages release in
+            # slot order, so the pool free list matches per-slot frees)
+            self.state = free_slots(self.state, self.pool, retiring)
         if done:
             # retirement frees pages AFTER the tick's _note_tick ran; keep
             # the gauges honest so a drained engine reads occupancy 0
@@ -657,21 +737,32 @@ class RaggedServeEngine:
         if rate is not None:
             _M_SPEC_RATE.set(rate)
 
+    def _journal_barrier(self, done: List[Tuple[int, List[int]]]) -> None:
+        """Durability-then-delivery barrier: fsync the tick's journal
+        appends, then run the journal machine's deliver transition for
+        every stream leaving the engine — protocols.journal raises if any
+        returned token is not yet durable (the delivered ⟹ durable
+        contract burstcheck model-checks as proto-journal-durable)."""
+        if self.journal is None:
+            return
+        self.journal.sync()
+        for rid, toks in done:
+            self.journal.delivered(rid, len(toks))
+
     def step(self) -> List[Tuple[int, List[int]]]:
-        """One engine tick (see _step).  When a journal is attached this
+        """One engine tick (see _step; _pipelined_step when pipeline=True
+        and no draft model is attached).  When a journal is attached this
         is also the durability barrier: the tick's journal appends are
         fsynced BEFORE its results are returned, so any token a caller
-        has seen survives a crash (write-ahead)."""
+        has seen survives a crash (write-ahead).  On the pipelined path
+        the fsync stays before delivery — which means delivery lags one
+        step behind generation (the launch whose tokens are returned here
+        was dispatched a step ago; this tick's launch is still in
+        flight)."""
+        if self.pipeline and self.draft is None:
+            return self._pipelined_step()
         done = self._step()
-        if self.journal is not None:
-            self.journal.sync()
-            # delivery barrier: run the journal machine's deliver
-            # transition for every stream leaving the engine this tick —
-            # protocols.journal raises if any returned token is not yet
-            # durable (the delivered ⟹ durable contract burstcheck
-            # model-checks as proto-journal-durable)
-            for rid, toks in done:
-                self.journal.delivered(rid, len(toks))
+        self._journal_barrier(done)
         return done
 
     def _step(self) -> List[Tuple[int, List[int]]]:
@@ -798,6 +889,356 @@ class RaggedServeEngine:
                 self.dstate, dc, attn="dense")
         self._note_tick(time.perf_counter() - t0, added, dev_s)
         done += self._retire_finished()
+        return done
+
+    # -- pipelined engine --------------------------------------------------
+    #
+    # step() under pipeline=True keeps exactly one launch in flight: each
+    # tick dispatches the NEXT launch (speculatively, when no admission or
+    # retire event can land at the unread launch's readback) BEFORE
+    # blocking on the previous one, so host scheduling for tick N+1
+    # overlaps device execution of tick N.  The readback replays the
+    # synchronous engine's post-sample accounting one step late; the
+    # journal fsync stays before delivery, so delivery lags one step.
+    # Token-exactness rests on two facts: (1) every launch is the SAME
+    # compiled program as the synchronous tick (burstlint asserts the K=1
+    # jaxprs are string-identical), and (2) jax.random.categorical's
+    # per-row noise depends only on (key, shape, row) — a slot's sampled
+    # token never depends on other slots' logits — so feeding a still-on-
+    # device choice into the next launch cannot change any slot's stream.
+
+    def _spec_plan(self) -> Optional[int]:
+        """Fused decode depth k for a speculative launch on top of the
+        unread pending launch, or None when the synchronous engine could
+        admit or retire at the pending readback (speculating would build
+        on a wrong schedule; EOS is the one event this cannot predict —
+        the reconcile path in _pipelined_step handles it)."""
+        p = self._pending
+        if self._queue and any(r is None for r in self.slots):
+            return None                  # admission would land next tick
+        any_live = False
+        k = self.multi_step
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            any_live = True
+            if req.n_prefilled + int(p.prefill_advance[slot]) \
+                    < len(req.prompt):
+                return None              # still mid-prefill after pending
+            remaining = req.max_new_tokens \
+                - (len(req.tokens) + int(p.tok_delta[slot]))
+            if remaining < 1:
+                return None              # budget retire at pending readback
+            k = min(k, remaining)
+        if not any_live:
+            return None
+        if self._shared and self.group_attn:
+            # shared-prefix ticks follow the synchronous engine's per-tick
+            # grouped-launch decision; never fuse across them
+            k = 1
+        return k
+
+    def _dispatch_deferred(self, *, feed, q_lens, qt, k, prefill_advance,
+                           tok_delta, n_prefill_toks, kind) -> _Pending:
+        """Shared dispatch for both pipelined launch flavors: CoW-protect
+        the window, route the kernel, launch WITHOUT reading the sampled
+        choice back.  `feed` is the [slots, qt] token grid for k == 1 or
+        the [slots] next-token feed for a fused k-step scan (either host
+        numpy or a still-in-flight device array)."""
+        self._cow_barrier(q_lens * k)
+        # capture the post-CoW table row of any slot completing its prompt
+        # this launch: prefix registration at readback must see the table
+        # exactly as the synchronous engine would, before a later launch's
+        # CoW rewrites it
+        table_rows: Dict[int, np.ndarray] = {}
+        if self.cache is not None:
+            for slot, req in enumerate(self.slots):
+                if req is not None and prefill_advance[slot] and \
+                        req.n_prefilled + int(prefill_advance[slot]) \
+                        == len(req.prompt):
+                    table_rows[slot] = np.asarray(self.state.page_table[slot])
+        attn = self._attn_for(qt)
+        rng_before = self._rng
+        q_lens_dev = jnp.asarray(q_lens)
+        t_d = time.perf_counter()
+        if k > 1:
+            choices, self.state, self._rng = multi_step_decode(
+                self.params, jnp.asarray(feed), q_lens_dev, self.state,
+                self._rng, self.cfg, k=k, attn=attn,
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p)
+            _M_MULTI.inc(k=str(k))
+        else:
+            groups = (self._build_groups()
+                      if self.group_attn and self._shared
+                      and attn == "ragged" else None)
+            self._rng, key = jax.random.split(self._rng)
+            if groups is not None:
+                gid, gtable, glens = groups
+                choice, self.state = pipelined_tick(
+                    self.params, jnp.asarray(feed), q_lens_dev, self.state,
+                    key, self.cfg, attn="grouped",
+                    temperature=self.temperature, top_k=self.top_k,
+                    top_p=self.top_p, group_id=gid, shared_table=gtable,
+                    shared_lens=glens)
+            else:
+                choice, self.state = pipelined_tick(
+                    self.params, jnp.asarray(feed), q_lens_dev, self.state,
+                    key, self.cfg, attn=attn,
+                    temperature=self.temperature, top_k=self.top_k,
+                    top_p=self.top_p)
+            choices = choice[None]
+        _M_RB_LAUNCH.inc(kind=kind)
+        if n_prefill_toks:
+            _M_RB_PREFILL.inc(n_prefill_toks)
+        _M_RB_FILL.set(float(q_lens.sum()) / (len(self.slots) * qt))
+        return _Pending(
+            choices=choices, k=k, q_lens=q_lens,
+            advance=(q_lens * k).astype(np.int32),
+            prefill_advance=prefill_advance, tok_delta=tok_delta,
+            rng_before=rng_before, table_rows=table_rows,
+            n_prefill_toks=n_prefill_toks, kind=kind, t_dispatch=t_d,
+            feed_next=choices[-1])
+
+    def _launch_deferred(self) -> _Pending:
+        """Pipeline (re)fill: the synchronous tick's batch build — prefill
+        chunks + decode singles from the fully-accounted host state — as
+        one deferred launch, fused to multi_step depth when every live
+        slot is pure-decode and no admission/retire can land inside the
+        window."""
+        prefilling = [s for s, r in enumerate(self.slots)
+                      if r is not None and r.n_prefilled < len(r.prompt)]
+        qt = self.chunk if prefilling else 1
+        slots = len(self.slots)
+        toks = np.zeros((slots, qt), np.int32)
+        q_lens = np.zeros((slots,), np.int32)
+        prefill_advance = np.zeros((slots,), np.int32)
+        tok_delta = np.zeros((slots,), np.int32)
+        n_prefill_toks = 0
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            if req.n_prefilled < len(req.prompt):
+                seg = req.prompt[req.n_prefilled:req.n_prefilled + qt]
+                toks[slot, :len(seg)] = seg
+                q_lens[slot] = len(seg)
+                prefill_advance[slot] = len(seg)
+                if req.n_prefilled + len(seg) == len(req.prompt):
+                    tok_delta[slot] = 1
+                n_prefill_toks += len(seg)
+            else:
+                toks[slot, 0] = self._next_tok[slot]
+                q_lens[slot] = 1
+                tok_delta[slot] = 1
+        k = 1
+        if not prefilling and self.multi_step > 1 \
+                and not (self._shared and self.group_attn) \
+                and not (self._queue
+                         and any(r is None for r in self.slots)):
+            k = self.multi_step
+            for req in self.slots:
+                if req is not None:
+                    k = min(k, req.max_new_tokens - len(req.tokens))
+            k = max(1, k)
+        if k > 1:
+            tok_delta = q_lens * k
+        kind = ("mixed" if prefilling and len(prefilling) < self.live
+                else "prefill" if prefilling else "decode")
+        return self._dispatch_deferred(
+            feed=(toks if k == 1 else toks[:, 0]), q_lens=q_lens, qt=qt,
+            k=k, prefill_advance=prefill_advance, tok_delta=tok_delta,
+            n_prefill_toks=n_prefill_toks, kind=kind)
+
+    def _launch_speculative(self, k: int) -> _Pending:
+        """Launch the next k decode steps on top of the UNREAD pending
+        launch, feeding its last on-device choice row straight in as the
+        next tokens — zero host readbacks between the two launches."""
+        p = self._pending
+        slots = len(self.slots)
+        q_lens = np.asarray([1 if r is not None else 0
+                             for r in self.slots], np.int32)
+        feed = p.feed_next if p.feed_next is not None else p.choices[-1]
+        return self._dispatch_deferred(
+            feed=(feed[:, None] if k == 1 else feed), q_lens=q_lens, qt=1,
+            k=k, prefill_advance=np.zeros((slots,), np.int32),
+            tok_delta=q_lens * k, n_prefill_toks=0, kind="decode")
+
+    def _readback(self, p: _Pending) -> Tuple[int, bool, bool]:
+        """Deferred host half of launch `p`: block on its sampled choices
+        (THE pipeline sync point) and replay the synchronous engine's
+        post-sample accounting.  A fused launch is truncated at its FIRST
+        EOS step — tokens past it are schedule the synchronous engine
+        would never have produced — by rolling the device lengths back
+        and re-deriving the rng from the pre-launch snapshot, so the
+        per-slot streams stay bit-identical.  Returns (tokens added,
+        diverged, truncated); `diverged` means the readback produced an
+        event (EOS / budget retire / truncation) that invalidates any
+        schedule speculated on top of this launch."""
+        choices = _readback_choices(p.choices)
+        slots = len(self.slots)
+        keep = p.k
+        if p.k > 1 and self.eos_id is not None:
+            for j in range(p.k):
+                if any(self.slots[s] is not None and p.q_lens[s]
+                       and choices[j, s] == self.eos_id
+                       for s in range(slots)):
+                    keep = j + 1
+                    break
+        added = 0
+        nan_at = None
+        for j in range(keep):
+            row = choices[j]
+            for slot, req in enumerate(self.slots):
+                if req is None or not p.q_lens[slot]:
+                    continue
+                if row[slot] < 0:  # sample_logits NaN-poison sentinel
+                    nan_at = (slot, req.rid)
+                    break
+                if j == 0 and p.prefill_advance[slot]:
+                    was = req.n_prefilled
+                    req.n_prefilled = was + int(p.prefill_advance[slot])
+                    if req.n_prefilled == len(req.prompt):
+                        self._register_prefix(slot, req,
+                                              row=p.table_rows.get(slot))
+                        tok = int(row[slot])
+                        req.tokens.append(tok)
+                        if self.journal is not None:
+                            self.journal.tokens(req.rid, [tok])
+                        self._next_tok[slot] = tok
+                        added += 1
+                        now = time.perf_counter()
+                        _M_TTFT.observe(now - req.t_submit)
+                        tc = getattr(req, "_tc", None)
+                        if tc is not None:
+                            t_adm = getattr(req, "_t_admit", req.t_submit)
+                            req._t_first = now
+                            tracing.record_span(tc, "serve.prefill", t_adm,
+                                                now,
+                                                prompt_len=len(req.prompt))
+                            tracing.marker(tc, "serve.first_token", now)
+                            tracing.note_ttft(tc, now - req.t_submit)
+                            tracing.publish_breakdown(
+                                {"queued": t_adm - req.t_submit,
+                                 "prefill": now - t_adm})
+                else:
+                    tok = int(row[slot])
+                    req.tokens.append(tok)
+                    if self.journal is not None:
+                        self.journal.tokens(req.rid, [tok])
+                    self._next_tok[slot] = tok
+                    added += 1
+                    _M_RB_DECODE.inc()
+            if nan_at is not None:
+                break
+        truncated = keep < p.k
+        if truncated:
+            # scattered K/V beyond the rolled-back logical length is
+            # harmless garbage — always overwritten before it can be read
+            undo = np.where(p.q_lens > 0, p.k - keep, 0).astype(np.int32)
+            self.state = self.state._replace(
+                lengths=self.state.lengths - jnp.asarray(undo))
+            rng = p.rng_before
+            for _ in range(keep):
+                rng, _ = jax.random.split(rng)
+            self._rng = rng
+            _M_RECONCILE.inc(cause="scan-eos")
+        if nan_at is not None:
+            slot, rid = nan_at
+            raise RuntimeError(
+                f"slot {slot} (rid {rid}) logits are NaN-poisoned: a live "
+                "slot was stepped without assigned pages")
+        eos = self.eos_id is not None and any(
+            req is not None and req.tokens
+            and req.tokens[-1] == self.eos_id for req in self.slots)
+        budget = any(
+            req is not None and len(req.tokens) >= req.max_new_tokens
+            for req in self.slots)
+        return added, (eos or budget or truncated), truncated
+
+    def _pipelined_step(self) -> List[Tuple[int, List[int]]]:
+        """One pipelined tick: dispatch the next launch (speculatively if
+        safe), THEN block on the previous one — its results are what this
+        call returns, so delivery lags one step.  On divergence (the
+        readback retired a stream the speculation assumed live) the
+        speculative launch is rolled back — lengths and rng restored —
+        and the tick falls back to the synchronous retire/admit/launch
+        sequence, so the schedule is always the synchronous engine's."""
+        t0 = time.perf_counter()
+        done = self._flushed_done
+        self._flushed_done = []
+        p = self._pending
+        if p is None:
+            # pipeline (re)fill: the synchronous tick head, one deferred
+            # launch, nothing to read back or deliver yet
+            done += self._retire_finished()
+            self._admit()
+            if self.live == 0:
+                self._note_tick(time.perf_counter() - t0, 0)
+                self._journal_barrier(done)
+                return done
+            self._pending = self._launch_deferred()
+            dt = time.perf_counter() - t0
+            self._note_tick(
+                dt, 0, min(dt, time.perf_counter()
+                           - self._pending.t_dispatch))
+            self._journal_barrier(done)
+            return done
+        ir = getattr(p.choices, "is_ready", None)
+        ready0 = bool(ir()) if ir is not None else False
+        k_spec = self._spec_plan()
+        spec = self._launch_speculative(k_spec) if k_spec else None
+        self._pending = None
+        added, diverged, truncated = self._readback(p)
+        t_rb = time.perf_counter()
+        if spec is not None and diverged:
+            # reconcile: discard the speculative launch (its scattered K/V
+            # sits beyond the logical length and is overwritten before it
+            # can ever be read) and fall back to a synchronous tick
+            self.state = self.state._replace(
+                lengths=self.state.lengths - jnp.asarray(spec.advance))
+            if not truncated:   # truncation already repositioned the rng
+                self._rng = spec.rng_before
+            _M_RECONCILE.inc(cause="eos-retire")
+            spec = None
+        if spec is not None:
+            # speculation was right: the launch in flight IS the next tick
+            self._pending = spec
+        else:
+            done += self._retire_finished()
+            self._admit()
+            if self.live:
+                self._pending = self._launch_deferred()
+        dt = time.perf_counter() - t0
+        # device window estimate: the pending launch provably ran from
+        # tick start to readback completion unless it was already ready
+        # when the tick began; the freshly dispatched launch runs from
+        # its dispatch to tick end (credited here, verified by the next
+        # tick's is_ready probe)
+        dev_s = 0.0 if ready0 else t_rb - t0
+        if self._pending is not None:
+            dev_s += time.perf_counter() - self._pending.t_dispatch
+        self._note_tick(dt, added, min(dev_s, dt))
+        self._journal_barrier(done)
+        return done
+
+    def flush_pipeline(self) -> List[Tuple[int, List[int]]]:
+        """Quiesce the pipeline: block on any in-flight launch, run its
+        deferred accounting, retire its finishers through the journal
+        barrier.  The finishers are ALSO queued onto the next step()'s
+        return so a driver loop polling step() never loses a completion.
+        Safe no-op when nothing is in flight (or on a synchronous
+        engine).  snapshot()/drain() call this first — a quiesced engine
+        is the only thing worth serializing."""
+        p = self._pending
+        if p is None:
+            return []
+        self._pending = None
+        added, _, _ = self._readback(p)
+        done = self._retire_finished()
+        if added:
+            _M_TOKENS.inc(added)
+        self._journal_barrier(done)
+        self._flushed_done.extend(done)
         return done
 
     def _spec_round(self) -> int:

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..models.decode import sample_logits
 from ..models.paged_decode import (
     PagedState, PagePool, _gather_dequant_pages,
 )
@@ -60,12 +61,10 @@ def _dense_ragged_attention(q, kp, vp, ks, vs, table, pos, real,
     return o.reshape(slots, n_q, qt, d).astype(q.dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "attn", "all_logits"),
-         donate_argnums=(3,))
-def ragged_model_step(params, tokens, q_lens, state: PagedState,
-                      cfg: ModelConfig, attn: str = "ragged",
-                      all_logits: bool = False, group_id=None,
-                      shared_table=None, shared_lens=None):
+def _ragged_model_step(params, tokens, q_lens, state: PagedState,
+                       cfg: ModelConfig, attn: str = "ragged",
+                       all_logits: bool = False, group_id=None,
+                       shared_table=None, shared_lens=None):
     """Advance every active slot by its own token count in ONE pass.
 
     tokens  [slots, QT] int32 — slot s consumes tokens[s, :q_lens[s]]
@@ -170,6 +169,75 @@ def ragged_model_step(params, tokens, q_lens, state: PagedState,
         tuple(k_scs) if quant else None, tuple(v_scs) if quant else None)
 
 
+ragged_model_step = partial(
+    jax.jit, static_argnames=("cfg", "attn", "all_logits"),
+    donate_argnums=(3,))(_ragged_model_step)
+
+
+def pipelined_tick(params, tokens, q_lens, state: PagedState, key,
+                   cfg: ModelConfig, *, attn: str = "ragged",
+                   temperature: float = 0.0, top_k=None, top_p=None,
+                   group_id=None, shared_table=None, shared_lens=None):
+    """One engine tick with the sampled choice kept ON DEVICE.
+
+    This is exactly the synchronous engine's tick — the same jitted
+    ragged_model_step dispatch followed by the same sample_logits call —
+    except the result is returned as a device array instead of being
+    read back with np.asarray.  The pipelined engine feeds the choice
+    straight into the next launch and defers the readback one step;
+    burstlint asserts this function's jaxpr is string-identical to the
+    synchronous composition, so pipelining can never change the compiled
+    program, only when the host looks at its output.
+
+    Returns (choice [slots] int32 device array, new PagedState)."""
+    logits, state = ragged_model_step(
+        params, tokens, q_lens, state, cfg, attn=attn, group_id=group_id,
+        shared_table=shared_table, shared_lens=shared_lens)
+    choice = sample_logits(logits, key, temperature=temperature,
+                           top_k=top_k, top_p=top_p, nan_sentinel=True)
+    return choice, state
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "k", "attn", "temperature",
+                          "top_k", "top_p"),
+         donate_argnums=(3,))
+def multi_step_decode(params, first_toks, q_lens, state: PagedState, rng,
+                      cfg: ModelConfig, *, k: int, attn: str = "ragged",
+                      temperature: float = 0.0, top_k=None, top_p=None):
+    """K pure-decode ticks fused into ONE jitted lax.scan launch.
+
+    The scan body is the un-jitted tick — _ragged_model_step at q_len 1
+    per live slot, one jax.random.split, one sample_logits — so the
+    split sequence and every slot's per-row sampling noise are exactly
+    what k consecutive synchronous ticks would consume
+    (jax.random.categorical's noise depends only on (key, shape, row),
+    never on other rows' logits).  The compile key includes the static
+    trip count k, so each fusion depth is its own program.
+
+    first_toks [slots] int32 — each live slot's pending next token (the
+    previous tick's sampled choice, possibly still in flight on device).
+    q_lens     [slots] int32 — 1 for live slots, 0 idle; constant across
+               the k steps (eligibility: pure decode, no admission or
+               retirement possible inside the window).
+
+    Returns (choices [k, slots] int32, new PagedState with lengths
+    advanced by k per live slot, rng after k splits).  A NaN-poisoned
+    row samples the -1 sentinel, same as the synchronous path."""
+    def body(carry, _):
+        toks, st, r = carry
+        logits, st = _ragged_model_step(params, toks[:, None], q_lens,
+                                        st, cfg, attn=attn)
+        r, key = jax.random.split(r)
+        choice = sample_logits(logits, key, temperature=temperature,
+                               top_k=top_k, top_p=top_p, nan_sentinel=True)
+        return (choice, st, r), choice
+
+    (_, state, rng), choices = jax.lax.scan(
+        body, (first_toks, state, rng), None, length=k)
+    return choices, state, rng
+
+
 def assign_pages(state: PagedState, slot: int, ids) -> PagedState:
     """Host-side: point `slot`'s table row at freshly acquired pages (the
     engine reserves a request's FULL lifetime at admission, before any
@@ -177,11 +245,14 @@ def assign_pages(state: PagedState, slot: int, ids) -> PagedState:
     row must be empty (retired) first."""
     if not ids:
         return state
-    if int(state.lengths[slot]) != 0:
+    if int(np.asarray(state.lengths)[slot]) != 0:
         raise RuntimeError(f"slot {slot} is still live; free_slot first")
-    table = state.page_table.at[slot, :len(ids)].set(
-        np.asarray(ids, np.int32))
-    return state._replace(page_table=table)
+    # tiny host-side table edit: one readback + one upload beats op-by-op
+    # .at[].set dispatches (~1.5ms each un-jitted) — admission waves are
+    # device-idle windows, so their host cost is pure serve.host_gap
+    table = np.asarray(state.page_table).copy()
+    table[slot, :len(ids)] = np.asarray(ids, np.int32)
+    return state._replace(page_table=jnp.asarray(table))
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -255,10 +326,25 @@ def free_slot(state: PagedState, pool: PagePool, slot: int) -> PagedState:
     0 — the ragged engine assigns pages at admission, before the first
     prefill chunk lands, so a slot can hold pages at length 0 (mid-
     admission rollback) and they must not leak."""
-    row = np.asarray(state.page_table[slot])
-    ids = [int(i) for i in row if i != 0]
-    if ids:
-        pool.release(ids)
-    return state._replace(
-        lengths=state.lengths.at[slot].set(0),
-        page_table=state.page_table.at[slot].set(0))
+    return free_slots(state, pool, [slot])
+
+
+def free_slots(state: PagedState, pool: PagePool, slots) -> PagedState:
+    """Batched free_slot: one table readback + one upload no matter how
+    many slots retire this tick.  Retire waves are device-idle windows
+    (the pipelined engine cannot speculate across them), so their host
+    cost is pure serve.host_gap — per-slot .at[].set dispatches were the
+    single largest contributor before batching."""
+    slots = list(slots)
+    if not slots:
+        return state
+    table = np.asarray(state.page_table).copy()
+    lengths = np.asarray(state.lengths).copy()
+    for slot in slots:
+        ids = [int(i) for i in table[slot] if i != 0]
+        if ids:
+            pool.release(ids)
+        table[slot] = 0
+        lengths[slot] = 0
+    return state._replace(lengths=jnp.asarray(lengths),
+                          page_table=jnp.asarray(table))

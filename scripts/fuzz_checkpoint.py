@@ -35,6 +35,19 @@ token-exact streams vs an UNCACHED uninterrupted oracle, after which
 the live tables + cache index and proves ZERO leaked and ZERO
 double-freed physical pages (and that a full evict drains the pool).
 
+`--pipeline-seeds N` fuzzes the PIPELINED engine's delivery lag
+(ISSUE 20): a pipeline=True multi_step=4 engine is killed inside the
+one-step window where sampled tokens exist on device only
+(MID-PIPELINE-FLIGHT: between dispatch and the deferred readback;
+MID-MULTI-STEP-SCAN: at the dispatch of a fused K-step launch;
+MID-READBACK: after the readback buffered its journal records, before
+the fsync).  Each kill point is named by a transition of the journal
+model the checker proves (`pipeline_kill_modes` validates the shared
+vocabulary), and a fresh PIPELINED engine recovering from
+snapshot+journal must deliver streams token-exact vs a SYNCHRONOUS
+uninterrupted oracle — the in-flight tokens were never durable, so
+recovery regenerates them.
+
 `--transport-seeds N` additionally fuzzes the fleet wire protocol
 (burst_attn_tpu.fleet.transport): per seed a random message stream is
 framed, then truncated / bit-flipped / duplicated; the FrameBuffer must
@@ -386,6 +399,175 @@ def run_cache_seed(seed: int, n_requests: int, out_dir: str) -> dict:
     return results
 
 
+# The pipelined-engine kill points (ISSUE 20) are NAMED BY burstcheck
+# transitions in the JOURNAL model (analysis/modelcheck.journal_model):
+# the pipelined engine samples tokens on device and reads them back one
+# step late, so there is a window where a token exists in neither the
+# journal buffer nor the durable view.  Each mode kills the REAL engine
+# inside the window the checker explores symbolically.
+PIPELINE_KILL_POINTS = {
+    # kill between dispatch and the deferred readback: the sampled
+    # token(s) exist on device only — never journaled, never delivered
+    "mid-pipeline-flight": "pipelined launch (defer readback)",
+    # same window, but the in-flight launch is a fused K-step scan:
+    # K tokens per live slot vanish with the process
+    "mid-multi-step-scan": "pipelined launch (defer readback)",
+    # kill at the deferred boundary AFTER readback appended the journal
+    # records but BEFORE the fsync — buffered records vanish, and the
+    # barrier guarantees none of them were delivered
+    "mid-readback": "pipelined step boundary (readback + sync + deliver)",
+}
+
+PIPE_ENGINE_SPEC = dict(ENGINE_SPEC, pipeline=True, multi_step=4)
+
+
+def pipeline_kill_modes():
+    """The pipelined fuzz modes, validated against the journal model's
+    enumerated transition steps."""
+    from burst_attn_tpu.analysis import modelcheck as mc
+
+    vocab = mc.event_vocabulary(mc.journal_model())
+    for mode, label in PIPELINE_KILL_POINTS.items():
+        assert label in vocab, (
+            f"fuzz mode {mode!r} names checker step {label!r} which the "
+            f"journal model no longer enumerates; vocabulary: {vocab}")
+    return tuple(PIPELINE_KILL_POINTS)
+
+
+def run_pipeline_seed(seed: int, n_requests: int, out_dir: str) -> dict:
+    """One pipelined-engine fuzz round: a pipelined multi_step=4 engine is
+    killed inside the delivery-lag window (launch dispatched, readback
+    not yet run / journal records buffered, fsync not yet run), then a
+    fresh PIPELINED engine recovers from snapshot+journal and must
+    deliver token-exact streams vs a SYNCHRONOUS uninterrupted oracle —
+    the in-flight device tokens were never durable, so recovery simply
+    regenerates them."""
+    import numpy as np
+
+    from burst_attn_tpu.loadgen.worker import build_engine
+    from burst_attn_tpu.serving import checkpoint as ckpt
+    from burst_attn_tpu.serving import engine as eng_mod
+
+    rng = np.random.default_rng([0x717E, int(seed)])
+    prompts = [[int(t) for t in rng.integers(1, 97, int(rng.integers(2, 9)))]
+               for _ in range(n_requests)]
+    budgets = [int(rng.integers(6, 13)) for _ in range(n_requests)]
+    snap = os.path.join(out_dir, f"pfuzz_{seed}.npz")
+    jour = os.path.join(out_dir, f"pfuzz_{seed}.jsonl")
+    jour2 = os.path.join(out_dir, f"pfuzz_{seed}_rewrite.jsonl")
+
+    def submit_all(eng, journal=None):
+        for i, (p, mx) in enumerate(zip(prompts, budgets)):
+            res = eng.try_submit(p, mx)
+            assert res.ok, res
+            if journal is not None:
+                journal.submit(res.rid, i + 100, p, mx)
+        if journal is not None:
+            journal.sync()
+
+    # oracle: SYNCHRONOUS uninterrupted run — the pipelined engine's
+    # exactness bar is the sync engine, kill or no kill
+    eng = build_engine(MODEL_SPEC, ENGINE_SPEC)
+    submit_all(eng)
+    oracle = {}
+    n = 0
+    while len(oracle) < n_requests:
+        for rid, toks in eng.step():
+            oracle[rid + 100] = toks
+        n += 1
+        assert n < 10_000
+
+    results = {}
+    for mode in pipeline_kill_modes():
+        journal = ckpt.TokenJournal(jour, truncate=True)
+        eng = build_engine(MODEL_SPEC, PIPE_ENGINE_SPEC, journal=journal)
+        submit_all(eng, journal=journal)
+        rid_map = {i: i + 100 for i in range(n_requests)}
+        delivered = {}
+
+        armed = {"live": False, "fired": False}
+        if mode == "mid-pipeline-flight":
+            # kill at THE pipeline sync point: the launch is in flight,
+            # its choices were never read back to the host
+            real_rb = eng_mod._readback_choices
+
+            def killing_rb(choices, real_rb=real_rb):
+                if armed["live"] and not armed["fired"]:
+                    armed["fired"] = True
+                    raise SimKill("mid-pipeline-flight")
+                return real_rb(choices)
+
+            eng_mod._readback_choices = killing_rb
+            undo = lambda: setattr(eng_mod, "_readback_choices", real_rb)
+        elif mode == "mid-multi-step-scan":
+            # kill at the dispatch of a fused K-step launch: K tokens
+            # per slot would have been produced by this one program
+            real_ms = eng_mod.multi_step_decode
+
+            def killing_ms(*a, **k):
+                if armed["live"] and not armed["fired"]:
+                    armed["fired"] = True
+                    raise SimKill("mid-multi-step-scan")
+                return real_ms(*a, **k)
+
+            eng_mod.multi_step_decode = killing_ms
+            undo = lambda: setattr(eng_mod, "multi_step_decode", real_ms)
+        else:
+            # kill inside the deferred boundary's fsync: the readback's
+            # journal records are buffered but not yet durable — and the
+            # barrier means they were not delivered either
+            real_sync = ckpt.TokenJournal.sync
+
+            def killing_sync(self, *a, **k):
+                if armed["live"] and not armed["fired"]:
+                    armed["fired"] = True
+                    raise SimKill("mid-readback")
+                return real_sync(self, *a, **k)
+
+            ckpt.TokenJournal.sync = killing_sync
+            undo = lambda: setattr(ckpt.TokenJournal, "sync", real_sync)
+
+        step = 0
+        killed = False
+        snap_step = 1
+        try:
+            while len(delivered) < n_requests and step < 10_000:
+                for rid, toks in eng.step():
+                    delivered[rid_map[rid]] = toks
+                step += 1
+                if step == snap_step:
+                    ckpt.save_snapshot(eng, snap,
+                                       extra={"rid_map": rid_map,
+                                              "resume_prefix": {}})
+                    armed["live"] = True  # kill at the next lag window
+        except SimKill:
+            killed = True
+        finally:
+            undo()
+        del eng, journal  # the "SIGKILL": no drain, no close, no sync
+        with open(jour, "ab") as f:
+            f.write(b'{"kind": "tokens", "rid": 0')  # torn tail
+
+        # recovery into a PIPELINED engine: the lag must survive its own
+        # restart path, not just a synchronous fallback
+        eng = build_engine(MODEL_SPEC, PIPE_ENGINE_SPEC)
+        info = ckpt.recover_engine(eng, snap, jour)
+        assert info.n_skipped == 1, info.n_skipped
+        eng.journal = ckpt.rewrite_journal(eng, jour2, info.rid_map,
+                                           info.resume_prefix)
+        out = dict(delivered)
+        out.update(ckpt.run_recovered(eng, info))
+        exact = out == oracle
+        verify_pool_integrity(eng)
+        results[mode] = dict(exact=exact, killed=killed)
+        status = "OK" if exact and killed else "FAIL"
+        print(f"  pipeline seed={seed} {mode:>19}: {status} "
+              f"killed={killed} exact={exact}")
+        if not exact:
+            print(f"    oracle: {oracle}\n    got:    {out}")
+    return results
+
+
 def run_transport_seed(seed: int, n_messages: int = 24) -> dict:
     """One seeded fuzz round over the fleet frame transport.
 
@@ -506,6 +688,11 @@ def main(argv=None) -> int:
     ap.add_argument("--transport-seeds", type=int, default=0,
                     help="also fuzz the fleet frame transport for N seeds "
                          "(truncate / bit-flip / duplicate mutations)")
+    ap.add_argument("--pipeline-seeds", type=int, default=0,
+                    help="pipelined-engine delivery-lag kill-point seeds "
+                         "(mid-pipeline-flight + mid-multi-step-scan + "
+                         "mid-readback on a pipeline=True multi_step=4 "
+                         "engine, per seed); 0 disables")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -520,6 +707,10 @@ def main(argv=None) -> int:
         for seed in range(args.cache_seeds):
             for mode, r in run_cache_seed(seed, args.requests, td).items():
                 if not (r["exact"] and r["killed"] and r["leak_free"]):
+                    failures += 1
+        for seed in range(args.pipeline_seeds):
+            for mode, r in run_pipeline_seed(seed, args.requests, td).items():
+                if not (r["exact"] and r["killed"]):
                     failures += 1
     for seed in range(args.transport_seeds):
         try:
@@ -548,6 +739,10 @@ def main(argv=None) -> int:
                      "(mid-CoW, mid-admission, mid-scale-scatter) "
                      "token-exact, zero "
                      "leaked/double-freed pages")
+    if args.pipeline_seeds:
+        parts.append(f"{args.pipeline_seeds} pipeline seeds x 3 kill "
+                     "points (mid-flight, mid-multi-step-scan, "
+                     "mid-readback) token-exact vs sync oracle")
     if args.transport_seeds:
         parts.append(f"{args.transport_seeds} transport seeds clean "
                      "(CRC rejects, dedup holds, retry completes)")

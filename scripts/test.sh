@@ -132,9 +132,12 @@ elif [[ $serve == 1 ]]; then
   # focused lane for the ragged paged serving subsystem: the one-launch
   # ragged kernel's interpret-mode parity + probe tests, the continuous-
   # batching engine (admission/eviction/speculative policy, load-shed
-  # ordering), and the ring->pages handoff — the quick iteration loop
-  # while working on burst_attn_tpu/serving/ and ops/ragged_paged.py
+  # ordering), the pipelined engine's parity matrix (deferred readback,
+  # fused multi-step launches, reconcile), and the ring->pages handoff —
+  # the quick iteration loop while working on burst_attn_tpu/serving/
+  # and ops/ragged_paged.py
   python -m pytest tests/test_ragged_paged.py tests/test_serving.py \
+    tests/test_serving_pipeline.py \
     tests/test_serving_handoff.py tests/test_check_regression.py -q \
     ${filtered[@]+"${filtered[@]}"}
   # bench smoke + perf gate: drive the engine end to end, emit the
@@ -159,8 +162,11 @@ elif [[ $loadgen == 1 ]]; then
     ${filtered[@]+"${filtered[@]}"}
   # checkpoint-recovery fuzz: seeded random kill points through the
   # snapshot+journal AND journal-only recovery paths — token-exact vs the
-  # uninterrupted oracle every time, recomputation bounded by journal lag
-  python scripts/fuzz_checkpoint.py --seeds 3
+  # uninterrupted oracle every time, recomputation bounded by journal lag.
+  # --pipeline-seeds: kills inside the pipelined engine's delivery-lag
+  # window (mid-flight / mid-multi-step-scan / mid-readback), recovery
+  # token-exact vs the synchronous oracle
+  python scripts/fuzz_checkpoint.py --seeds 3 --pipeline-seeds 2
   # bench + REAL perf gate (not dry-run): replay the canonical trace, emit
   # serve.load_p99_ttft (lower) + serve.load_goodput (higher) +
   # serve.load_recovery_p99 (lower; kill-mid-trace cluster recovery)

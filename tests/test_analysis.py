@@ -49,6 +49,7 @@ def test_at_least_8_rules_registered():
                      "fp32-accum", "lse-fp32",
                      "fused-ring-schedule", "fused-ring-fused",
                      "obs-jit-safe", "ckpt-jit-safe",
+                     "pipe-fused-pure", "pipe-tick-identity",
                      "ragged-serve-safe", "pagepool-cow-safe",
                      "proto-transfer-atomic", "proto-journal-durable",
                      "proto-pool-conserved", "proto-no-deadlock",
@@ -618,6 +619,102 @@ def test_ckpt_real_serve_step_is_quiet():
                                 rule_name="ckpt-jit-safe") == []
 
 
+# ---------------------------------------------------------------------------
+# pipe-fused-pure / pipe-tick-identity mutations (jaxpr, ISSUE 20)
+
+
+def _tiny_multi_step_trace(hook=None):
+    """Trace a fused multi-step decode scan, optionally smuggling a
+    primitive into the scan body via `hook(choice)`."""
+    from burst_attn_tpu.models.paged_decode import init_paged_state
+    from burst_attn_tpu.models.transformer import ModelConfig, init_params
+    from burst_attn_tpu.serving import model as serving_model
+
+    cfg = ModelConfig(vocab=31, d_model=16, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_head=8, d_ff=32, attn_backend="jnp",
+                      remat=False, dtype=jnp.float32, batch_axis=None,
+                      head_axis=None)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    state, _ = init_paged_state(cfg, slots=2, n_pages=4, page=128,
+                                max_pages_per_seq=2)
+    first = jnp.zeros((2,), jnp.int32)
+    qlens = jnp.ones((2,), jnp.int32)
+    rng = jax.random.PRNGKey(1)
+
+    def prog(p, t, ql, st, r):
+        choices, st, r = serving_model.multi_step_decode(
+            p, t, ql, st, r, cfg, k=3, attn="dense")
+        if hook is not None:
+            hook(choices)
+        return choices, st, r
+
+    return jax.make_jaxpr(prog)(params, first, qlens, state, rng)
+
+
+def test_pipe_fused_callback_fires():
+    """A per-step host hook inside the fused launch (a progress callback,
+    a debug print) multiplies host round trips by K — pipe-fused-pure
+    must flag it."""
+    from burst_attn_tpu.analysis import obscheck
+
+    jx = _tiny_multi_step_trace(
+        hook=lambda c: jax.debug.callback(lambda v: None, c))
+    findings = obscheck.check_trace(jx, where="seeded fused scan",
+                                    anchor=ANCHOR,
+                                    rule_name="pipe-fused-pure")
+    assert _rules_of(findings) == {"pipe-fused-pure"}
+
+
+def test_pipe_fused_remote_dma_fires():
+    """A collective smuggled into the decode program is wire traffic per
+    launch — check_remote_free must flag it even though it is not a
+    callback."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from burst_attn_tpu.analysis import obscheck
+    from burst_attn_tpu.utils.compat import shard_map
+
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.asarray(devs), ("sp",))
+    prog = shard_map(lambda x: jax.lax.psum(x, "sp"), mesh=mesh,
+                     in_specs=P("sp"), out_specs=P(), check_vma=False)
+    jx = jax.make_jaxpr(prog)(jnp.zeros((2,), jnp.float32))
+    findings = obscheck.check_remote_free(jx, where="seeded decode",
+                                          anchor=ANCHOR)
+    assert _rules_of(findings) == {"pipe-fused-pure"}
+    assert "psum" in findings[0].message
+
+
+def test_pipe_fused_real_scan_is_quiet():
+    """The real fused multi-step scan carries neither callbacks nor
+    remote/collective primitives."""
+    from burst_attn_tpu.analysis import obscheck
+
+    jx = _tiny_multi_step_trace()
+    assert obscheck.check_trace(jx, where="fused scan", anchor=ANCHOR,
+                                rule_name="pipe-fused-pure") == []
+    assert obscheck.check_remote_free(jx, where="fused scan",
+                                      anchor=ANCHOR) == []
+
+
+def test_pipe_tick_identity_canon_detects_divergence():
+    """The K=1 identity gate compares canonical jaxpr strings: identical
+    programs pass, a program with one extra equation fails."""
+    from burst_attn_tpu.analysis import obscheck
+
+    def f(x):
+        return x * 2.0
+
+    def g(x):
+        return x * 2.0 + 1.0
+
+    a = jax.make_jaxpr(f)(jnp.zeros((2,), jnp.float32))
+    b = jax.make_jaxpr(f)(jnp.zeros((2,), jnp.float32))
+    c = jax.make_jaxpr(g)(jnp.zeros((2,), jnp.float32))
+    assert obscheck._canon_jaxpr(a) == obscheck._canon_jaxpr(b)
+    assert obscheck._canon_jaxpr(a) != obscheck._canon_jaxpr(c)
+
+
 def test_cli_exits_zero_on_repo():
     import subprocess
     import sys
@@ -1168,6 +1265,44 @@ def test_proto_journal_dropped_fsync_fires(monkeypatch):
     assert "counterexample" in msg and "DurabilityViolation" in msg
     assert "engine step boundary" in msg
     assert findings[0].file.endswith("checkpoint.py")
+
+
+def test_proto_journal_pipelined_lagged_delivery_fires():
+    """ISSUE 20 delivery lag: the pipelined step boundary journals the
+    deferred readback, fsyncs, THEN delivers — one step after the token
+    was generated on device.  Reorder deliver before sync on that ONE
+    transition (the synchronous boundary stays correct) and the checker
+    must find a counterexample that goes THROUGH the pipelined launch:
+    the lagged path is proven independently of the synchronous one."""
+    from burst_attn_tpu.analysis import modelcheck as mc
+    from burst_attn_tpu.protocols import journal as jp
+
+    base = mc.journal_model()
+
+    def transitions(s):
+        out = []
+        for label, nxt in base.transitions(s):
+            if label.startswith("pipelined step boundary"):
+                def lagged_deliver_first(s=s):
+                    j1, _ = jp.step(s.j, ("append", "tokens", mc._RID, 1))
+                    # BUG under test: results leave before the deferred
+                    # readback's fsync barrier
+                    j2, _ = jp.step(j1, ("deliver", mc._RID, s.gen + 1))
+                    j3, _ = jp.step(j2, ("sync",))
+                    return mc.JournalModelState(j3, s.gen + 1, 0)
+                out.append(mc.guarded(label, lagged_deliver_first))
+            else:
+                out.append((label, nxt))
+        return tuple(out)
+
+    mutated = base._replace(transitions=transitions)
+    r = mc.check(mutated, max_depth=24, max_states=50_000)
+    assert not r.ok and r.violation is not None
+    assert "DurabilityViolation" in r.violation.message
+    assert r.violation.trace == (
+        "pipelined launch (defer readback)",
+        "pipelined step boundary (readback + sync + deliver)"), \
+        r.violation.trace
 
 
 def test_proto_transfer_skipped_preconditions_fires(monkeypatch):

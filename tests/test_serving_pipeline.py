@@ -1,0 +1,245 @@
+"""Pipelined RaggedServeEngine (ISSUE 20): the async tick that hides the
+host behind the device must be TOKEN-EXACT with the synchronous engine
+on every path — the pipeline changes when work is dispatched and when
+results are read back, never what is computed.
+
+Covers:
+  * the parity matrix: greedy and sampled decode, plain and quantized
+    (int8 / fp8) pools, K=1 and fused K=4 multi-step launches — every
+    stream bit-identical to the synchronous engine on the same workload
+    (admission happens mid-flight throughout: more requests than slots);
+  * prefix-cache parity: a shared-template workload with the cache on,
+    pipelined vs synchronous, and both vs an uncached oracle;
+  * EOS mid-launch: fused launches truncate at the first EOS and the
+    reconcile path (speculation rolled back on retire) actually fires;
+  * deferred delivery vs the write-ahead journal: a launch is in flight
+    while the journal lags, yet the fsync-before-delivery barrier never
+    trips and the folded journal ends exactly equal to the results;
+  * drain() mid-flight: quiesces the pipeline, gauges at zero, and the
+    engine still serves everything token-exact afterwards;
+  * draft-model engines delegate to the synchronous speculative path;
+  * constructor validation (multi_step requires pipeline).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from burst_attn_tpu import obs
+from burst_attn_tpu.models import ModelConfig, init_params, generate
+from burst_attn_tpu.serving import RaggedServeEngine
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(
+        vocab=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_head=16,
+        d_ff=128, block_q=8, block_kv=8, attn_backend="jnp", remat=False,
+        dtype=jnp.float32, batch_axis=None, head_axis=None,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(11)
+    lengths = [9, 5, 13, 3]
+    prompts = [np.asarray(rng.integers(1, cfg.vocab, size=(n,)), np.int32)
+               for n in lengths]
+    steps = [5, 4, 6, 3]
+    refs = [list(np.asarray(generate(params, jnp.asarray(p)[None], cfg,
+                                     steps=s, max_seq=256)[0]))
+            for p, s in zip(prompts, steps)]
+    return cfg, params, prompts, steps, refs
+
+
+def _serve(cfg, params, prompts, steps, **kw):
+    eng = RaggedServeEngine(params, cfg, slots=2, n_pages=10, page=128,
+                            max_pages_per_seq=4, chunk=4, **kw)
+    rids = [eng.submit(p, s) for p, s in zip(prompts, steps)]
+    res = eng.run()
+    return [res[r] for r in rids], eng
+
+
+MATRIX = [
+    ("greedy-k1", dict(), 1),
+    ("greedy-k4", dict(), 4),
+    ("sampled-k1", dict(temperature=0.8), 1),
+    ("sampled-k4", dict(temperature=0.8), 4),
+    ("sampled-topk-k4", dict(temperature=0.7, top_k=8), 4),
+    ("int8-k4", dict(quantize="int8"), 4),
+    ("fp8-k4", dict(quantize="fp8"), 4),
+]
+
+
+@pytest.mark.parametrize("name,kw,ms", MATRIX, ids=[m[0] for m in MATRIX])
+def test_pipelined_parity_matrix(setup, name, kw, ms):
+    """Pipelined streams bit-identical to the synchronous engine across
+    decode modes, pool dtypes, and fused depths.  Four requests over two
+    slots, so admission/retire events interleave with in-flight launches
+    on every config."""
+    cfg, params, prompts, steps, _ = setup
+    if "temperature" in kw:
+        kw = dict(kw, rng=jax.random.PRNGKey(7))
+    base, _ = _serve(cfg, params, prompts, steps, **kw)
+    launches0 = obs.counter("serve.multi_step_launches").total()
+    piped, eng = _serve(cfg, params, prompts, steps,
+                        pipeline=True, multi_step=ms, **kw)
+    assert piped == base, name
+    assert eng._pending is None and eng.live == 0
+    assert eng.pool.available == 9  # nothing orphaned by deferred readback
+    if ms > 1:
+        # the fused path actually ran (labeled counter: k="4")
+        assert obs.counter("serve.multi_step_launches").get(k=str(ms)) > 0
+        assert obs.counter("serve.multi_step_launches").total() > launches0
+
+
+def test_pipelined_greedy_matches_generate(setup):
+    """The pipelined engine is exact vs single-stream generate(), not
+    just vs the sync engine (guards against a shared bug)."""
+    cfg, params, prompts, steps, refs = setup
+    piped, _ = _serve(cfg, params, prompts, steps, pipeline=True,
+                      multi_step=4)
+    assert piped == refs
+
+
+def test_pipelined_eos_truncation_and_reconcile(setup):
+    """An EOS inside a fused launch: tokens past the first EOS step are
+    schedule the sync engine would never produce, so the readback
+    truncates and the speculated launch is reconciled away — and the
+    streams still match the synchronous engine exactly."""
+    cfg, params, prompts, steps, refs = setup
+    eos = int(refs[0][0])  # fires early for request 0 AND mid-stream for 2
+    base, _ = _serve(cfg, params, prompts, steps, eos_id=eos)
+    rec0 = obs.counter("serve.pipeline_reconciles").total()
+    piped, _ = _serve(cfg, params, prompts, steps, eos_id=eos,
+                      pipeline=True, multi_step=4)
+    assert piped == base
+    assert obs.counter("serve.pipeline_reconciles").total() > rec0
+
+
+def test_pipelined_deferred_journal_ordering(setup, tmp_path):
+    """Delivery lags one step but durability does not: while a launch is
+    in flight its tokens are journaled by the NEXT tick's readback,
+    fsynced, and only then delivered.  The journal machine (attached via
+    TokenJournal.delivered) would raise DurabilityViolation on any
+    token returned before its fsync — a clean run IS the proof.  The
+    folded journal must end exactly equal to the delivered streams."""
+    from burst_attn_tpu.serving import checkpoint as ckpt
+
+    cfg, params, prompts, steps, _ = setup
+    path = str(tmp_path / "pipe.jsonl")
+    journal = ckpt.TokenJournal(path, truncate=True)
+    eng = RaggedServeEngine(params, cfg, slots=2, n_pages=10, page=128,
+                            max_pages_per_seq=4, chunk=4, journal=journal,
+                            pipeline=True, multi_step=4)
+    rids = []
+    for p, s in zip(prompts, steps):
+        res = eng.try_submit(p, s)
+        assert res.ok
+        journal.submit(res.rid, res.rid, p, s)
+        rids.append(res.rid)
+    journal.sync()
+
+    lagged = False
+    out = {}
+    for _ in range(10_000):
+        for rid, toks in eng.step():
+            out[rid] = toks
+        if eng._pending is not None:
+            # a launch is in flight: its sampled tokens are journaled by
+            # a FUTURE readback — the on-disk view lags what the device
+            # has already computed
+            durable = sum(len(t) for t in
+                          ckpt.journal_view(path).tokens.values())
+            lagged = lagged or durable < sum(steps)
+        if len(out) == len(rids):
+            break
+    assert lagged, "pipeline never had a launch in flight"
+    assert eng._pending is None
+    view = ckpt.journal_view(path)
+    for rid in rids:
+        assert view.tokens[rid] == out[rid]
+        assert rid in view.done
+
+
+def test_pipelined_drain_quiesces(setup):
+    """drain() mid-flight flushes the pending launch, requeues live work,
+    zeroes the gauges — and the engine then serves everything exactly."""
+    cfg, params, prompts, steps, refs = setup
+    eng = RaggedServeEngine(params, cfg, slots=2, n_pages=10, page=128,
+                            max_pages_per_seq=4, chunk=4, pipeline=True,
+                            multi_step=4)
+    rids = [eng.submit(p, s) for p, s in zip(prompts, steps)]
+    for _ in range(4):
+        eng.step()
+    assert eng._pending is not None  # genuinely mid-flight
+    eng.drain()
+    assert eng._pending is None and eng.live == 0
+    assert obs.gauge("serve.live_slots").get() == 0.0
+    assert obs.gauge("serve.page_pool_occupancy").get() == 0.0
+    res = eng.run()
+    assert [res[r] for r in rids] == refs
+
+
+def test_pipelined_draft_engine_delegates(setup):
+    """A draft-model engine with pipeline=True serves through the
+    synchronous speculative rounds (already fused launches; trivially
+    exact) — same tokens, spec machinery exercised."""
+    cfg, params, prompts, steps, refs = setup
+    dcfg = ModelConfig(
+        vocab=97, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2, d_head=16,
+        d_ff=64, block_q=8, block_kv=8, attn_backend="jnp", remat=False,
+        dtype=jnp.float32, batch_axis=None, head_axis=None,
+    )
+    dparams = init_params(jax.random.PRNGKey(1), dcfg)
+    eng = RaggedServeEngine(params, cfg, slots=2, n_pages=12, page=128,
+                            max_pages_per_seq=4, chunk=4, pipeline=True,
+                            draft_params=dparams, draft_cfg=dcfg, spec_k=3)
+    rids = [eng.submit(p, s) for p, s in zip(prompts, steps)]
+    res = eng.run()
+    assert [res[r] for r in rids] == refs
+    assert eng.spec_rounds > 0
+
+
+def test_pipelined_prefix_cache_parity():
+    """Shared-template workload with the prefix cache on: pipelined vs
+    synchronous cached engines agree, and both agree with an uncached
+    oracle — CoW barriers and cache registration survive the deferred
+    readback (table rows are captured at dispatch time)."""
+    from burst_attn_tpu.loadgen.worker import build_engine
+
+    model_spec = dict(vocab=97, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=1, d_head=16, d_ff=64, seed=0)
+    engine_spec = dict(slots=2, n_pages=10, page=128, max_pages_per_seq=2,
+                       chunk=64)
+    rng = np.random.default_rng(5)
+    tmpl = [int(t) for t in rng.integers(1, 97, 128)]
+    prompts = [tmpl + [int(t) for t in rng.integers(1, 97, n)]
+               for n in (3, 7)]
+    prompts.append(list(tmpl))  # exact-template: full-prompt cache hit
+
+    def serve(spec):
+        eng = build_engine(model_spec, spec)
+        rids = [eng.submit(np.asarray(p, np.int32), 5) for p in prompts]
+        res = eng.run()
+        return [res[r] for r in rids], eng
+
+    oracle, _ = serve(engine_spec)
+    cached_spec = dict(engine_spec, prefix_cache=True)
+    base, _ = serve(cached_spec)
+    hits0 = obs.counter("serve.prefix_hits").total()
+    piped, eng = serve(dict(cached_spec, pipeline=True, multi_step=4))
+    assert base == oracle
+    assert piped == oracle
+    assert obs.counter("serve.prefix_hits").total() > hits0
+    # cache drains clean: full evict leaves zero held pages
+    eng.cache.evict(eng.pool.n_pages)
+    assert eng.pool.in_use == 0
+
+
+def test_multi_step_requires_pipeline(setup):
+    cfg, params, _, _, _ = setup
+    with pytest.raises(ValueError):
+        RaggedServeEngine(params, cfg, slots=2, n_pages=10, page=128,
+                          max_pages_per_seq=4, multi_step=4)
+    with pytest.raises(ValueError):
+        RaggedServeEngine(params, cfg, slots=2, n_pages=10, page=128,
+                          max_pages_per_seq=4, pipeline=True, multi_step=0)
